@@ -1,0 +1,6 @@
+"""TPU Pallas kernels for hot ops.
+
+`flash_attention` is the Pallas fused-attention kernel used behind the
+`use_fused_attn()` config switch (see timm_tpu/layers/attention.py).
+"""
+from .flash_attention import flash_attention, flash_attention_supported

@@ -1,0 +1,16 @@
+"""Pallas TPU flash attention (placeholder dispatch until kernel lands).
+
+The real kernel is task #10; this module keeps the dispatch contract stable:
+`flash_attention_supported(q, k, v, mask)` gates the call site.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def flash_attention_supported(q, k, v, mask=None) -> bool:
+    return False
+
+
+def flash_attention(q, k, v, mask=None, scale=None):
+    raise NotImplementedError('Pallas flash attention kernel not yet available')

@@ -1,0 +1,32 @@
+from .attention import Attention, AttentionRope, maybe_add_mask, scaled_dot_product_attention
+from .attention_pool import AttentionPoolLatent
+from .classifier import ClassifierHead, NormMlpClassifierHead, create_classifier
+from .config import (
+    is_exportable, is_scriptable, set_exportable, set_scriptable,
+    set_fused_attn, use_fused_attn,
+)
+from .create_act import create_act_layer, get_act_fn, get_act_layer
+from .create_conv2d import ConvNormAct, create_conv2d, get_padding
+from .create_norm import create_norm_layer, get_norm_layer
+from .drop import DropPath, Dropout, calculate_drop_path_rates, drop_path
+from .helpers import extend_tuple, make_divisible, to_1tuple, to_2tuple, to_3tuple, to_4tuple, to_ntuple
+from .layer_scale import LayerScale, LayerScale2d
+from .mlp import ConvMlp, GatedMlp, GlobalResponseNorm, GlobalResponseNormMlp, GluMlp, Mlp, SwiGLU, SwiGLUPacked
+from .norm import (
+    BatchNorm2d, GroupNorm, GroupNorm1, LayerNorm, LayerNorm2d, LayerNormFp32,
+    RmsNorm, RmsNorm2d, SimpleNorm, SimpleNorm2d,
+)
+from .norm_act import (
+    BatchNormAct2d, FrozenBatchNormAct2d, GroupNorm1Act, GroupNormAct,
+    LayerNormAct, LayerNormAct2d,
+)
+from .patch_dropout import PatchDropout
+from .patch_embed import PatchEmbed, resample_patch_embed
+from .pool import SelectAdaptivePool2d, adaptive_pool_feat_mult, global_pool_nlc
+from .pos_embed import resample_abs_pos_embed, resample_abs_pos_embed_nhwc
+from .pos_embed_sincos import (
+    RotaryEmbeddingCat, build_fourier_pos_embed, build_rotary_pos_embed,
+    build_sincos2d_pos_embed, freq_bands, pixel_freq_bands,
+)
+from .squeeze_excite import EffectiveSEModule, SEModule, SqueezeExcite
+from .weight_init import lecun_normal_, trunc_normal_, trunc_normal_tf_, variance_scaling_

@@ -1,0 +1,179 @@
+"""Multi-head attention (reference: timm/layers/attention.py:1-293).
+
+TPU-first design: tokens are (B, N, C); the fused path dispatches to
+`jax.nn.dot_product_attention` (XLA flash lowering) or the local Pallas
+flash kernel (timm_tpu/kernels/flash_attention.py) when shapes allow; the
+manual path is plain einsum+softmax which XLA also fuses well. Selection is
+trace-time via `use_fused_attn()` — the reference's SDPA-vs-manual switch at
+attention.py:123-129.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from .config import use_fused_attn
+from .drop import Dropout
+from .weight_init import trunc_normal_, zeros_
+
+__all__ = ['Attention', 'AttentionRope', 'maybe_add_mask', 'apply_rot_embed_cat']
+
+
+def maybe_add_mask(scores, attn_mask=None):
+    if attn_mask is None:
+        return scores
+    if attn_mask.dtype == jnp.bool_:
+        neg = jnp.finfo(scores.dtype).min
+        return jnp.where(attn_mask, scores, neg)
+    return scores + attn_mask
+
+
+def apply_rot_embed_cat(x, emb):
+    """Apply concatenated (sin, cos) rotary embedding to (..., N, D) tokens."""
+    sin_emb, cos_emb = jnp.split(emb, 2, axis=-1)
+    x1, x2 = jnp.split(x.reshape(*x.shape[:-1], -1, 2), 2, axis=-1)
+    x1 = x1[..., 0]
+    x2 = x2[..., 0]
+    rot = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+    return x * cos_emb + rot * sin_emb
+
+
+def _sdpa(q, k, v, attn_mask=None, dropout_p: float = 0.0, key=None, scale: Optional[float] = None):
+    """Scaled dot-product attention on (B, H, N, D) tensors."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    q = q * scale
+    attn = jnp.einsum('bhqd,bhkd->bhqk', q, k)
+    attn = maybe_add_mask(attn, attn_mask)
+    attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, attn.shape)
+        attn = jnp.where(keep, attn / (1.0 - dropout_p), 0.0)
+    return jnp.einsum('bhqk,bhkd->bhqd', attn, v)
+
+
+def scaled_dot_product_attention(
+        q, k, v,
+        attn_mask=None,
+        dropout_p: float = 0.0,
+        dropout_key=None,
+        scale: Optional[float] = None,
+        fused: Optional[bool] = None,
+):
+    """Dispatcher over (B, H, N, D) q/k/v. `fused=None` → config default."""
+    fused = use_fused_attn() if fused is None else fused
+    if fused and dropout_p == 0.0:
+        from ..kernels import flash_attention_supported, flash_attention
+        if flash_attention_supported(q, k, v, attn_mask):
+            return flash_attention(q, k, v, mask=attn_mask, scale=scale)
+        # XLA's fused path: expects (B, N, H, D)
+        mask = attn_mask
+        if mask is not None and mask.dtype != jnp.bool_:
+            return _sdpa(q, k, v, attn_mask, 0.0, None, scale)
+        out = jax.nn.dot_product_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+            mask=mask, scale=scale,
+        )
+        return out.transpose(0, 2, 1, 3)
+    return _sdpa(q, k, v, attn_mask, dropout_p, dropout_key, scale)
+
+
+class Attention(nnx.Module):
+    """Standard MHSA with optional qk-norm (reference attention.py:26-146)."""
+
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int = 8,
+            qkv_bias: bool = False,
+            qk_norm: bool = False,
+            proj_bias: bool = True,
+            attn_drop: float = 0.0,
+            proj_drop: float = 0.0,
+            norm_layer: Optional[Callable] = None,
+            scale_norm: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert dim % num_heads == 0, 'dim should be divisible by num_heads'
+        if qk_norm or scale_norm:
+            assert norm_layer is not None, 'norm_layer must be provided if qk_norm or scale_norm is True'
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.scale = self.head_dim ** -0.5
+        self.attn_drop_rate = attn_drop
+
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs,
+        )
+        self.qkv = linear(dim, dim * 3, use_bias=qkv_bias)
+        self.q_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.k_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.norm = norm_layer(dim, rngs=rngs) if scale_norm else None
+        self.proj = linear(dim, dim, use_bias=proj_bias)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def _qkv(self, x):
+        B, N, C = x.shape
+        qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, self.head_dim)
+        qkv = qkv.transpose(2, 0, 3, 1, 4)  # (3, B, H, N, D)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+        if self.k_norm is not None:
+            k = self.k_norm(k)
+        return q, k, v
+
+    def __call__(self, x, attn_mask=None):
+        B, N, C = x.shape
+        q, k, v = self._qkv(x)
+        dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop_rate
+        dropout_key = self.attn_drop.rngs.dropout() if (dropout_p > 0.0 and self.attn_drop.rngs is not None) else None
+        x = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale,
+        )
+        x = x.transpose(0, 2, 1, 3).reshape(B, N, C)
+        if self.norm is not None:
+            x = self.norm(x)
+        x = self.proj(x)
+        x = self.proj_drop(x)
+        return x
+
+
+class AttentionRope(Attention):
+    """MHSA accepting a rotary position embedding (reference attention.py:149+)."""
+
+    def __call__(self, x, rope=None, attn_mask=None):
+        B, N, C = x.shape
+        q, k, v = self._qkv(x)
+        if rope is not None:
+            # don't rotate prefix (cls/reg) tokens — rope covers trailing tokens
+            num_prefix = N - rope.shape[-2]
+            if num_prefix > 0:
+                qp, qr = q[..., :num_prefix, :], q[..., num_prefix:, :]
+                kp, kr = k[..., :num_prefix, :], k[..., num_prefix:, :]
+                q = jnp.concatenate([qp, apply_rot_embed_cat(qr, rope)], axis=-2)
+                k = jnp.concatenate([kp, apply_rot_embed_cat(kr, rope)], axis=-2)
+            else:
+                q = apply_rot_embed_cat(q, rope)
+                k = apply_rot_embed_cat(k, rope)
+            q = q.astype(v.dtype)
+            k = k.astype(v.dtype)
+        dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop_rate
+        dropout_key = self.attn_drop.rngs.dropout() if (dropout_p > 0.0 and self.attn_drop.rngs is not None) else None
+        x = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale,
+        )
+        x = x.transpose(0, 2, 1, 3).reshape(B, N, C)
+        if self.norm is not None:
+            x = self.norm(x)
+        x = self.proj(x)
+        x = self.proj_drop(x)
+        return x

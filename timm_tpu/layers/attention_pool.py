@@ -1,0 +1,103 @@
+"""Latent-query attention pooling (reference: timm/layers/attention_pool.py).
+
+Used by ViT 'map' pooling — a learned latent attends over the token sequence.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .attention import scaled_dot_product_attention
+from .drop import Dropout
+from .mlp import Mlp
+from .norm import LayerNorm
+from .weight_init import trunc_normal_, zeros_
+
+__all__ = ['AttentionPoolLatent']
+
+
+class AttentionPoolLatent(nnx.Module):
+    def __init__(
+            self,
+            in_features: int,
+            out_features: Optional[int] = None,
+            embed_dim: Optional[int] = None,
+            num_heads: int = 8,
+            feat_size: Optional[int] = None,
+            mlp_ratio: float = 4.0,
+            qkv_bias: bool = True,
+            qk_norm: bool = False,
+            latent_len: int = 1,
+            latent_dim: Optional[int] = None,
+            pos_embed: str = '',
+            pool_type: str = 'token',
+            norm_layer: Optional[Callable] = None,
+            act_layer: Union[str, Callable] = 'gelu',
+            drop: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        embed_dim = embed_dim or in_features
+        out_features = out_features or in_features
+        assert embed_dim % num_heads == 0
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.scale = self.head_dim ** -0.5
+        self.pool = pool_type
+        self.latent_len = latent_len
+
+        norm_layer = norm_layer or LayerNorm
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs,
+        )
+
+        if pos_embed == 'abs':
+            assert feat_size is not None
+            self.pos_embed = nnx.Param(jnp.zeros((feat_size, in_features), param_dtype))
+        else:
+            self.pos_embed = None
+
+        self.latent_dim = latent_dim or embed_dim
+        import jax
+        self.latent = nnx.Param(
+            trunc_normal_(std=in_features ** -0.5)(rngs.params(), (1, self.latent_len, embed_dim), param_dtype))
+
+        self.q = linear(embed_dim, embed_dim, use_bias=qkv_bias)
+        self.kv = linear(embed_dim, embed_dim * 2, use_bias=qkv_bias)
+        self.q_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.k_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.proj = linear(embed_dim, embed_dim)
+        self.proj_drop = Dropout(drop, rngs=rngs)
+
+        self.norm = norm_layer(out_features, rngs=rngs)
+        self.mlp = Mlp(embed_dim, int(embed_dim * mlp_ratio), act_layer=act_layer,
+                       dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        B, N, C = x.shape
+        if self.pos_embed is not None:
+            x = x + self.pos_embed[...].astype(x.dtype)[None]
+        q_latent = jnp.broadcast_to(self.latent[...].astype(x.dtype), (B, self.latent_len, x.shape[-1]))
+        q = self.q(q_latent).reshape(B, self.latent_len, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        kv = self.kv(x).reshape(B, N, 2, self.num_heads, self.head_dim).transpose(2, 0, 3, 1, 4)
+        k, v = kv[0], kv[1]
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+        if self.k_norm is not None:
+            k = self.k_norm(k)
+        x = scaled_dot_product_attention(q, k, v, scale=self.scale)
+        x = x.transpose(0, 2, 1, 3).reshape(B, self.latent_len, -1)
+        x = self.proj(x)
+        x = self.proj_drop(x)
+        x = x + self.mlp(self.norm(x))
+        if self.pool == 'token':
+            x = x[:, 0]
+        elif self.pool == 'avg':
+            x = x.mean(axis=1)
+        return x

@@ -1,0 +1,142 @@
+"""Classifier heads (reference: timm/layers/classifier.py:1-300)."""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_act import get_act_fn
+from .drop import Dropout
+from .norm import LayerNorm
+from .pool import SelectAdaptivePool2d
+from .weight_init import trunc_normal_, zeros_
+
+__all__ = ['ClassifierHead', 'NormMlpClassifierHead', 'create_classifier']
+
+
+def create_classifier(
+        num_features: int,
+        num_classes: int,
+        pool_type: str = 'avg',
+        *,
+        dtype=None,
+        param_dtype=jnp.float32,
+        rngs: nnx.Rngs,
+):
+    pool = SelectAdaptivePool2d(pool_type=pool_type, flatten=True)
+    num_pooled = num_features * pool.feat_mult()
+    if num_classes <= 0:
+        fc = None
+    else:
+        fc = nnx.Linear(
+            num_pooled, num_classes, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs,
+        )
+    return pool, fc
+
+
+class ClassifierHead(nnx.Module):
+    """Pool → drop → fc, with reset support (reference classifier.py:ClassifierHead)."""
+
+    def __init__(
+            self,
+            in_features: int,
+            num_classes: int,
+            pool_type: str = 'avg',
+            drop_rate: float = 0.0,
+            input_fmt: str = 'NHWC',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.in_features = in_features
+        self.num_classes = num_classes
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+        self.global_pool, self.fc = create_classifier(
+            in_features, num_classes, pool_type=pool_type, dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+        )
+        self.drop = Dropout(drop_rate, rngs=rngs)
+
+    def reset(self, num_classes: int, pool_type: Optional[str] = None, *, rngs: Optional[nnx.Rngs] = None):
+        self.num_classes = num_classes
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        pool_type = pool_type if pool_type is not None else self.global_pool.pool_type
+        self.global_pool, self.fc = create_classifier(
+            self.in_features, num_classes, pool_type=pool_type,
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs,
+        )
+
+    def __call__(self, x, pre_logits: bool = False):
+        x = self.global_pool(x)
+        x = self.drop(x)
+        if pre_logits or self.fc is None:
+            return x
+        return self.fc(x)
+
+
+class NormMlpClassifierHead(nnx.Module):
+    """Pool → norm → (hidden mlp) → drop → fc (reference classifier.py:~180)."""
+
+    def __init__(
+            self,
+            in_features: int,
+            num_classes: int,
+            hidden_size: Optional[int] = None,
+            pool_type: str = 'avg',
+            drop_rate: float = 0.0,
+            norm_layer: Union[str, Callable] = LayerNorm,
+            act_layer: Union[str, Callable] = 'tanh',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.in_features = in_features
+        self.hidden_size = hidden_size
+        self.num_classes = num_classes
+        self.num_features = hidden_size or in_features
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+        self.global_pool = SelectAdaptivePool2d(pool_type=pool_type, flatten=True)
+        self.norm = norm_layer(in_features, rngs=rngs)
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs,
+        )
+        if hidden_size:
+            self.pre_logits_fc = linear(in_features, hidden_size)
+            self.pre_logits_act = get_act_fn(act_layer)
+        else:
+            self.pre_logits_fc = None
+            self.pre_logits_act = None
+        self.drop = Dropout(drop_rate, rngs=rngs)
+        self.fc = linear(self.num_features, num_classes) if num_classes > 0 else None
+
+    def reset(self, num_classes: int, pool_type: Optional[str] = None, *, rngs: Optional[nnx.Rngs] = None):
+        self.num_classes = num_classes
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        if pool_type is not None:
+            self.global_pool = SelectAdaptivePool2d(pool_type=pool_type, flatten=True)
+        if num_classes > 0:
+            self.fc = nnx.Linear(
+                self.num_features, num_classes, dtype=self._dtype, param_dtype=self._param_dtype,
+                kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs,
+            )
+        else:
+            self.fc = None
+
+    def __call__(self, x, pre_logits: bool = False):
+        if x.ndim == 4:
+            x = self.global_pool(x)
+        x = self.norm(x)
+        if self.pre_logits_fc is not None:
+            x = self.pre_logits_act(self.pre_logits_fc(x))
+        x = self.drop(x)
+        if pre_logits or self.fc is None:
+            return x
+        return self.fc(x)

@@ -1,0 +1,77 @@
+"""Global layer behaviour flags.
+
+TPU-native re-design of the reference's layer-config singleton
+(reference: timm/layers/config.py:101-165). Unlike the reference we keep the
+surface minimal: flags only select which code path gets *traced* (e.g. Pallas
+flash attention vs. plain XLA dot-product attention); they never mutate state
+inside a jitted computation, so they are safe process-level switches.
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+__all__ = [
+    'is_exportable', 'is_scriptable', 'set_exportable', 'set_scriptable',
+    'use_fused_attn', 'set_fused_attn',
+]
+
+# Pallas flash-attention toggle. 0 = never, 1 = on TPU when shapes allow,
+# 2 = always (error if unsupported).  Seeded from env like TIMM_FUSED_ATTN.
+_USE_FUSED_ATTN = int(os.environ.get('TIMM_TPU_FUSED_ATTN', '1'))
+
+# Export mode: prefer the most portable lowering (no Pallas custom kernels).
+_EXPORTABLE = False
+# Kept for API parity with the reference; TorchScript has no TPU analogue.
+_SCRIPTABLE = False
+
+
+def is_exportable() -> bool:
+    return _EXPORTABLE
+
+
+def is_scriptable() -> bool:
+    return _SCRIPTABLE
+
+
+@contextmanager
+def set_exportable(value: bool):
+    global _EXPORTABLE
+    prev = _EXPORTABLE
+    _EXPORTABLE = value
+    try:
+        yield
+    finally:
+        _EXPORTABLE = prev
+
+
+@contextmanager
+def set_scriptable(value: bool):
+    global _SCRIPTABLE
+    prev = _SCRIPTABLE
+    _SCRIPTABLE = value
+    try:
+        yield
+    finally:
+        _SCRIPTABLE = prev
+
+
+def use_fused_attn(experimental: bool = False) -> bool:
+    """Whether attention layers should trace the Pallas fused kernel path."""
+    if _EXPORTABLE:
+        return False
+    if _USE_FUSED_ATTN > 1:
+        return True
+    if _USE_FUSED_ATTN < 1:
+        return False
+    # Default: fused on real TPU backends only; CPU tests use the XLA path.
+    import jax
+    try:
+        return jax.default_backend() == 'tpu'
+    except Exception:
+        return False
+
+
+def set_fused_attn(enable: bool = True, experimental: bool = False):
+    global _USE_FUSED_ATTN
+    _USE_FUSED_ATTN = 2 if (enable and experimental) else (1 if enable else 0)

@@ -1,0 +1,98 @@
+"""Activation factory (reference: timm/layers/create_act.py + activations.py).
+
+Activations are pure functions here (not Modules) — XLA fuses them into the
+surrounding matmuls, so the reference's memory-efficient custom-grad variants
+(activations_me.py) are unnecessary on TPU.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['get_act_fn', 'get_act_layer', 'create_act_layer']
+
+
+def gelu(x):
+    return jax.nn.gelu(x, approximate=False)
+
+
+def gelu_tanh(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def hard_sigmoid(x):
+    return jax.nn.relu6(x + 3.0) / 6.0
+
+
+def hard_swish(x):
+    return x * hard_sigmoid(x)
+
+
+def hard_mish(x):
+    return 0.5 * x * jnp.clip(x + 2.0, 0.0, 2.0)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def prelu_like(x):  # placeholder; PReLU with learned slope is a module concern
+    return jax.nn.leaky_relu(x, 0.25)
+
+
+_ACT_FNS = {
+    '': None,
+    'none': None,
+    'identity': lambda x: x,
+    'relu': jax.nn.relu,
+    'relu6': jax.nn.relu6,
+    'leaky_relu': jax.nn.leaky_relu,
+    'elu': jax.nn.elu,
+    'celu': jax.nn.celu,
+    'selu': jax.nn.selu,
+    'gelu': gelu,
+    'gelu_tanh': gelu_tanh,
+    'gelu_erf': gelu,
+    'quick_gelu': quick_gelu,
+    'sigmoid': jax.nn.sigmoid,
+    'tanh': jnp.tanh,
+    'silu': jax.nn.silu,
+    'swish': swish,
+    'mish': mish,
+    'hard_sigmoid': hard_sigmoid,
+    'hard_swish': hard_swish,
+    'hard_mish': hard_mish,
+    'softplus': jax.nn.softplus,
+    'hardswish': hard_swish,
+    'hardsigmoid': hard_sigmoid,
+}
+
+
+def get_act_fn(name: Union[str, Callable, None] = 'relu') -> Optional[Callable]:
+    if name is None:
+        return None
+    if callable(name):
+        return name
+    name = name.lower()
+    if name not in _ACT_FNS:
+        raise ValueError(f'Unknown activation: {name}')
+    return _ACT_FNS[name]
+
+
+# In this framework activations are functions; layer == fn.
+get_act_layer = get_act_fn
+
+
+def create_act_layer(name, inplace=None, **kwargs):
+    fn = get_act_fn(name)
+    return fn if fn is not None else (lambda x: x)

@@ -1,0 +1,124 @@
+"""Conv layer factory for NHWC TPU convs
+(reference: timm/layers/create_conv2d.py, conv2d_same.py, padding.py).
+
+TF-'SAME' padding is native in lax/flax conv (`padding='SAME'`), so the
+reference's Conv2dSame wrapper machinery collapses into a padding string.
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .helpers import to_2tuple
+from .weight_init import variance_scaling_, zeros_
+
+__all__ = ['create_conv2d', 'ConvNormAct', 'get_padding']
+
+
+def get_padding(kernel_size: int, stride: int = 1, dilation: int = 1):
+    """Symmetric 'same-when-stride-1' padding amount (reference padding.py:get_padding)."""
+    if isinstance(kernel_size, (tuple, list)):
+        return tuple(get_padding(k, s, d) for k, s, d in
+                     zip(kernel_size, to_2tuple(stride), to_2tuple(dilation)))
+    return ((stride - 1) + dilation * (kernel_size - 1)) // 2
+
+
+def _resolve_padding(padding, kernel_size, stride, dilation):
+    """Map timm padding conventions onto flax conv padding."""
+    if isinstance(padding, str):
+        padding = padding.lower()
+        if padding in ('same', ''):
+            return 'SAME'
+        if padding == 'valid':
+            return 'VALID'
+        raise ValueError(f'Unknown padding {padding}')
+    if padding is None:
+        padding = get_padding(kernel_size, stride, dilation)
+    if isinstance(padding, int):
+        return [(padding, padding), (padding, padding)]
+    # tuple of per-dim ints
+    return [(p, p) for p in padding]
+
+
+def create_conv2d(
+        in_channels: int,
+        out_channels: int,
+        kernel_size: Union[int, tuple] = 3,
+        stride: int = 1,
+        padding='',
+        dilation: int = 1,
+        groups: int = 1,
+        bias: bool = False,
+        depthwise: bool = False,
+        *,
+        dtype=None,
+        param_dtype=jnp.float32,
+        rngs: nnx.Rngs,
+) -> nnx.Conv:
+    """NHWC conv with timm argument conventions (conv weights are HWIO)."""
+    if depthwise:
+        groups = in_channels
+    kernel_size = to_2tuple(kernel_size)
+    return nnx.Conv(
+        in_channels, out_channels,
+        kernel_size=kernel_size,
+        strides=to_2tuple(stride),
+        padding=_resolve_padding(padding, kernel_size, stride, dilation),
+        kernel_dilation=to_2tuple(dilation),
+        feature_group_count=groups,
+        use_bias=bias,
+        dtype=dtype,
+        param_dtype=param_dtype,
+        kernel_init=variance_scaling_(2.0, 'fan_out', 'normal'),
+        bias_init=zeros_,
+        rngs=rngs,
+    )
+
+
+class ConvNormAct(nnx.Module):
+    """Conv + norm + act composite (reference: timm/layers/conv_bn_act.py)."""
+
+    def __init__(
+            self,
+            in_channels: int,
+            out_channels: int,
+            kernel_size: Union[int, tuple] = 1,
+            stride: int = 1,
+            padding='',
+            dilation: int = 1,
+            groups: int = 1,
+            bias: bool = False,
+            apply_norm: bool = True,
+            apply_act: bool = True,
+            norm_layer=None,
+            act_layer='relu',
+            drop_layer=None,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        from .norm_act import BatchNormAct2d
+        self.conv = create_conv2d(
+            in_channels, out_channels, kernel_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, bias=bias,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+        )
+        if apply_norm:
+            norm_act = norm_layer or BatchNormAct2d
+            self.bn = norm_act(
+                out_channels, apply_act=apply_act, act_layer=act_layer,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+            )
+        else:
+            from .create_act import get_act_fn
+            act = get_act_fn(act_layer) if apply_act else None
+            self.bn = act
+
+    def __call__(self, x):
+        x = self.conv(x)
+        if self.bn is not None:
+            x = self.bn(x)
+        return x

@@ -1,0 +1,76 @@
+"""Stochastic-depth / dropout regularizers (reference: timm/layers/drop.py).
+
+RNG is explicit: modules own an `nnx.Rngs` stream; `model.eval()` flips the
+standard `deterministic` flag the same way flax dropout does.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+__all__ = ['DropPath', 'Dropout', 'calculate_drop_path_rates', 'drop_path']
+
+
+def drop_path(x, key, drop_prob: float = 0.0, scale_by_keep: bool = True):
+    """Per-sample stochastic depth (reference drop.py:~140)."""
+    if drop_prob == 0.0:
+        return x
+    keep_prob = 1.0 - drop_prob
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    mask = jax.random.bernoulli(key, keep_prob, shape)
+    if scale_by_keep:
+        return jnp.where(mask, x / keep_prob, jnp.zeros((), x.dtype))
+    return jnp.where(mask, x, jnp.zeros((), x.dtype))
+
+
+class DropPath(nnx.Module):
+    """Drop residual-branch output per sample (stochastic depth)."""
+
+    def __init__(self, drop_prob: float = 0.0, scale_by_keep: bool = True, *, rngs: Optional[nnx.Rngs] = None):
+        self.drop_prob = float(drop_prob)
+        self.scale_by_keep = scale_by_keep
+        self.deterministic = False
+        self.rngs = rngs.fork() if rngs is not None and self.drop_prob > 0.0 else None
+
+    def __call__(self, x):
+        if self.deterministic or self.drop_prob == 0.0 or self.rngs is None:
+            return x
+        return drop_path(x, self.rngs.dropout(), self.drop_prob, self.scale_by_keep)
+
+
+class Dropout(nnx.Dropout):
+    """nnx Dropout with a torch-ish positional-rate constructor."""
+
+    def __init__(self, rate: float = 0.0, *, rngs: Optional[nnx.Rngs] = None):
+        super().__init__(rate=rate, rngs=rngs if rate > 0.0 else None)
+
+
+def calculate_drop_path_rates(
+        drop_path_rate: float,
+        depths: Union[int, List[int]],
+        stagewise: bool = False,
+) -> Union[List[float], List[List[float]]]:
+    """Linearly-increasing per-block drop-path rates (reference drop.py:~190)."""
+    if isinstance(depths, int):
+        depths = [depths]
+        squeeze = True
+    else:
+        squeeze = False
+    total = sum(depths)
+    rates = [drop_path_rate * i / max(total - 1, 1) for i in range(total)]
+    if stagewise:
+        out, idx = [], 0
+        for d in depths:
+            out.append(rates[idx:idx + d])
+            idx += d
+        return out[0] if squeeze else out
+    if squeeze:
+        return rates
+    out, idx = [], 0
+    for d in depths:
+        out.append(rates[idx:idx + d])
+        idx += d
+    return out

@@ -1,0 +1,19 @@
+"""LayerScale (reference: timm/layers/layer_scale.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import nnx
+
+__all__ = ['LayerScale', 'LayerScale2d']
+
+
+class LayerScale(nnx.Module):
+    def __init__(self, dim: int, init_values: float = 1e-5, *, param_dtype=jnp.float32, rngs: nnx.Rngs = None):
+        self.gamma = nnx.Param(jnp.full((dim,), init_values, param_dtype))
+
+    def __call__(self, x):
+        return x * self.gamma[...].astype(x.dtype)
+
+
+# NHWC: channel axis is last in both token and spatial layouts.
+LayerScale2d = LayerScale

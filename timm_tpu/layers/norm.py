@@ -1,0 +1,147 @@
+"""Normalization layers (reference: timm/layers/norm.py:1-575, fast_norm.py).
+
+All activations live in NHWC / NLC layouts, so the channel axis is always the
+last axis and every '2d' variant is the same computation as its 1d cousin —
+no permutes, no special cases. XLA fuses these for free, which subsumes the
+reference's fast_norm/APEX machinery.
+
+Frameworks note: these subclass flax.nnx norm modules but expose the
+reference's constructor conventions (`eps`, `affine`, positional num_channels).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import nnx
+
+__all__ = [
+    'LayerNorm', 'LayerNorm2d', 'LayerNormFp32', 'RmsNorm', 'RmsNorm2d',
+    'SimpleNorm', 'SimpleNorm2d', 'GroupNorm', 'GroupNorm1', 'BatchNorm2d',
+]
+
+
+class LayerNorm(nnx.LayerNorm):
+    """LayerNorm over the channel (last) axis."""
+
+    def __init__(
+            self,
+            num_channels: int,
+            eps: float = 1e-6,
+            affine: bool = True,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        super().__init__(
+            num_channels,
+            epsilon=eps,
+            use_bias=affine,
+            use_scale=affine,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            rngs=rngs,
+        )
+
+
+# NHWC: channels are already last, identical computation.
+LayerNorm2d = LayerNorm
+
+
+class LayerNormFp32(LayerNorm):
+    """LayerNorm forced to fp32 statistics (reference norm.py LayerNormFp32)."""
+
+    def __init__(self, num_channels, eps: float = 1e-6, affine: bool = True, *, rngs: nnx.Rngs, **kw):
+        super().__init__(num_channels, eps=eps, affine=affine, dtype=jnp.float32, rngs=rngs)
+
+
+class RmsNorm(nnx.RMSNorm):
+    def __init__(
+            self,
+            num_channels: int,
+            eps: float = 1e-6,
+            affine: bool = True,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        super().__init__(
+            num_channels,
+            epsilon=eps,
+            use_scale=affine,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            rngs=rngs,
+        )
+
+
+RmsNorm2d = RmsNorm
+# SimpleNorm (reference norm.py:~430) == RMSNorm with fp32 reduction; flax
+# RMSNorm already promotes reductions, so these alias.
+SimpleNorm = RmsNorm
+SimpleNorm2d = RmsNorm
+
+
+class GroupNorm(nnx.GroupNorm):
+    def __init__(
+            self,
+            num_channels: int,
+            num_groups: int = 32,
+            eps: float = 1e-5,
+            affine: bool = True,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        super().__init__(
+            num_channels,
+            num_groups=num_groups,
+            epsilon=eps,
+            use_bias=affine,
+            use_scale=affine,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            rngs=rngs,
+        )
+
+
+class GroupNorm1(GroupNorm):
+    """Group normalization with 1 group == LayerNorm over (H, W, C)."""
+
+    def __init__(self, num_channels, **kwargs):
+        super().__init__(num_channels, num_groups=1, **kwargs)
+
+
+class BatchNorm2d(nnx.BatchNorm):
+    """BatchNorm over N,H,W for NHWC inputs.
+
+    Under pjit with a batch-sharded input, the mean/var reductions are global
+    across the device mesh — XLA inserts the cross-replica collectives — so
+    this is natively a SyncBatchNorm (reference norm_act.py SyncBatchNormAct /
+    convert_sync_batchnorm have no separate TPU equivalent).
+    """
+
+    def __init__(
+            self,
+            num_features: int,
+            eps: float = 1e-5,
+            momentum: float = 0.1,
+            affine: bool = True,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        # torch-style momentum (weight of the *new* batch stat) → flax decay
+        super().__init__(
+            num_features,
+            use_running_average=False,
+            momentum=1.0 - momentum,
+            epsilon=eps,
+            use_bias=affine,
+            use_scale=affine,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            rngs=rngs,
+        )

@@ -1,0 +1,142 @@
+"""Norm + activation composite layers (reference: timm/layers/norm_act.py:1-690).
+
+The reference fuses norm+act into single modules so conv blocks can treat them
+as one unit; we keep that API. On TPU the fusion itself is XLA's job.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_act import get_act_fn
+from .norm import BatchNorm2d, GroupNorm, LayerNorm
+
+__all__ = ['BatchNormAct2d', 'GroupNormAct', 'GroupNorm1Act', 'LayerNormAct', 'LayerNormAct2d', 'FrozenBatchNormAct2d']
+
+
+class BatchNormAct2d(BatchNorm2d):
+    def __init__(
+            self,
+            num_features: int,
+            eps: float = 1e-5,
+            momentum: float = 0.1,
+            affine: bool = True,
+            apply_act: bool = True,
+            act_layer: Union[str, Callable, None] = 'relu',
+            act_kwargs=None,
+            drop_layer=None,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        super().__init__(
+            num_features, eps=eps, momentum=momentum, affine=affine,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+        )
+        self.act = get_act_fn(act_layer) if apply_act else None
+        self.drop = drop_layer() if drop_layer is not None else None
+
+    def __call__(self, x):
+        x = super().__call__(x)
+        if self.drop is not None:
+            x = self.drop(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+class FrozenBatchNormAct2d(nnx.Module):
+    """BN with frozen statistics and affine params (reference norm_act.py:~300)."""
+
+    def __init__(
+            self,
+            num_features: int,
+            eps: float = 1e-5,
+            apply_act: bool = True,
+            act_layer: Union[str, Callable, None] = 'relu',
+            *,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.eps = eps
+        self.scale = nnx.Variable(jnp.ones((num_features,), param_dtype))
+        self.bias = nnx.Variable(jnp.zeros((num_features,), param_dtype))
+        self.mean = nnx.Variable(jnp.zeros((num_features,), param_dtype))
+        self.var = nnx.Variable(jnp.ones((num_features,), param_dtype))
+        self.act = get_act_fn(act_layer) if apply_act else None
+
+    def __call__(self, x):
+        scale = self.scale[...] * jnp.reciprocal(jnp.sqrt(self.var[...] + self.eps))
+        bias = self.bias[...] - self.mean[...] * scale
+        x = x * scale.astype(x.dtype) + bias.astype(x.dtype)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+class GroupNormAct(GroupNorm):
+    def __init__(
+            self,
+            num_channels: int,
+            num_groups: int = 32,
+            eps: float = 1e-5,
+            affine: bool = True,
+            apply_act: bool = True,
+            act_layer: Union[str, Callable, None] = 'relu',
+            act_kwargs=None,
+            drop_layer=None,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        super().__init__(
+            num_channels, num_groups=num_groups, eps=eps, affine=affine,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+        )
+        self.act = get_act_fn(act_layer) if apply_act else None
+
+    def __call__(self, x):
+        x = super().__call__(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+class GroupNorm1Act(GroupNormAct):
+    def __init__(self, num_channels, **kwargs):
+        super().__init__(num_channels, num_groups=1, **kwargs)
+
+
+class LayerNormAct(LayerNorm):
+    def __init__(
+            self,
+            num_channels: int,
+            eps: float = 1e-6,
+            affine: bool = True,
+            apply_act: bool = True,
+            act_layer: Union[str, Callable, None] = 'relu',
+            act_kwargs=None,
+            drop_layer=None,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        super().__init__(
+            num_channels, eps=eps, affine=affine,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+        )
+        self.act = get_act_fn(act_layer) if apply_act else None
+
+    def __call__(self, x):
+        x = super().__call__(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+LayerNormAct2d = LayerNormAct  # NHWC: identical

@@ -1,0 +1,54 @@
+"""Patch (token) dropout (reference: timm/layers/patch_dropout.py).
+
+Keeps a fixed *count* of tokens per sample so shapes stay static under jit —
+per-sample random subset selection via argsort of random keys.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+__all__ = ['PatchDropout']
+
+
+class PatchDropout(nnx.Module):
+    def __init__(
+            self,
+            prob: float = 0.5,
+            num_prefix_tokens: int = 1,
+            ordered: bool = False,
+            return_indices: bool = False,
+            *,
+            rngs: Optional[nnx.Rngs] = None,
+    ):
+        assert 0.0 <= prob < 1.0
+        self.prob = prob
+        self.num_prefix_tokens = num_prefix_tokens
+        self.ordered = ordered
+        self.return_indices = return_indices
+        self.deterministic = False
+        self.rngs = rngs.fork() if rngs is not None and prob > 0.0 else None
+
+    def __call__(self, x):
+        if self.deterministic or self.prob == 0.0 or self.rngs is None:
+            return (x, None) if self.return_indices else x
+
+        if self.num_prefix_tokens:
+            prefix, x = x[:, :self.num_prefix_tokens], x[:, self.num_prefix_tokens:]
+        else:
+            prefix = None
+
+        B, L = x.shape[:2]
+        num_keep = max(1, int(L * (1.0 - self.prob)))
+        rand = jax.random.uniform(self.rngs.dropout(), (B, L))
+        keep_indices = jnp.argsort(rand, axis=-1)[:, :num_keep]
+        if self.ordered:
+            keep_indices = jnp.sort(keep_indices, axis=-1)
+        x = jnp.take_along_axis(x, keep_indices[..., None], axis=1)
+
+        if prefix is not None:
+            x = jnp.concatenate([prefix, x], axis=1)
+        return (x, keep_indices) if self.return_indices else x

@@ -1,0 +1,75 @@
+"""Global pooling for token (NLC) and spatial (NHWC) features
+(reference: timm/layers/pool1d.py, adaptive_avgmax_pool.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import nnx
+
+__all__ = ['global_pool_nlc', 'SelectAdaptivePool2d', 'adaptive_pool_feat_mult']
+
+
+def global_pool_nlc(
+        x,
+        pool_type: str = 'token',
+        num_prefix_tokens: int = 1,
+        reduce_include_prefix: bool = False,
+):
+    """Pool (B, N, C) tokens → (B, C). Mirrors reference pool1d.py:global_pool_nlc."""
+    if not pool_type:
+        return x
+    if pool_type == 'token':
+        return x[:, 0]
+    if not reduce_include_prefix:
+        x = x[:, num_prefix_tokens:]
+    if pool_type == 'avg':
+        return x.mean(axis=1)
+    if pool_type == 'max':
+        return x.max(axis=1)
+    if pool_type == 'avgmax':
+        return 0.5 * (x.max(axis=1) + x.mean(axis=1))
+    raise ValueError(f'Unknown pool type {pool_type}')
+
+
+def adaptive_pool_feat_mult(pool_type: str = 'avg') -> int:
+    return 2 if pool_type.endswith('catavgmax') else 1
+
+
+class SelectAdaptivePool2d(nnx.Module):
+    """Global pooling over NHWC spatial dims with selectable mode.
+
+    The reference's 'fast' NHWC variants (adaptive_avgmax_pool.py) are the
+    *only* variants here — NHWC reductions are native on TPU.
+    """
+
+    def __init__(self, output_size=1, pool_type: str = 'avg', flatten: bool = False, input_fmt: str = 'NHWC'):
+        assert input_fmt in ('NHWC', 'NCHW')
+        self.pool_type = pool_type or ''
+        self.flatten = flatten
+
+    def is_identity(self) -> bool:
+        return not self.pool_type
+
+    def feat_mult(self) -> int:
+        return adaptive_pool_feat_mult(self.pool_type)
+
+    def __call__(self, x):
+        # x: (B, H, W, C)
+        if not self.pool_type:
+            return x
+        pt = self.pool_type
+        if pt.startswith('fast'):
+            pt = pt[4:].lstrip('_') or 'avg'
+        if pt == 'avg':
+            out = x.mean(axis=(1, 2))
+        elif pt == 'max':
+            out = x.max(axis=(1, 2))
+        elif pt == 'avgmax':
+            out = 0.5 * (x.mean(axis=(1, 2)) + x.max(axis=(1, 2)))
+        elif pt == 'catavgmax':
+            out = jnp.concatenate([x.mean(axis=(1, 2)), x.max(axis=(1, 2))], axis=-1)
+        else:
+            raise ValueError(f'Invalid pool type: {self.pool_type}')
+        return out  # already flat (B, C[*2])

@@ -1,0 +1,64 @@
+"""Absolute position-embedding helpers (reference: timm/layers/pos_embed.py)."""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['resample_abs_pos_embed', 'resample_abs_pos_embed_nhwc']
+
+
+def resample_abs_pos_embed(
+        posemb,
+        new_size: Tuple[int, int],
+        old_size: Optional[Tuple[int, int]] = None,
+        num_prefix_tokens: int = 1,
+        interpolation: str = 'cubic',
+        antialias: bool = True,
+):
+    """Resize a (1, N, C) learned pos embed to a new token grid.
+
+    Mirrors reference pos_embed.py:resample_abs_pos_embed — prefix (cls/reg)
+    tokens are carried through untouched.
+    """
+    num_pos_tokens = posemb.shape[1]
+    num_new_tokens = new_size[0] * new_size[1] + num_prefix_tokens
+    # same token count is only a no-op for square grids (ref pos_embed.py:31)
+    if num_new_tokens == num_pos_tokens and new_size[0] == new_size[1]:
+        return posemb
+
+    if old_size is None:
+        hw = int(math.sqrt(num_pos_tokens - num_prefix_tokens))
+        old_size = (hw, hw)
+
+    if num_prefix_tokens:
+        posemb_prefix, posemb = posemb[:, :num_prefix_tokens], posemb[:, num_prefix_tokens:]
+    else:
+        posemb_prefix = None
+
+    embed_dim = posemb.shape[-1]
+    orig_dtype = posemb.dtype
+    posemb = posemb.astype(jnp.float32).reshape(1, old_size[0], old_size[1], embed_dim)
+    posemb = jax.image.resize(
+        posemb, (1, new_size[0], new_size[1], embed_dim), method=interpolation, antialias=antialias,
+    )
+    posemb = posemb.reshape(1, -1, embed_dim).astype(orig_dtype)
+
+    if posemb_prefix is not None:
+        posemb = jnp.concatenate([posemb_prefix, posemb], axis=1)
+    return posemb
+
+
+def resample_abs_pos_embed_nhwc(posemb, new_size, interpolation: str = 'cubic', antialias: bool = True):
+    """Resize a (1, H, W, C) pos embed grid."""
+    if tuple(posemb.shape[1:3]) == tuple(new_size):
+        return posemb
+    orig_dtype = posemb.dtype
+    posemb = jax.image.resize(
+        posemb.astype(jnp.float32),
+        (posemb.shape[0], new_size[0], new_size[1], posemb.shape[-1]),
+        method=interpolation, antialias=antialias,
+    )
+    return posemb.astype(orig_dtype)

@@ -1,0 +1,171 @@
+"""Sin-cos / Fourier / rotary position embeddings
+(reference: timm/layers/pos_embed_sincos.py:1-1357).
+
+Everything here is pure-functional and shape-static: tables are built at trace
+time from python ints, so they constant-fold under jit.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+from flax import nnx
+
+__all__ = [
+    'build_sincos2d_pos_embed', 'build_fourier_pos_embed', 'build_rotary_pos_embed',
+    'RotaryEmbeddingCat', 'freq_bands', 'pixel_freq_bands',
+]
+
+
+def freq_bands(num_bands: int, temperature: float = 10000.0, step: int = 2) -> jnp.ndarray:
+    exp = jnp.arange(0, num_bands, step, dtype=jnp.float32) / num_bands
+    return 1.0 / (temperature ** exp)
+
+
+def pixel_freq_bands(num_bands: int, max_freq: float = 224.0, linear_bands: bool = True) -> jnp.ndarray:
+    if linear_bands:
+        bands = jnp.linspace(1.0, max_freq / 2, num_bands, dtype=jnp.float32)
+    else:
+        bands = 2.0 ** jnp.linspace(0, math.log2(max_freq / 2), num_bands, dtype=jnp.float32)
+    return bands * jnp.pi
+
+
+def build_sincos2d_pos_embed(
+        feat_shape: Tuple[int, int],
+        dim: int = 64,
+        temperature: float = 10000.0,
+        reverse_coord: bool = False,
+        interleave_sin_cos: bool = False,
+        dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Fixed 2D sin-cos position embedding, (H*W, dim)."""
+    assert dim % 4 == 0, 'Embed dim must be divisible by 4 for sin-cos 2d pos embed'
+    h, w = feat_shape
+    grid_y, grid_x = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32), jnp.arange(w, dtype=jnp.float32), indexing='ij')
+    if reverse_coord:
+        grid_y, grid_x = grid_x, grid_y
+    pos_dim = dim // 4
+    omega = freq_bands(pos_dim * 2, temperature=temperature, step=2)
+    out_x = grid_x.reshape(-1, 1) * omega[None, :]
+    out_y = grid_y.reshape(-1, 1) * omega[None, :]
+    if interleave_sin_cos:
+        emb = jnp.stack([jnp.sin(out_x), jnp.cos(out_x), jnp.sin(out_y), jnp.cos(out_y)], axis=-1).reshape(h * w, -1)
+    else:
+        emb = jnp.concatenate([jnp.sin(out_x), jnp.cos(out_x), jnp.sin(out_y), jnp.cos(out_y)], axis=1)
+    return emb.astype(dtype)
+
+
+def build_fourier_pos_embed(
+        feat_shape: Tuple[int, ...],
+        bands: Optional[jnp.ndarray] = None,
+        num_bands: int = 64,
+        max_res: int = 224,
+        temperature: float = 10000.0,
+        linear_bands: bool = False,
+        include_grid: bool = False,
+        in_pixels: bool = True,
+        ref_feat_shape: Optional[Tuple[int, ...]] = None,
+        grid_offset: float = 0.0,
+        grid_indexing: str = 'ij',
+        dtype=jnp.float32,
+) -> List[jnp.ndarray]:
+    if bands is None:
+        if in_pixels:
+            bands = pixel_freq_bands(num_bands, float(max_res), linear_bands=linear_bands)
+        else:
+            bands = freq_bands(num_bands, temperature=temperature, step=1)
+    if in_pixels:
+        t = [jnp.linspace(-1.0, 1.0, s, dtype=jnp.float32) for s in feat_shape]
+    else:
+        t = [jnp.arange(s, dtype=jnp.float32) + grid_offset for s in feat_shape]
+        if ref_feat_shape is not None:
+            t = [x / s * r for x, s, r in zip(t, feat_shape, ref_feat_shape)]
+    grid = jnp.stack(jnp.meshgrid(*t, indexing=grid_indexing), axis=-1)
+    grid = grid[..., None]
+    pos = grid * bands
+    pos_sin, pos_cos = jnp.sin(pos).astype(dtype), jnp.cos(pos).astype(dtype)
+    out = [grid, pos_sin, pos_cos] if include_grid else [pos_sin, pos_cos]
+    return out
+
+
+def build_rotary_pos_embed(
+        feat_shape: Tuple[int, ...],
+        bands: Optional[jnp.ndarray] = None,
+        dim: int = 64,
+        max_res: int = 224,
+        temperature: float = 10000.0,
+        linear_bands: bool = False,
+        in_pixels: bool = True,
+        ref_feat_shape: Optional[Tuple[int, ...]] = None,
+        grid_offset: float = 0.0,
+        grid_indexing: str = 'ij',
+        dtype=jnp.float32,
+):
+    """Returns (sin_emb, cos_emb), each (num_tokens, dim) for 2D rotary."""
+    sin_emb, cos_emb = build_fourier_pos_embed(
+        feat_shape,
+        bands=bands,
+        num_bands=dim // 4,
+        max_res=max_res,
+        temperature=temperature,
+        linear_bands=linear_bands,
+        in_pixels=in_pixels,
+        ref_feat_shape=ref_feat_shape,
+        grid_offset=grid_offset,
+        grid_indexing=grid_indexing,
+        dtype=dtype,
+    )
+    num_spatial_dim = 1
+    for x in feat_shape:
+        num_spatial_dim *= x
+    sin_emb = sin_emb.reshape(num_spatial_dim, -1)
+    sin_emb = jnp.repeat(sin_emb, 2, axis=-1)
+    cos_emb = cos_emb.reshape(num_spatial_dim, -1)
+    cos_emb = jnp.repeat(cos_emb, 2, axis=-1)
+    return sin_emb, cos_emb
+
+
+class RotaryEmbeddingCat(nnx.Module):
+    """2D ROPE producing a concatenated (sin, cos) table
+    (reference pos_embed_sincos.py RotaryEmbeddingCat)."""
+
+    def __init__(
+            self,
+            dim: int,
+            max_res: int = 224,
+            temperature: float = 10000.0,
+            in_pixels: bool = True,
+            linear_bands: bool = False,
+            feat_shape: Optional[Tuple[int, int]] = None,
+            ref_feat_shape: Optional[Tuple[int, int]] = None,
+            grid_offset: float = 0.0,
+            grid_indexing: str = 'ij',
+            *,
+            rngs: nnx.Rngs = None,
+    ):
+        self.dim = dim
+        self.max_res = max_res
+        self.temperature = temperature
+        self.in_pixels = in_pixels
+        self.linear_bands = linear_bands
+        self.feat_shape = feat_shape
+        self.ref_feat_shape = ref_feat_shape
+        self.grid_offset = grid_offset
+        self.grid_indexing = grid_indexing
+
+    def get_embed(self, shape: Optional[Tuple[int, int]] = None):
+        shape = shape if shape is not None else self.feat_shape
+        assert shape is not None
+        sin_emb, cos_emb = build_rotary_pos_embed(
+            shape,
+            dim=self.dim,
+            max_res=self.max_res,
+            temperature=self.temperature,
+            linear_bands=self.linear_bands,
+            in_pixels=self.in_pixels,
+            ref_feat_shape=self.ref_feat_shape,
+            grid_offset=self.grid_offset,
+            grid_indexing=self.grid_indexing,
+        )
+        return jnp.concatenate([sin_emb, cos_emb], axis=-1)

@@ -1,0 +1,89 @@
+"""Squeeze-and-Excitation modules (reference: timm/layers/squeeze_excite.py)."""
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_act import get_act_fn
+from .helpers import make_divisible
+from .weight_init import variance_scaling_, zeros_
+
+__all__ = ['SEModule', 'EffectiveSEModule', 'SqueezeExcite']
+
+
+class SEModule(nnx.Module):
+    """SE over NHWC features: squeeze (mean HW) → fc → act → fc → gate."""
+
+    def __init__(
+            self,
+            channels: int,
+            rd_ratio: float = 1. / 16,
+            rd_channels: Optional[int] = None,
+            rd_divisor: int = 8,
+            add_maxpool: bool = False,
+            bias: bool = True,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer=None,
+            gate_layer: Union[str, Callable] = 'sigmoid',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        if not rd_channels:
+            rd_channels = make_divisible(channels * rd_ratio, rd_divisor, round_limit=0.0)
+        self.add_maxpool = add_maxpool
+        conv = lambda ci, co: nnx.Linear(
+            ci, co, use_bias=bias, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=variance_scaling_(2.0, 'fan_out', 'normal'), bias_init=zeros_, rngs=rngs,
+        )
+        self.fc1 = conv(channels, rd_channels)
+        self.bn = norm_layer(rd_channels, rngs=rngs) if norm_layer is not None else None
+        self.act = get_act_fn(act_layer)
+        self.fc2 = conv(rd_channels, channels)
+        self.gate = get_act_fn(gate_layer)
+
+    def __call__(self, x):
+        # x: (B, H, W, C)
+        x_se = x.mean(axis=(1, 2), keepdims=True)
+        if self.add_maxpool:
+            x_se = 0.5 * (x_se + x.max(axis=(1, 2), keepdims=True))
+        x_se = self.fc1(x_se)
+        if self.bn is not None:
+            x_se = self.bn(x_se)
+        x_se = self.act(x_se)
+        x_se = self.fc2(x_se)
+        return x * self.gate(x_se)
+
+
+SqueezeExcite = SEModule
+
+
+class EffectiveSEModule(nnx.Module):
+    """'Effective' SE — single fc, hard-sigmoid gate (reference squeeze_excite.py:~90)."""
+
+    def __init__(
+            self,
+            channels: int,
+            add_maxpool: bool = False,
+            gate_layer: Union[str, Callable] = 'hard_sigmoid',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.add_maxpool = add_maxpool
+        self.fc = nnx.Linear(
+            channels, channels, use_bias=True, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=variance_scaling_(2.0, 'fan_out', 'normal'), bias_init=zeros_, rngs=rngs,
+        )
+        self.gate = get_act_fn(gate_layer)
+
+    def __call__(self, x):
+        x_se = x.mean(axis=(1, 2), keepdims=True)
+        if self.add_maxpool:
+            x_se = 0.5 * (x_se + x.max(axis=(1, 2), keepdims=True))
+        x_se = self.fc(x_se)
+        return x * self.gate(x_se)

@@ -1,0 +1,65 @@
+"""Weight initializers (reference: timm/layers/weight_init.py:1-178).
+
+Exposed as `jax.nn.initializers`-style callables usable as `kernel_init=` in
+nnx modules. JAX's truncated_normal truncates at +/-2 sigma (the reference's
+`trunc_normal_tf_` behaviour); for the tiny std values used by ViTs (0.02)
+this is numerically indistinguishable from the reference's `trunc_normal_`.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.nn import initializers as jinit
+
+__all__ = [
+    'trunc_normal_', 'trunc_normal_tf_', 'variance_scaling_', 'lecun_normal_',
+    'init_weight_vit', 'zeros_', 'ones_', 'normal_',
+]
+
+
+def trunc_normal_(std: float = 1.0, mean: float = 0.0):
+    base = jinit.truncated_normal(stddev=std)
+    if mean == 0.0:
+        return base
+
+    def init(key, shape, dtype=jnp.float32):
+        return base(key, shape, dtype) + mean
+    return init
+
+
+# identical under JAX (see module docstring)
+trunc_normal_tf_ = trunc_normal_
+
+
+def variance_scaling_(scale: float = 1.0, mode: str = 'fan_in', distribution: str = 'normal'):
+    if distribution == 'normal':
+        distribution = 'truncated_normal'
+    return jinit.variance_scaling(scale, mode, distribution)
+
+
+def lecun_normal_():
+    return jinit.variance_scaling(1.0, 'fan_in', 'truncated_normal')
+
+
+def zeros_(key, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones_(key, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def normal_(std: float = 1.0):
+    return jinit.normal(stddev=std)
+
+
+def init_weight_vit(std: float = 0.02):
+    """Default ViT linear/conv kernel init (trunc normal, std .02)."""
+    return trunc_normal_(std=std)
+
+
+def head_init_scaled(hidden_size: int):
+    """`head_init_scale`-style zero-ish init used by some heads."""
+    return jinit.truncated_normal(stddev=1.0 / math.sqrt(hidden_size))

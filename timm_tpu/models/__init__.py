@@ -1,0 +1,16 @@
+from ._builder import build_model_with_cfg, load_pretrained, resolve_pretrained_cfg
+from ._factory import create_model, parse_model_name, safe_model_name
+from ._features import FeatureGetterNet, FeatureInfo, feature_take_indices
+from ._helpers import (
+    clean_state_dict, load_checkpoint, load_state_dict, load_state_dict_into_model,
+    model_state_dict, remap_state_dict, save_state_dict,
+)
+from ._manipulate import checkpoint_seq, group_parameters, group_with_matcher, named_parameters
+from ._pretrained import DefaultCfg, PretrainedCfg
+from ._registry import (
+    generate_default_cfgs, get_arch_name, get_pretrained_cfg, get_pretrained_cfg_value,
+    is_model, is_model_in_modules, is_model_pretrained, list_models, list_modules,
+    list_pretrained, model_entrypoint, register_model, split_model_name_tag,
+)
+
+from .vision_transformer import VisionTransformer

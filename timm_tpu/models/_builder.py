@@ -1,0 +1,244 @@
+"""Model build + pretrained-load orchestration
+(reference: timm/models/_builder.py:43-503).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from copy import deepcopy
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from ._helpers import clean_state_dict, load_state_dict, load_state_dict_into_model
+from ._pretrained import PretrainedCfg
+from ._registry import get_pretrained_cfg, split_model_name_tag
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ['build_model_with_cfg', 'resolve_pretrained_cfg', 'load_pretrained', 'adapt_input_conv']
+
+
+def adapt_input_conv(in_chans: int, conv_weight: np.ndarray) -> np.ndarray:
+    """Adapt a first-conv HWIO kernel to a different input channel count
+    (reference _builder.py:245-259, _manipulate.py:289)."""
+    conv_weight = np.asarray(conv_weight, dtype=np.float32)
+    KH, KW, I, O = conv_weight.shape
+    if in_chans == I:
+        return conv_weight
+    if in_chans == 1:
+        return conv_weight.sum(axis=2, keepdims=True)
+    if I != 3:
+        raise NotImplementedError('Weight format not supported by conversion.')
+    repeat = -(-in_chans // I)
+    w = np.tile(conv_weight, (1, 1, repeat, 1))[:, :, :in_chans]
+    w *= (3 / float(in_chans))
+    return w
+
+
+def _resolve_pretrained_source(pretrained_cfg: PretrainedCfg):
+    cfg_source = pretrained_cfg.source or ''
+    if pretrained_cfg.state_dict is not None:
+        return 'state_dict', pretrained_cfg.state_dict
+    if pretrained_cfg.file:
+        return 'file', pretrained_cfg.file
+    if pretrained_cfg.url:
+        return 'url', pretrained_cfg.url
+    if pretrained_cfg.hf_hub_id:
+        return 'hf-hub', pretrained_cfg.hf_hub_id
+    return '', None
+
+
+def resolve_pretrained_cfg(
+        variant: str,
+        pretrained_cfg=None,
+        pretrained_cfg_overlay=None,
+) -> PretrainedCfg:
+    model_with_tag = variant
+    pretrained_tag = None
+    if pretrained_cfg:
+        if isinstance(pretrained_cfg, dict):
+            pretrained_cfg = PretrainedCfg(**pretrained_cfg)
+        elif isinstance(pretrained_cfg, str):
+            pretrained_tag = pretrained_cfg
+            pretrained_cfg = None
+    if not pretrained_cfg:
+        if pretrained_tag:
+            model_with_tag = '.'.join([variant, pretrained_tag])
+        pretrained_cfg = get_pretrained_cfg(model_with_tag)
+    if not pretrained_cfg:
+        _logger.info(
+            f'No pretrained configuration specified for {model_with_tag}. '
+            f'Using a default; accuracy/input-size metadata may be incorrect.')
+        pretrained_cfg = PretrainedCfg()
+    pretrained_cfg_overlay = pretrained_cfg_overlay or {}
+    if not pretrained_cfg.architecture:
+        pretrained_cfg_overlay.setdefault('architecture', variant)
+    pretrained_cfg = dataclasses.replace(pretrained_cfg, **pretrained_cfg_overlay)
+    return pretrained_cfg
+
+
+def load_pretrained(
+        model: nnx.Module,
+        pretrained_cfg: Optional[PretrainedCfg] = None,
+        num_classes: int = 1000,
+        in_chans: int = 3,
+        filter_fn: Optional[Callable] = None,
+        strict: bool = True,
+):
+    """Load pretrained weights, adapting stem/classifier (reference _builder.py:152-281)."""
+    pretrained_cfg = pretrained_cfg or getattr(model, 'pretrained_cfg', None)
+    if not pretrained_cfg:
+        raise RuntimeError('Invalid pretrained config, cannot load weights.')
+    load_from, pretrained_loc = _resolve_pretrained_source(pretrained_cfg)
+    if load_from == 'state_dict':
+        state_dict = dict(pretrained_loc)
+    elif load_from == 'file':
+        state_dict = load_state_dict(pretrained_loc)
+    elif load_from in ('url', 'hf-hub'):
+        raise RuntimeError(
+            f'Pretrained weights for this model resolve to a remote source ({load_from}: {pretrained_loc}). '
+            'This environment has no network egress — download the file and pass '
+            "pretrained_cfg_overlay=dict(file='/path/to/weights.safetensors').")
+    else:
+        raise RuntimeError('No pretrained weights exist for this model. Use `pretrained=False`.')
+
+    if filter_fn is not None:
+        try:
+            state_dict = filter_fn(state_dict, model)
+        except TypeError:
+            state_dict = filter_fn(state_dict)
+
+    input_convs = pretrained_cfg.first_conv
+    if input_convs is not None and in_chans != 3:
+        if isinstance(input_convs, str):
+            input_convs = (input_convs,)
+        for input_conv_name in input_convs:
+            weight_name = input_conv_name + '.kernel'
+            if weight_name in state_dict:
+                try:
+                    state_dict[weight_name] = adapt_input_conv(in_chans, state_dict[weight_name])
+                    _logger.info(f'Converted input conv {input_conv_name} to {in_chans} chans')
+                except NotImplementedError:
+                    del state_dict[weight_name]
+                    strict = False
+                    _logger.warning(f'Unable to convert input conv {input_conv_name}; random init used.')
+
+    classifiers = pretrained_cfg.classifier
+    label_offset = pretrained_cfg.label_offset or 0
+    if classifiers is not None:
+        if isinstance(classifiers, str):
+            classifiers = (classifiers,)
+        if num_classes != pretrained_cfg.num_classes:
+            for classifier_name in classifiers:
+                state_dict.pop(classifier_name + '.kernel', None)
+                state_dict.pop(classifier_name + '.bias', None)
+            strict = False
+        elif label_offset > 0:
+            for classifier_name in classifiers:
+                kname = classifier_name + '.kernel'
+                bname = classifier_name + '.bias'
+                if kname in state_dict:
+                    state_dict[kname] = state_dict[kname][..., label_offset:]
+                if bname in state_dict:
+                    state_dict[bname] = state_dict[bname][label_offset:]
+
+    load_state_dict_into_model(model, state_dict, strict=strict)
+
+
+def _filter_kwargs(kwargs: Dict, names):
+    if not kwargs or not names:
+        return
+    for n in names:
+        kwargs.pop(n, None)
+
+
+def _update_default_model_kwargs(pretrained_cfg: PretrainedCfg, kwargs: Dict, kwargs_filter):
+    """Push cfg defaults into model kwargs (reference _builder.py:307-345)."""
+    default_kwarg_names = ('num_classes', 'global_pool', 'in_chans')
+    if pretrained_cfg.fixed_input_size:
+        default_kwarg_names += ('img_size',)
+    for n in default_kwarg_names:
+        if n == 'img_size':
+            input_size = pretrained_cfg.input_size
+            if input_size is not None:
+                assert len(input_size) == 3
+                kwargs.setdefault(n, input_size[-2:])
+        elif n == 'in_chans':
+            input_size = pretrained_cfg.input_size
+            if input_size is not None:
+                assert len(input_size) == 3
+                kwargs.setdefault(n, input_size[0])
+        elif n == 'num_classes':
+            v = pretrained_cfg.num_classes
+            if v is not None:
+                kwargs.setdefault(n, v)
+        else:
+            v = getattr(pretrained_cfg, n, None)
+            if v is not None:
+                kwargs.setdefault(n, v)
+    _filter_kwargs(kwargs, names=kwargs_filter)
+
+
+def build_model_with_cfg(
+        model_cls: Callable,
+        variant: str,
+        pretrained: bool,
+        pretrained_cfg: Optional[Dict] = None,
+        pretrained_cfg_overlay: Optional[Dict] = None,
+        model_cfg: Optional[Any] = None,
+        feature_cfg: Optional[Dict] = None,
+        pretrained_strict: bool = True,
+        pretrained_filter_fn: Optional[Callable] = None,
+        kwargs_filter=None,
+        **kwargs,
+):
+    """Instantiate a model from an entrypoint + cfg (reference _builder.py:384-503)."""
+    pruned = kwargs.pop('pruned', False)
+    features = False
+    feature_cfg = feature_cfg or {}
+
+    pretrained_cfg = resolve_pretrained_cfg(
+        variant, pretrained_cfg=pretrained_cfg, pretrained_cfg_overlay=pretrained_cfg_overlay)
+    pretrained_cfg_dict = pretrained_cfg.to_dict()
+    _update_default_model_kwargs(pretrained_cfg, kwargs, kwargs_filter)
+
+    if kwargs.pop('features_only', False):
+        features = True
+        feature_cfg.setdefault('out_indices', (0, 1, 2, 3, 4))
+        if 'out_indices' in kwargs:
+            feature_cfg['out_indices'] = kwargs.pop('out_indices')
+        if 'feature_cls' in kwargs:
+            feature_cfg['feature_cls'] = kwargs.pop('feature_cls')
+
+    rngs = kwargs.pop('rngs', None)
+    if rngs is None:
+        seed = kwargs.pop('seed', 0)
+        rngs = nnx.Rngs(params=seed, dropout=seed + 1)
+    else:
+        kwargs.pop('seed', None)
+
+    if model_cfg is None:
+        model = model_cls(rngs=rngs, **kwargs)
+    else:
+        model = model_cls(cfg=model_cfg, rngs=rngs, **kwargs)
+    model.pretrained_cfg = pretrained_cfg
+    model.default_cfg = pretrained_cfg_dict  # backwards-compat alias
+
+    if pretrained:
+        load_pretrained(
+            model,
+            pretrained_cfg=pretrained_cfg,
+            num_classes=kwargs.get('num_classes', 1000),
+            in_chans=kwargs.get('in_chans', 3),
+            filter_fn=pretrained_filter_fn,
+            strict=pretrained_strict,
+        )
+
+    if features:
+        from ._features import FeatureGetterNet
+        model = FeatureGetterNet(model, **feature_cfg)
+    return model

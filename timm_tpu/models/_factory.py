@@ -1,0 +1,89 @@
+"""`create_model` public entry (reference: timm/models/_factory.py:18-149)."""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Union
+from urllib.parse import urlsplit
+
+from ._helpers import load_checkpoint
+from ._pretrained import PretrainedCfg
+from ._registry import is_model, model_entrypoint, split_model_name_tag
+
+__all__ = ['create_model', 'parse_model_name', 'safe_model_name']
+
+
+def parse_model_name(model_name: str):
+    if model_name.startswith('hf_hub'):
+        model_name = model_name.replace('hf_hub', 'hf-hub')
+    parsed = urlsplit(model_name)
+    assert parsed.scheme in ('', 'timm', 'hf-hub', 'local-dir')
+    if parsed.scheme == 'hf-hub':
+        return parsed.scheme, os.path.join(parsed.netloc, parsed.path.lstrip('/')).rstrip('/')
+    if parsed.scheme == 'local-dir':
+        return parsed.scheme, os.path.join(parsed.netloc, parsed.path.lstrip('/')).rstrip('/')
+    return 'timm', os.path.split(parsed.path)[-1]
+
+
+def safe_model_name(model_name: str, remove_source: bool = True) -> str:
+    def make_safe(name):
+        return ''.join(c if c.isalnum() else '_' for c in name).rstrip('_')
+    if remove_source:
+        model_name = parse_model_name(model_name)[-1]
+    return make_safe(model_name)
+
+
+def create_model(
+        model_name: str,
+        pretrained: bool = False,
+        pretrained_cfg: Optional[Union[str, Dict[str, Any], PretrainedCfg]] = None,
+        pretrained_cfg_overlay: Optional[Dict[str, Any]] = None,
+        checkpoint_path: str = '',
+        cache_dir: Optional[str] = None,
+        scriptable: Optional[bool] = None,
+        exportable: Optional[bool] = None,
+        no_jit: Optional[bool] = None,
+        **kwargs,
+):
+    """Create a model by registry name, mirroring the reference contract.
+
+    `hf-hub:`/`local-dir:` schemes resolve to a config + weights directory;
+    in this environment only local dirs are reachable.
+    """
+    kwargs = {k: v for k, v in kwargs.items() if v is not None}
+
+    model_source, model_name = parse_model_name(model_name)
+    if model_source == 'hf-hub':
+        raise RuntimeError(
+            'hf-hub model sources require network egress; download the repo and use local-dir: instead.')
+    if model_source == 'local-dir':
+        import json
+        cfg_path = os.path.join(model_name, 'config.json')
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        arch = cfg.get('architecture')
+        pretrained_cfg = cfg.get('pretrained_cfg', cfg)
+        for fname in ('model.safetensors', 'model.npz'):
+            fpath = os.path.join(model_name, fname)
+            if os.path.exists(fpath):
+                pretrained_cfg = dict(pretrained_cfg, file=fpath)
+                break
+        model_name = arch
+    else:
+        model_name, pretrained_tag = split_model_name_tag(model_name)
+        if pretrained_tag and not pretrained_cfg:
+            pretrained_cfg = pretrained_tag
+
+    if not is_model(model_name):
+        raise RuntimeError(f'Unknown model ({model_name})')
+
+    create_fn = model_entrypoint(model_name)
+    model = create_fn(
+        pretrained=pretrained,
+        pretrained_cfg=pretrained_cfg,
+        pretrained_cfg_overlay=pretrained_cfg_overlay,
+        **kwargs,
+    )
+
+    if checkpoint_path:
+        load_checkpoint(model, checkpoint_path)
+    return model

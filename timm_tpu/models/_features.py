@@ -1,0 +1,132 @@
+"""Multi-scale feature extraction (reference: timm/models/_features.py).
+
+Functional JAX has no forward hooks; the primary mechanism is the model's
+`forward_intermediates()` method (reference `FeatureGetterNet` style,
+_features.py:435-482). `features_only=True` wraps models in FeatureGetterNet.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from flax import nnx
+
+__all__ = ['FeatureInfo', 'FeatureGetterNet', 'feature_take_indices']
+
+
+def feature_take_indices(
+        num_features: int,
+        indices: Optional[Union[int, List[int], Tuple[int, ...]]] = None,
+        as_set: bool = False,
+):
+    """Resolve relative/negative indices → absolute (reference _features.py:28)."""
+    if indices is None:
+        indices = num_features
+    if isinstance(indices, int):
+        # from the end
+        take_indices = [num_features - indices + i for i in range(indices)]
+    else:
+        take_indices = [num_features + i if i < 0 else i for i in indices]
+    for i in take_indices:
+        assert 0 <= i < num_features, f'feature index {i} out of range [0, {num_features})'
+    max_index = max(take_indices)
+    return (set(take_indices) if as_set else take_indices), max_index
+
+
+class FeatureInfo:
+    def __init__(self, feature_info: List[Dict], out_indices: Tuple[int, ...]):
+        prev_reduction = 1
+        for i, fi in enumerate(feature_info):
+            assert 'num_chs' in fi and fi['num_chs'] > 0
+            assert 'reduction' in fi and fi['reduction'] >= prev_reduction
+            prev_reduction = fi['reduction']
+            fi.setdefault('module', f'layer_{i}')
+            fi.setdefault('index', i)
+        self.out_indices = out_indices
+        self.info = feature_info
+
+    def from_other(self, out_indices: Tuple[int, ...]):
+        import copy
+        return FeatureInfo(copy.deepcopy(self.info), out_indices)
+
+    def get(self, key: str, idx: Optional[Union[int, tuple]] = None):
+        if idx is None:
+            return [self.info[i][key] for i in self.out_indices]
+        if isinstance(idx, (tuple, list)):
+            return [self.info[i][key] for i in idx]
+        return self.info[idx][key]
+
+    def get_dicts(self, keys=None, idx=None):
+        if idx is None:
+            idx = self.out_indices
+        if isinstance(idx, int):
+            idx = [idx]
+        if keys is None:
+            return [self.info[i] for i in idx]
+        return [{k: self.info[i][k] for k in keys} for i in idx]
+
+    def channels(self, idx=None):
+        return self.get('num_chs', idx)
+
+    def reduction(self, idx=None):
+        return self.get('reduction', idx)
+
+    def module_name(self, idx=None):
+        return self.get('module', idx)
+
+    def __getitem__(self, item):
+        return self.info[item]
+
+    def __len__(self):
+        return len(self.info)
+
+
+class FeatureGetterNet(nnx.Module):
+    """`features_only` wrapper driving model.forward_intermediates
+    (reference _features.py:435)."""
+
+    def __init__(
+            self,
+            model: nnx.Module,
+            out_indices=4,
+            out_map=None,
+            return_dict: bool = False,
+            output_fmt: str = 'NHWC',
+            norm: bool = False,
+            prune: bool = True,
+            **kwargs,
+    ):
+        if prune and hasattr(model, 'prune_intermediate_layers'):
+            out_indices = model.prune_intermediate_layers(out_indices, prune_norm=not norm)
+        self.feature_info = _build_feature_info(model, out_indices)
+        self.model = model
+        self.out_indices = out_indices
+        self.out_map = out_map
+        self.return_dict = return_dict
+        self.output_fmt = output_fmt
+        self.norm = norm
+
+    def __call__(self, x):
+        features = self.model.forward_intermediates(
+            x,
+            indices=self.out_indices,
+            norm=self.norm,
+            output_fmt=self.output_fmt,
+            intermediates_only=True,
+        )
+        if self.return_dict:
+            names = self.out_map or [f'layer_{i}' for i in range(len(features))]
+            return dict(zip(names, features))
+        return features
+
+
+def _build_feature_info(model, out_indices):
+    raw = getattr(model, 'feature_info', None)
+    if raw is None:
+        return None
+    if isinstance(raw, FeatureInfo):
+        take, _ = feature_take_indices(len(raw), out_indices)
+        return raw.from_other(tuple(take))
+    import copy
+    info = copy.deepcopy(raw)
+    take, _ = feature_take_indices(len(info), out_indices)
+    return FeatureInfo(info, tuple(take))

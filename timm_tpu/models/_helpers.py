@@ -1,0 +1,162 @@
+"""Model state (de)serialization helpers
+(reference: timm/models/_helpers.py:1-261).
+
+State dicts are flat `{dotted.path: np.ndarray}` mappings; the on-disk format
+is safetensors (preferred) or .npz. Torch-checkpoint conversion lives in
+`_torch_convert.py`.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+_logger = logging.getLogger(__name__)
+
+__all__ = [
+    'clean_state_dict', 'model_state_dict', 'load_state_dict',
+    'load_state_dict_into_model', 'save_state_dict', 'load_checkpoint',
+    'remap_state_dict',
+]
+
+
+def clean_state_dict(state_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """Strip wrapper prefixes (reference _helpers.py:79)."""
+    cleaned = {}
+    for k, v in state_dict.items():
+        for prefix in ('module.', '_orig_mod.', 'model.'):
+            if k.startswith(prefix):
+                k = k[len(prefix):]
+        cleaned[k] = v
+    return cleaned
+
+
+def _path_str(path) -> str:
+    return '.'.join(str(getattr(p, 'key', p)) for p in path)
+
+
+def model_state_dict(model: nnx.Module, include_stats: bool = True) -> Dict[str, np.ndarray]:
+    """Flatten an nnx model's parameters (+ batch stats) to a flat dict."""
+    state = nnx.state(model)
+    out = {}
+    for path, leaf in nnx.to_flat_state(state):
+        value = leaf[...]
+        if value is None:
+            continue
+        if hasattr(value, 'dtype') and jnp.issubdtype(value.dtype, jnp.integer) and not include_stats:
+            continue
+        key = _path_str(path)
+        if 'rngs' in key:
+            continue  # rng stream state is not part of the weight contract
+        out[key] = np.asarray(value)
+    return out
+
+
+def load_state_dict_into_model(
+        model: nnx.Module,
+        state_dict: Dict[str, np.ndarray],
+        strict: bool = True,
+) -> nnx.Module:
+    """Merge a flat dict back into model variables in-place."""
+    state_dict = clean_state_dict(state_dict)
+    state = nnx.state(model)
+    flat = list(nnx.to_flat_state(state))
+    used = set()
+    missing = []
+    for path, leaf in flat:
+        key = _path_str(path)
+        if 'rngs' in key:
+            continue
+        if key in state_dict:
+            new_val = jnp.asarray(state_dict[key])
+            cur = leaf[...]
+            if cur is not None and tuple(new_val.shape) != tuple(cur.shape):
+                msg = f'Shape mismatch for {key}: ckpt {new_val.shape} vs model {cur.shape}'
+                if strict:
+                    raise ValueError(msg)
+                _logger.warning(msg)
+                continue
+            if cur is not None:
+                new_val = new_val.astype(cur.dtype)
+            leaf[...] = new_val
+            used.add(key)
+        else:
+            missing.append(key)
+    unexpected = [k for k in state_dict if k not in used]
+    if strict and (missing or unexpected):
+        raise ValueError(f'State dict mismatch. Missing: {missing[:8]}..., Unexpected: {unexpected[:8]}...')
+    if missing:
+        _logger.warning(f'Missing keys: {missing[:8]}{"..." if len(missing) > 8 else ""}')
+    if unexpected:
+        _logger.warning(f'Unexpected keys: {unexpected[:8]}{"..." if len(unexpected) > 8 else ""}')
+    nnx.update(model, state)
+    return model
+
+
+def save_state_dict(state_dict: Dict[str, np.ndarray], path: str):
+    path = str(path)
+    if path.endswith('.safetensors'):
+        from safetensors.numpy import save_file
+        save_file({k: np.ascontiguousarray(v) for k, v in state_dict.items()}, path)
+    else:
+        np.savez(path, **state_dict)
+
+
+def load_state_dict(checkpoint_path: str, use_ema: bool = True) -> Dict[str, np.ndarray]:
+    checkpoint_path = str(checkpoint_path)
+    if not os.path.exists(checkpoint_path):
+        raise FileNotFoundError(f'No checkpoint found at {checkpoint_path}')
+    if checkpoint_path.endswith('.safetensors'):
+        from safetensors.numpy import load_file
+        sd = load_file(checkpoint_path)
+    elif checkpoint_path.endswith(('.npz', '.npy')):
+        with np.load(checkpoint_path, allow_pickle=False) as data:
+            sd = {k: data[k] for k in data.files}
+    elif checkpoint_path.endswith(('.pth', '.pt', '.bin')):
+        from ._torch_convert import load_torch_state_dict
+        sd = load_torch_state_dict(checkpoint_path, use_ema=use_ema)
+    else:
+        raise ValueError(f'Unsupported checkpoint format: {checkpoint_path}')
+    # unwrap EMA/nested containers saved by our CheckpointSaver
+    ema_keys = [k for k in sd if k.startswith('state_dict_ema.')]
+    if use_ema and ema_keys:
+        sd = {k[len('state_dict_ema.'):]: v for k in ema_keys for v in [sd[k]]}
+    elif any(k.startswith('state_dict.') for k in sd):
+        sd = {k[len('state_dict.'):]: v for k, v in sd.items() if k.startswith('state_dict.')}
+    return clean_state_dict(sd)
+
+
+def load_checkpoint(
+        model: nnx.Module,
+        checkpoint_path: str,
+        use_ema: bool = True,
+        strict: bool = True,
+        remap: bool = False,
+        filter_fn: Optional[Callable] = None,
+):
+    state_dict = load_state_dict(checkpoint_path, use_ema=use_ema)
+    if remap:
+        state_dict = remap_state_dict(state_dict, model)
+    if filter_fn is not None:
+        state_dict = filter_fn(state_dict, model)
+    load_state_dict_into_model(model, state_dict, strict=strict)
+
+
+def remap_state_dict(state_dict: Dict[str, np.ndarray], model: nnx.Module, allow_reshape: bool = True):
+    """Remap by order when names differ but shapes align (reference _helpers.py:178)."""
+    target = model_state_dict(model)
+    out = {}
+    for (ka, va), (kb, vb) in zip(target.items(), state_dict.items()):
+        vb = np.asarray(vb)
+        if va.size != vb.size:
+            raise ValueError(f'Cannot remap {kb} ({vb.shape}) -> {ka} ({va.shape})')
+        if va.shape != vb.shape:
+            if not allow_reshape:
+                raise ValueError(f'Shape mismatch remap {kb} -> {ka}')
+            vb = vb.reshape(va.shape)
+        out[ka] = vb
+    return out

@@ -1,0 +1,120 @@
+"""Parameter grouping / model manipulation
+(reference: timm/models/_manipulate.py:29-346).
+
+Parameter "names" are the dotted flat-state paths produced by
+`model_state_dict`; `group_matcher` specs are the same regex-tuple structures
+the reference uses, matched against those names.
+"""
+from __future__ import annotations
+
+import re
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+MATCH_PREV_GROUP = (99999,)
+
+__all__ = ['group_parameters', 'group_with_matcher', 'named_parameters', 'checkpoint_seq']
+
+
+def named_parameters(model) -> Dict[str, Any]:
+    """Flat {dotted.name: array} of trainable params only."""
+    from flax import nnx
+    out = {}
+    state = nnx.state(model, nnx.Param)
+    for path, leaf in nnx.to_flat_state(state):
+        key = '.'.join(str(getattr(p, 'key', p)) for p in path)
+        if 'rngs' in key:
+            continue
+        out[key] = leaf[...]
+    return out
+
+
+def group_with_matcher(
+        named_objects,
+        group_matcher: Union[Dict, Callable],
+        return_values: bool = False,
+        reverse: bool = False,
+):
+    """(reference _manipulate.py:80-140)."""
+    if isinstance(group_matcher, dict):
+        compiled = []
+        for group_ordinal, (group_name, mspec) in enumerate(group_matcher.items()):
+            if mspec is None:
+                continue
+            if isinstance(mspec, (tuple, list)):
+                for sspec in mspec:
+                    compiled += [(group_ordinal, group_name, re.compile(sspec[0]), sspec[1])]
+            else:
+                compiled += [(group_ordinal, group_name, re.compile(mspec), None)]
+        group_matcher = compiled
+
+    def _get_grouping(name):
+        if isinstance(group_matcher, (list, tuple)):
+            for grp_ordinal, _, pattern, suffix in group_matcher:
+                r = pattern.match(name)
+                if r:
+                    parts = (grp_ordinal,) + r.groups()
+                    if suffix is not None:
+                        parts = parts + (suffix,)
+                    return tuple(map(float, filter(lambda x: x is not None, parts)))
+            return (float('inf'),)
+        ord_ = group_matcher(name)
+        if not isinstance(ord_, collections_abc_iterable()):
+            return (ord_,)
+        return tuple(ord_)
+
+    grouping = defaultdict(list)
+    for name, obj in named_objects:
+        grouping[_get_grouping(name)].append(obj if return_values else name)
+
+    # remap to integers, ordered
+    layer_id_to_param = defaultdict(list)
+    lid = -1
+    for k in sorted(filter(lambda x: x is not None, grouping.keys())):
+        if lid < 0 or k[-1] != MATCH_PREV_GROUP[0]:
+            lid += 1
+        layer_id_to_param[lid].extend(grouping[k])
+
+    if reverse:
+        assert not return_values, 'reverse mapping only supported for name output'
+        param_to_layer_id = {}
+        for lid_, names in layer_id_to_param.items():
+            for n in names:
+                param_to_layer_id[n] = lid_
+        return param_to_layer_id
+    return layer_id_to_param
+
+
+def collections_abc_iterable():
+    import collections.abc
+    return collections.abc.Iterable
+
+
+def group_parameters(model, group_matcher, return_values: bool = False, reverse: bool = False):
+    return group_with_matcher(
+        named_parameters(model).items(), group_matcher, return_values=return_values, reverse=reverse)
+
+
+def _run_modules(modules, x):
+    for m in modules:
+        x = m(x)
+    return x
+
+
+def checkpoint_seq(functions, x, every: int = 1, flatten: bool = False, skip_last: bool = False):
+    """Apply a sequence of nnx modules with rematerialisation every `every`
+    modules (reference _manipulate.py:213 checkpoint_seq). Trades recompute
+    for HBM — the TPU equivalent of torch activation checkpointing.
+    """
+    from flax import nnx
+    functions = list(functions)
+    end = len(functions) - 1 if skip_last else len(functions)
+    remat_run = nnx.remat(_run_modules)
+    idx = 0
+    while idx < end:
+        chunk = tuple(functions[idx:min(idx + every, end)])
+        x = remat_run(chunk, x)
+        idx += every
+    if skip_last:
+        x = functions[-1](x)
+    return x

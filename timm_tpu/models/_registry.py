@@ -1,0 +1,231 @@
+"""Model registry (reference: timm/models/_registry.py:1-352).
+
+Same public contract: `@register_model` on entrypoint functions, `arch.tag`
+pretrained tags, fnmatch-based `list_models`, per-module export tracking.
+"""
+from __future__ import annotations
+
+import fnmatch
+import re
+import sys
+from collections import defaultdict, deque
+from copy import deepcopy
+from dataclasses import replace
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple, Union
+
+from ._pretrained import DefaultCfg, PretrainedCfg
+
+__all__ = [
+    'register_model', 'generate_default_cfgs', 'list_models', 'list_pretrained',
+    'is_model', 'model_entrypoint', 'list_modules', 'is_model_in_modules',
+    'get_pretrained_cfg', 'get_pretrained_cfg_value', 'is_model_pretrained',
+    'split_model_name_tag', 'get_arch_name', 'get_arch_pretrained_cfgs',
+]
+
+_module_to_models: Dict[str, Set[str]] = defaultdict(set)
+_model_to_module: Dict[str, str] = {}
+_model_entrypoints: Dict[str, Callable[..., Any]] = {}
+_model_has_pretrained: Set[str] = set()
+_model_default_cfgs: Dict[str, DefaultCfg] = {}
+_model_pretrained_cfgs: Dict[str, PretrainedCfg] = {}
+_model_with_tags: Dict[str, List[str]] = defaultdict(list)
+_deprecated_models: Dict[str, Optional[str]] = {}
+
+
+def split_model_name_tag(model_name: str, no_tag: str = '') -> Tuple[str, str]:
+    model_name, *tag_list = model_name.split('.', 1)
+    tag = tag_list[0] if tag_list else no_tag
+    return model_name, tag
+
+
+def get_arch_name(model_name: str) -> str:
+    return split_model_name_tag(model_name)[0]
+
+
+def generate_default_cfgs(cfgs: Dict[str, Union[Dict[str, Any], PretrainedCfg]]) -> Dict[str, DefaultCfg]:
+    out = defaultdict(DefaultCfg)
+    default_set = set()  # archs with a default (first or explicitly-starred) tag
+
+    for k, v in cfgs.items():
+        if isinstance(v, dict):
+            v = PretrainedCfg(**v)
+        has_weights = v.has_weights
+        model, tag = split_model_name_tag(k)
+        is_default_set = model in default_set
+        priority = (has_weights and not tag) or (tag.endswith('*') and not is_default_set)
+        tag = tag.strip('*')
+        default_cfg = out[model]
+        if priority:
+            default_cfg.tags.insert(0, tag)
+            default_set.add(model)
+        elif has_weights and not default_cfg.is_pretrained:
+            default_cfg.tags.insert(0, tag)
+        else:
+            default_cfg.tags.append(tag)
+        if has_weights:
+            default_cfg.is_pretrained = True
+        default_cfg.cfgs[tag] = v
+
+    return dict(out)
+
+
+def register_model(fn: Callable) -> Callable:
+    mod = sys.modules[fn.__module__]
+    module_name = fn.__module__.split('.')[-1]
+    model_name = fn.__name__
+
+    if hasattr(mod, '__all__'):
+        mod.__all__.append(model_name)
+    else:
+        mod.__all__ = [model_name]
+
+    _model_entrypoints[model_name] = fn
+    _model_to_module[model_name] = module_name
+    _module_to_models[module_name].add(model_name)
+
+    default_cfg = getattr(mod, 'default_cfgs', {}).get(model_name, None)
+    if default_cfg is not None:
+        if not isinstance(default_cfg, DefaultCfg):
+            assert isinstance(default_cfg, dict)
+            default_cfg = DefaultCfg(tags=[''], cfgs={'': PretrainedCfg(**default_cfg)})
+        for tag_idx, tag in enumerate(default_cfg.tags):
+            is_default = tag_idx == 0
+            pretrained_cfg = default_cfg.cfgs[tag]
+            model_name_tag = '.'.join([model_name, tag]) if tag else model_name
+            pretrained_cfg = replace(pretrained_cfg, architecture=model_name, tag=tag if tag else None)
+            if is_default:
+                _model_pretrained_cfgs[model_name] = pretrained_cfg
+                if pretrained_cfg.has_weights:
+                    _model_has_pretrained.add(model_name)
+            if tag:
+                _model_pretrained_cfgs[model_name_tag] = pretrained_cfg
+                if pretrained_cfg.has_weights:
+                    _model_has_pretrained.add(model_name_tag)
+                _model_with_tags[model_name].append(model_name_tag)
+            else:
+                _model_with_tags[model_name].append(model_name)
+        _model_default_cfgs[model_name] = default_cfg
+    return fn
+
+
+def _natural_key(string_: str) -> List[Union[int, str]]:
+    return [int(s) if s.isdigit() else s for s in re.split(r'(\d+)', string_.lower())]
+
+
+def _expand_filter(filter_: str) -> List[str]:
+    filter_base, filter_tag = split_model_name_tag(filter_)
+    if not filter_tag:
+        return ['.'.join([filter_base, '*']), filter_]
+    return [filter_]
+
+
+def list_models(
+        filter: Union[str, List[str]] = '',
+        module: Union[str, List[str]] = '',
+        pretrained: bool = False,
+        exclude_filters: Union[str, List[str]] = '',
+        name_matches_cfg: bool = False,
+        include_tags: Optional[bool] = None,
+) -> List[str]:
+    if filter:
+        include_filters = filter if isinstance(filter, (tuple, list)) else [filter]
+    else:
+        include_filters = []
+    include_tags = pretrained if include_tags is None else include_tags
+
+    if not module:
+        all_models: Iterable[str] = _model_entrypoints.keys()
+    else:
+        models: Set[str] = set()
+        if isinstance(module, str):
+            module = [module]
+        for m in module:
+            models.update(_module_to_models[m])
+        all_models = models
+    all_models = [m for m in all_models if m not in _deprecated_models]
+
+    if include_tags:
+        models_with_tags: Set[str] = set()
+        for m in all_models:
+            models_with_tags.update(_model_with_tags[m])
+        all_models = list(models_with_tags)
+        include_filters = [ef for f in include_filters for ef in _expand_filter(f)]
+        exclude_filters = [ef for f in ([exclude_filters] if isinstance(exclude_filters, str) else exclude_filters) for ef in _expand_filter(f)] if exclude_filters else exclude_filters
+
+    if include_filters:
+        models = set()
+        for f in include_filters:
+            include_models = fnmatch.filter(all_models, f)
+            if include_models:
+                models.update(include_models)
+    else:
+        models = set(all_models)
+
+    if exclude_filters:
+        if not isinstance(exclude_filters, (tuple, list)):
+            exclude_filters = [exclude_filters]
+        for xf in exclude_filters:
+            exclude_models = fnmatch.filter(models, xf)
+            if exclude_models:
+                models = models.difference(exclude_models)
+
+    if pretrained:
+        models = _model_has_pretrained.intersection(models)
+
+    if name_matches_cfg:
+        models = set(_model_pretrained_cfgs).intersection(models)
+
+    return sorted(models, key=_natural_key)
+
+
+def list_pretrained(filter: Union[str, List[str]] = '', exclude_filters: str = '') -> List[str]:
+    return list_models(filter=filter, pretrained=True, exclude_filters=exclude_filters, include_tags=True)
+
+
+def is_model(model_name: str) -> bool:
+    arch_name = get_arch_name(model_name)
+    return arch_name in _model_entrypoints
+
+
+def model_entrypoint(model_name: str, module_filter: Optional[str] = None) -> Callable[..., Any]:
+    arch_name = get_arch_name(model_name)
+    if module_filter and arch_name not in _module_to_models.get(module_filter, {}):
+        raise RuntimeError(f'Model ({model_name}) not found in module {module_filter}.')
+    if arch_name not in _model_entrypoints:
+        raise RuntimeError(f'Unknown model ({model_name})')
+    return _model_entrypoints[arch_name]
+
+
+def list_modules() -> List[str]:
+    return sorted(_module_to_models.keys())
+
+
+def is_model_in_modules(model_name: str, module_names: Sequence[str]) -> bool:
+    arch_name = get_arch_name(model_name)
+    return any(arch_name in _module_to_models[n] for n in module_names)
+
+
+def is_model_pretrained(model_name: str) -> bool:
+    return model_name in _model_has_pretrained
+
+
+def get_pretrained_cfg(model_name: str, allow_unregistered: bool = True) -> Optional[PretrainedCfg]:
+    if model_name in _model_pretrained_cfgs:
+        return deepcopy(_model_pretrained_cfgs[model_name])
+    arch_name, tag = split_model_name_tag(model_name)
+    if arch_name in _model_default_cfgs:
+        raise RuntimeError(f'Invalid pretrained tag ({tag}) for {arch_name}.')
+    if allow_unregistered:
+        return None
+    raise RuntimeError(f'Model architecture ({arch_name}) has no pretrained cfg registered.')
+
+
+def get_pretrained_cfg_value(model_name: str, cfg_key: str) -> Optional[Any]:
+    cfg = get_pretrained_cfg(model_name, allow_unregistered=False)
+    return getattr(cfg, cfg_key, None)
+
+
+def get_arch_pretrained_cfgs(model_name: str) -> Dict[str, PretrainedCfg]:
+    arch_name, _ = split_model_name_tag(model_name)
+    model_names = _model_with_tags.get(arch_name, [])
+    return {m: _model_pretrained_cfgs[m] for m in model_names if m in _model_pretrained_cfgs}

@@ -1,0 +1,64 @@
+"""Torch-checkpoint → timm_tpu state-dict conversion.
+
+Lets this framework load the reference's released weights for parity testing
+(reference weight layouts: timm/models/*.py checkpoint_filter_fn families).
+
+Conversion rules (torch → flax/nnx):
+  Linear  .weight (O, I)       → .kernel (I, O)        [transpose]
+  Conv2d  .weight (O, I, H, W) → .kernel (H, W, I, O)  [permute 2,3,1,0]
+  Norm    .weight              → .scale
+  BatchNorm .running_mean/var  → .mean / .var
+Names otherwise match because module trees mirror the reference contract.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ['load_torch_state_dict', 'convert_torch_state_dict']
+
+
+def load_torch_state_dict(path: str, use_ema: bool = True) -> Dict[str, np.ndarray]:
+    import torch
+    ckpt = torch.load(path, map_location='cpu', weights_only=True)
+    if isinstance(ckpt, dict):
+        for key in (('state_dict_ema', 'model_ema') if use_ema else ()) + ('state_dict', 'model'):
+            if key in ckpt and isinstance(ckpt[key], dict):
+                ckpt = ckpt[key]
+                break
+    return {k: v.numpy() if hasattr(v, 'numpy') else np.asarray(v) for k, v in ckpt.items()}
+
+
+def convert_torch_state_dict(state_dict: Dict[str, np.ndarray], model=None) -> Dict[str, np.ndarray]:
+    """Mechanical torch→nnx layout conversion keyed on target shapes."""
+    from ._helpers import model_state_dict
+    target = model_state_dict(model) if model is not None else None
+    out = {}
+    for k, v in state_dict.items():
+        v = np.asarray(v)
+        nk, nv = k, v
+        if k.endswith('.running_mean'):
+            nk = k[:-len('.running_mean')] + '.mean'
+        elif k.endswith('.running_var'):
+            nk = k[:-len('.running_var')] + '.var'
+        elif k.endswith('num_batches_tracked'):
+            continue
+        elif k.endswith('.weight'):
+            base = k[:-len('.weight')]
+            if v.ndim == 4:  # conv OIHW → HWIO
+                nk, nv = base + '.kernel', v.transpose(2, 3, 1, 0)
+            elif v.ndim == 2:  # linear (O,I) → (I,O)
+                nk, nv = base + '.kernel', v.T
+            elif v.ndim == 1:  # norm scale
+                nk = base + '.scale'
+                if target is not None and nk not in target and base + '.kernel' in target:
+                    nk = base + '.kernel'
+            else:
+                nk = base + '.kernel'
+        # verify/auto-correct against target shapes when available
+        if target is not None and nk in target and tuple(target[nk].shape) != tuple(nv.shape):
+            if target[nk].size == nv.size:
+                nv = nv.reshape(target[nk].shape)
+        out[nk] = nv
+    return out

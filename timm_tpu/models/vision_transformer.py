@@ -1,0 +1,676 @@
+"""Vision Transformer, TPU-native.
+
+Re-designed from the reference's VisionTransformer
+(reference: timm/models/vision_transformer.py:711-1302) for JAX/XLA:
+NLC tokens, explicit RNG streams, trace-time pos-embed resampling for
+dynamic image sizes, rematerialised blocks for grad checkpointing.
+
+Model contract parity (reference vision_transformer.py):
+  forward_features / forward_head / __call__, get_classifier / reset_classifier,
+  group_matcher, set_grad_checkpointing, forward_intermediates,
+  prune_intermediate_layers, no_weight_decay, set_input_size.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    Attention, AttentionPoolLatent, DropPath, Dropout, LayerNorm, LayerScale,
+    Mlp, PatchDropout, PatchEmbed, calculate_drop_path_rates, get_act_fn,
+    get_norm_layer, global_pool_nlc, resample_abs_pos_embed, trunc_normal_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['VisionTransformer', 'Block', 'ResPostBlock']
+
+
+class Block(nnx.Module):
+    """Pre-norm transformer block (reference vision_transformer.py:128-216)."""
+
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int,
+            mlp_ratio: float = 4.0,
+            qkv_bias: bool = False,
+            qk_norm: bool = False,
+            proj_bias: bool = True,
+            proj_drop: float = 0.0,
+            attn_drop: float = 0.0,
+            init_values: Optional[float] = None,
+            drop_path: float = 0.0,
+            act_layer: Union[str, Callable] = 'gelu',
+            norm_layer: Callable = LayerNorm,
+            mlp_layer: Callable = Mlp,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.norm1 = norm_layer(dim, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.attn = Attention(
+            dim,
+            num_heads=num_heads,
+            qkv_bias=qkv_bias,
+            qk_norm=qk_norm,
+            proj_bias=proj_bias,
+            attn_drop=attn_drop,
+            proj_drop=proj_drop,
+            norm_layer=norm_layer,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            rngs=rngs,
+        )
+        self.ls1 = LayerScale(dim, init_values=init_values, param_dtype=param_dtype, rngs=rngs) if init_values else None
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(dim, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.mlp = mlp_layer(
+            dim,
+            hidden_features=int(dim * mlp_ratio),
+            act_layer=act_layer,
+            drop=proj_drop,
+            bias=proj_bias,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            rngs=rngs,
+        )
+        self.ls2 = LayerScale(dim, init_values=init_values, param_dtype=param_dtype, rngs=rngs) if init_values else None
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x, attn_mask=None):
+        y = self.attn(self.norm1(x), attn_mask=attn_mask)
+        if self.ls1 is not None:
+            y = self.ls1(y)
+        x = x + self.drop_path1(y)
+        y = self.mlp(self.norm2(x))
+        if self.ls2 is not None:
+            y = self.ls2(y)
+        x = x + self.drop_path2(y)
+        return x
+
+
+class ResPostBlock(nnx.Module):
+    """Post-norm residual block (reference vision_transformer.py:217-291)."""
+
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int,
+            mlp_ratio: float = 4.0,
+            qkv_bias: bool = False,
+            qk_norm: bool = False,
+            proj_bias: bool = True,
+            proj_drop: float = 0.0,
+            attn_drop: float = 0.0,
+            init_values: Optional[float] = None,
+            drop_path: float = 0.0,
+            act_layer: Union[str, Callable] = 'gelu',
+            norm_layer: Callable = LayerNorm,
+            mlp_layer: Callable = Mlp,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.init_values = init_values
+        self.attn = Attention(
+            dim, num_heads=num_heads, qkv_bias=qkv_bias, qk_norm=qk_norm, proj_bias=proj_bias,
+            attn_drop=attn_drop, proj_drop=proj_drop, norm_layer=norm_layer,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+        )
+        self.norm1 = norm_layer(dim, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.mlp = mlp_layer(
+            dim, hidden_features=int(dim * mlp_ratio), act_layer=act_layer, drop=proj_drop,
+            bias=proj_bias, dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+        )
+        self.norm2 = norm_layer(dim, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+        # reference init: scale norm weights by init_values when provided
+        if init_values is not None:
+            self.norm1.scale[...] = self.norm1.scale[...] * init_values
+            self.norm2.scale[...] = self.norm2.scale[...] * init_values
+
+    def __call__(self, x, attn_mask=None):
+        x = x + self.drop_path1(self.norm1(self.attn(x, attn_mask=attn_mask)))
+        x = x + self.drop_path2(self.norm2(self.mlp(x)))
+        return x
+
+
+class VisionTransformer(nnx.Module):
+    """ViT with the reference's full model contract."""
+
+    dynamic_img_size: bool
+
+    def __init__(
+            self,
+            img_size: Union[int, Tuple[int, int]] = 224,
+            patch_size: Union[int, Tuple[int, int]] = 16,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'token',
+            embed_dim: int = 768,
+            depth: int = 12,
+            num_heads: int = 12,
+            mlp_ratio: float = 4.0,
+            qkv_bias: bool = True,
+            qk_norm: bool = False,
+            proj_bias: bool = True,
+            init_values: Optional[float] = None,
+            class_token: bool = True,
+            pos_embed: str = 'learn',
+            no_embed_class: bool = False,
+            reg_tokens: int = 0,
+            pre_norm: bool = False,
+            final_norm: bool = True,
+            fc_norm: Optional[bool] = None,
+            dynamic_img_size: bool = False,
+            dynamic_img_pad: bool = False,
+            drop_rate: float = 0.0,
+            pos_drop_rate: float = 0.0,
+            patch_drop_rate: float = 0.0,
+            proj_drop_rate: float = 0.0,
+            attn_drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            weight_init: str = '',
+            fix_init: bool = False,
+            embed_layer: Callable = PatchEmbed,
+            norm_layer: Optional[Union[str, Callable]] = None,
+            act_layer: Optional[Union[str, Callable]] = None,
+            block_fn: Callable = Block,
+            mlp_layer: Callable = Mlp,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert global_pool in ('', 'avg', 'avgmax', 'max', 'token', 'map')
+        assert class_token or global_pool != 'token'
+        assert pos_embed in ('', 'none', 'learn')
+        norm_layer = get_norm_layer(norm_layer) or LayerNorm
+        act_layer = act_layer or 'gelu'
+
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.num_features = self.head_hidden_size = self.embed_dim = embed_dim
+        self.num_prefix_tokens = 1 if class_token else 0
+        self.num_prefix_tokens += reg_tokens
+        self.num_reg_tokens = reg_tokens
+        self.has_class_token = class_token
+        self.no_embed_class = no_embed_class
+        self.dynamic_img_size = dynamic_img_size
+        self.grad_checkpointing = False
+        self.depth = depth
+
+        embed_args = {}
+        if dynamic_img_size:
+            embed_args.update(dict(strict_img_size=False))
+        self.patch_embed = embed_layer(
+            img_size=img_size,
+            patch_size=patch_size,
+            in_chans=in_chans,
+            embed_dim=embed_dim,
+            bias=not pre_norm,  # pre-norm (CLIP) ViTs have no patch-proj bias
+            dynamic_img_pad=dynamic_img_pad,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            rngs=rngs,
+            **embed_args,
+        )
+        num_patches = self.patch_embed.num_patches
+        reduction = self.patch_embed.patch_size[0] if hasattr(self.patch_embed, 'patch_size') else 16
+
+        self.cls_token = nnx.Param(
+            jnp.zeros((1, 1, embed_dim), param_dtype)) if class_token else None
+        self.reg_token = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (1, reg_tokens, embed_dim), param_dtype)) if reg_tokens else None
+
+        embed_len = num_patches if no_embed_class else num_patches + self.num_prefix_tokens
+        if not pos_embed or pos_embed == 'none':
+            self.pos_embed = None
+        else:
+            self.pos_embed = nnx.Param(
+                trunc_normal_(std=0.02)(rngs.params(), (1, embed_len, embed_dim), param_dtype))
+        self.pos_drop = Dropout(pos_drop_rate, rngs=rngs)
+        if patch_drop_rate > 0:
+            self.patch_drop = PatchDropout(patch_drop_rate, num_prefix_tokens=self.num_prefix_tokens, rngs=rngs)
+        else:
+            self.patch_drop = None
+        self.norm_pre = norm_layer(embed_dim, rngs=rngs) if pre_norm else None
+
+        dpr = calculate_drop_path_rates(drop_path_rate, depth)
+        self.blocks = nnx.List([
+            block_fn(
+                dim=embed_dim,
+                num_heads=num_heads,
+                mlp_ratio=mlp_ratio,
+                qkv_bias=qkv_bias,
+                qk_norm=qk_norm,
+                proj_bias=proj_bias,
+                init_values=init_values,
+                proj_drop=proj_drop_rate,
+                attn_drop=attn_drop_rate,
+                drop_path=dpr[i],
+                norm_layer=norm_layer,
+                act_layer=act_layer,
+                mlp_layer=mlp_layer,
+                dtype=dtype,
+                param_dtype=param_dtype,
+                rngs=rngs,
+            )
+            for i in range(depth)
+        ])
+        self.feature_info = [
+            dict(module=f'blocks.{i}', num_chs=embed_dim, reduction=reduction) for i in range(depth)]
+
+        # feature norm (pre-pool) vs fc norm (post-pool)
+        if fc_norm is None:
+            fc_norm = global_pool == 'avg'
+        self.norm = norm_layer(embed_dim, rngs=rngs) if final_norm and not fc_norm else None
+
+        # head
+        if global_pool == 'map':
+            self.attn_pool = AttentionPoolLatent(
+                self.embed_dim,
+                num_heads=num_heads,
+                mlp_ratio=mlp_ratio,
+                norm_layer=norm_layer,
+                dtype=dtype,
+                param_dtype=param_dtype,
+                rngs=rngs,
+            )
+        else:
+            self.attn_pool = None
+        self.fc_norm = norm_layer(embed_dim, rngs=rngs) if final_norm and fc_norm else None
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.head = nnx.Linear(
+            self.embed_dim, num_classes,
+            kernel_init=trunc_normal_(std=0.02),
+            bias_init=lambda key, shape, dtype=jnp.float32: jnp.zeros(shape, dtype),
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+        ) if num_classes > 0 else None
+
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+        if fix_init:
+            self.fix_init_weight()
+
+    def fix_init_weight(self):
+        """Rescale block projections by depth (reference vision_transformer.py:~980)."""
+        for layer_id, block in enumerate(self.blocks):
+            scale = math.sqrt(2.0 * (layer_id + 1))
+            block.attn.proj.kernel[...] = block.attn.proj.kernel[...] / scale
+            block.mlp.fc2.kernel[...] = block.mlp.fc2.kernel[...] / scale
+
+    # ---- contract methods -------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'pos_embed', 'cls_token', 'reg_token', 'dist_token'}
+
+    def group_matcher(self, coarse: bool = False) -> Dict:
+        return dict(
+            stem=r'^cls_token|pos_embed|patch_embed|reg_token',
+            blocks=[(r'^blocks\.(\d+)', None), (r'^norm', (99999,))],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs: Optional[nnx.Rngs] = None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            assert global_pool in ('', 'avg', 'avgmax', 'max', 'token', 'map')
+            if global_pool == 'map' and self.attn_pool is None:
+                raise AssertionError("Cannot currently add attention pooling in reset_classifier().")
+            if global_pool != 'map':
+                self.attn_pool = None
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = nnx.Linear(
+            self.embed_dim, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs,
+        ) if num_classes > 0 else None
+
+    def set_input_size(self, img_size=None, patch_size=None):
+        """Resample learned pos embed for a new static input size
+        (reference vision_transformer.py:1013)."""
+        if img_size is None:
+            return
+        prev_grid = self.patch_embed.grid_size
+        self.patch_embed.set_input_size(img_size=img_size, patch_size=patch_size)
+        new_grid = self.patch_embed.grid_size
+        if self.pos_embed is not None and new_grid != prev_grid:
+            self.pos_embed[...] = resample_abs_pos_embed(
+                self.pos_embed[...],
+                new_size=new_grid,
+                old_size=prev_grid,
+                num_prefix_tokens=0 if self.no_embed_class else self.num_prefix_tokens,
+            )
+
+    # ---- forward ----------------------------------------------------------
+    def _pos_embed(self, x, grid_size: Optional[Tuple[int, int]] = None):
+        B = x.shape[0]
+        if self.pos_embed is None:
+            pos_embed = None
+        else:
+            pos_embed = self.pos_embed[...].astype(x.dtype)
+            if self.dynamic_img_size and grid_size is not None and grid_size != self.patch_embed.grid_size:
+                pos_embed = resample_abs_pos_embed(
+                    pos_embed,
+                    new_size=grid_size,
+                    old_size=self.patch_embed.grid_size,
+                    num_prefix_tokens=0 if self.no_embed_class else self.num_prefix_tokens,
+                )
+
+        to_cat = []
+        if self.cls_token is not None:
+            to_cat.append(jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (B, 1, x.shape[-1])))
+        if self.reg_token is not None:
+            to_cat.append(jnp.broadcast_to(self.reg_token[...].astype(x.dtype), (B, self.num_reg_tokens, x.shape[-1])))
+
+        if self.no_embed_class:
+            if pos_embed is not None:
+                x = x + pos_embed
+            if to_cat:
+                x = jnp.concatenate(to_cat + [x], axis=1)
+        else:
+            if to_cat:
+                x = jnp.concatenate(to_cat + [x], axis=1)
+            if pos_embed is not None:
+                x = x + pos_embed
+        return self.pos_drop(x)
+
+    def forward_features(self, x, attn_mask=None):
+        grid_size = None
+        if self.dynamic_img_size:
+            grid_size = self.patch_embed.dynamic_feat_size(x.shape[1:3])
+        x = self.patch_embed(x)
+        x = self._pos_embed(x, grid_size=grid_size)
+        if self.patch_drop is not None:
+            x = self.patch_drop(x)
+        if self.norm_pre is not None:
+            x = self.norm_pre(x)
+        if self.grad_checkpointing and attn_mask is None:
+            x = checkpoint_seq(self.blocks, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x, attn_mask=attn_mask)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+    def pool(self, x, pool_type: Optional[str] = None):
+        if self.attn_pool is not None:
+            return self.attn_pool(x)
+        pool_type = self.global_pool if pool_type is None else pool_type
+        return global_pool_nlc(x, pool_type=pool_type, num_prefix_tokens=self.num_prefix_tokens)
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = self.pool(x)
+        if self.fc_norm is not None:
+            x = self.fc_norm(x)
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, x, attn_mask=None):
+        x = self.forward_features(x, attn_mask=attn_mask)
+        x = self.forward_head(x)
+        return x
+
+    # ---- intermediates ----------------------------------------------------
+    def forward_intermediates(
+            self,
+            x,
+            indices: Optional[Union[int, List[int]]] = None,
+            return_prefix_tokens: bool = False,
+            norm: bool = False,
+            stop_early: bool = False,
+            output_fmt: str = 'NHWC',
+            intermediates_only: bool = False,
+            attn_mask=None,
+    ):
+        """Collect intermediate block outputs (reference vision_transformer.py:1077)."""
+        assert output_fmt in ('NHWC', 'NLC'), 'Output format must be NHWC or NLC.'
+        reshape = output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+
+        B, H, W, _ = x.shape
+        grid_size = self.patch_embed.dynamic_feat_size((H, W)) if self.dynamic_img_size \
+            else self.patch_embed.grid_size
+        x = self.patch_embed(x)
+        x = self._pos_embed(x, grid_size=grid_size if self.dynamic_img_size else None)
+        if self.patch_drop is not None:
+            x = self.patch_drop(x)
+        if self.norm_pre is not None:
+            x = self.norm_pre(x)
+
+        intermediates = []
+        blocks = self.blocks if not stop_early else self.blocks[:max_index + 1]
+        for i, blk in enumerate(blocks):
+            x = blk(x, attn_mask=attn_mask)
+            if i in take_indices:
+                intermediates.append(self.norm(x) if (norm and self.norm is not None) else x)
+
+        # split prefix tokens, reshape spatial
+        prefix_tokens = None
+        if self.num_prefix_tokens:
+            prefix_tokens = [y[:, 0:self.num_prefix_tokens] for y in intermediates]
+            intermediates = [y[:, self.num_prefix_tokens:] for y in intermediates]
+        if reshape:
+            intermediates = [
+                y.reshape(B, grid_size[0], grid_size[1], -1) for y in intermediates]
+        if return_prefix_tokens and prefix_tokens is not None:
+            intermediates = list(zip(intermediates, prefix_tokens))
+
+        if intermediates_only:
+            return intermediates
+        if self.norm is not None:
+            x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(
+            self,
+            indices: Union[int, List[int]] = 1,
+            prune_norm: bool = False,
+            prune_head: bool = True,
+    ):
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.fc_norm = None
+            self.attn_pool = None
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict: Dict, model) -> Dict:
+    """Convert reference-timm torch checkpoints → this module's state layout."""
+    from ._torch_convert import convert_torch_state_dict
+    return convert_torch_state_dict(state_dict, model)
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': None,
+        'crop_pct': 0.9,
+        'interpolation': 'bicubic',
+        'fixed_input_size': True,
+        'mean': (0.5, 0.5, 0.5),
+        'std': (0.5, 0.5, 0.5),
+        'first_conv': 'patch_embed.proj',
+        'classifier': 'head',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'vit_tiny_patch16_224.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'vit_tiny_patch16_384.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_small_patch32_224.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'vit_small_patch16_224.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'vit_small_patch16_384.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_base_patch32_224.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'vit_base_patch16_224.augreg2_in21k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'vit_base_patch16_224.augreg_in1k': _cfg(hf_hub_id='timm/'),
+    'vit_base_patch16_384.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_base_patch8_224.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'vit_large_patch16_224.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'vit_large_patch14_224.untrained': _cfg(url=''),
+    'vit_huge_patch14_224.untrained': _cfg(url=''),
+    'vit_so400m_patch14_siglip_224.untrained': _cfg(url=''),
+    'vit_tiny_patch16_224.untrained': _cfg(url=''),
+    # tiny test fixtures (reference vision_transformer.py:4802-4833)
+    'test_vit.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+    'test_vit2.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+    'test_vit3.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+    'test_vit4.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+})
+
+
+def _create_vision_transformer(variant: str, pretrained: bool = False, **kwargs) -> VisionTransformer:
+    out_indices = kwargs.pop('out_indices', 3)
+    return build_model_with_cfg(
+        VisionTransformer,
+        variant,
+        pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices),
+        **kwargs,
+    )
+
+
+@register_model
+def vit_tiny_patch16_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=16, embed_dim=192, depth=12, num_heads=3)
+    return _create_vision_transformer('vit_tiny_patch16_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_tiny_patch16_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=16, embed_dim=192, depth=12, num_heads=3)
+    return _create_vision_transformer('vit_tiny_patch16_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_small_patch32_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=32, embed_dim=384, depth=12, num_heads=6)
+    return _create_vision_transformer('vit_small_patch32_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_small_patch16_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=16, embed_dim=384, depth=12, num_heads=6)
+    return _create_vision_transformer('vit_small_patch16_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_small_patch16_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=16, embed_dim=384, depth=12, num_heads=6)
+    return _create_vision_transformer('vit_small_patch16_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch32_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=32, embed_dim=768, depth=12, num_heads=12)
+    return _create_vision_transformer('vit_base_patch32_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=16, embed_dim=768, depth=12, num_heads=12)
+    return _create_vision_transformer('vit_base_patch16_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=16, embed_dim=768, depth=12, num_heads=12)
+    return _create_vision_transformer('vit_base_patch16_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch8_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=8, embed_dim=768, depth=12, num_heads=12)
+    return _create_vision_transformer('vit_base_patch8_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch16_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=16, embed_dim=1024, depth=24, num_heads=16)
+    return _create_vision_transformer('vit_large_patch16_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch14_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=14, embed_dim=1024, depth=24, num_heads=16)
+    return _create_vision_transformer('vit_large_patch14_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_huge_patch14_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(patch_size=14, embed_dim=1280, depth=32, num_heads=16)
+    return _create_vision_transformer('vit_huge_patch14_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch14_siglip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=14, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362,
+        class_token=False, global_pool='map',
+    )
+    return _create_vision_transformer('vit_so400m_patch14_siglip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_vit(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """Minimal test ViT (reference vision_transformer.py:4802)."""
+    model_args = dict(img_size=160, patch_size=16, embed_dim=64, depth=2, num_heads=2, mlp_ratio=3)
+    return _create_vision_transformer('test_vit', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_vit2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """Test ViT w/ global avg pool + reg tokens + layer scale."""
+    model_args = dict(
+        img_size=160, patch_size=16, embed_dim=64, depth=2, num_heads=2, mlp_ratio=3,
+        class_token=False, reg_tokens=1, global_pool='avg', init_values=1e-5,
+    )
+    return _create_vision_transformer('test_vit2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_vit3(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """Test ViT w/ qk-norm + map pooling."""
+    model_args = dict(
+        img_size=160, patch_size=16, embed_dim=96, depth=9, num_heads=3, mlp_ratio=2,
+        class_token=False, reg_tokens=1, global_pool='map', qk_norm=True,
+    )
+    return _create_vision_transformer('test_vit3', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_vit4(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """Test ViT w/ dynamic img size + patch dropout."""
+    model_args = dict(
+        img_size=160, patch_size=16, embed_dim=64, depth=2, num_heads=2, mlp_ratio=3,
+        dynamic_img_size=True, patch_drop_rate=0.25,
+    )
+    return _create_vision_transformer('test_vit4', pretrained=pretrained, **dict(model_args, **kwargs))

@@ -64,26 +64,26 @@ class AttentionPoolLatent(nnx.Module):
             self.pos_embed = None
 
         self.latent_dim = latent_dim or embed_dim
-        import jax
         self.latent = nnx.Param(
             trunc_normal_(std=in_features ** -0.5)(rngs.params(), (1, self.latent_len, embed_dim), param_dtype))
 
         self.q = linear(embed_dim, embed_dim, use_bias=qkv_bias)
-        self.kv = linear(embed_dim, embed_dim * 2, use_bias=qkv_bias)
+        self.kv = linear(in_features, embed_dim * 2, use_bias=qkv_bias)
         self.q_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
         self.k_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
-        self.proj = linear(embed_dim, embed_dim)
+        self.proj = linear(embed_dim, out_features)
         self.proj_drop = Dropout(drop, rngs=rngs)
 
         self.norm = norm_layer(out_features, rngs=rngs)
-        self.mlp = Mlp(embed_dim, int(embed_dim * mlp_ratio), act_layer=act_layer,
+        self.mlp = Mlp(out_features, int(out_features * mlp_ratio), act_layer=act_layer,
                        dtype=dtype, param_dtype=param_dtype, rngs=rngs)
 
     def __call__(self, x):
         B, N, C = x.shape
         if self.pos_embed is not None:
             x = x + self.pos_embed[...].astype(x.dtype)[None]
-        q_latent = jnp.broadcast_to(self.latent[...].astype(x.dtype), (B, self.latent_len, x.shape[-1]))
+        lat = self.latent[...].astype(x.dtype)
+        q_latent = jnp.broadcast_to(lat, (B, self.latent_len, lat.shape[-1]))
         q = self.q(q_latent).reshape(B, self.latent_len, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
         kv = self.kv(x).reshape(B, N, 2, self.num_heads, self.head_dim).transpose(2, 0, 3, 1, 4)
         k, v = kv[0], kv[1]

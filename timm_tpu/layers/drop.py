@@ -53,21 +53,18 @@ def calculate_drop_path_rates(
         depths: Union[int, List[int]],
         stagewise: bool = False,
 ) -> Union[List[float], List[List[float]]]:
-    """Linearly-increasing per-block drop-path rates (reference drop.py:~190)."""
+    """Linearly-increasing per-block drop-path rates (reference drop.py:~190).
+
+    Returns a flat per-block list; `stagewise=True` (requires list depths)
+    groups the flat rates per stage instead.
+    """
     if isinstance(depths, int):
+        if stagewise:
+            raise ValueError('stagewise=True requires a list of per-stage depths')
         depths = [depths]
-        squeeze = True
-    else:
-        squeeze = False
     total = sum(depths)
     rates = [drop_path_rate * i / max(total - 1, 1) for i in range(total)]
-    if stagewise:
-        out, idx = [], 0
-        for d in depths:
-            out.append(rates[idx:idx + d])
-            idx += d
-        return out[0] if squeeze else out
-    if squeeze:
+    if not stagewise:
         return rates
     out, idx = [], 0
     for d in depths:

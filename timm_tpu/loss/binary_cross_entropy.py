@@ -1,0 +1,64 @@
+"""BCE w/ soft-target support (reference: timm/loss/binary_cross_entropy.py)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['BinaryCrossEntropy']
+
+
+class BinaryCrossEntropy:
+    """BCE-with-logits treating dense targets, w/ smoothing, thresholding,
+    optional sum-mode and pos_weight (reference binary_cross_entropy.py:14)."""
+
+    def __init__(
+            self,
+            smoothing: float = 0.1,
+            target_threshold: Optional[float] = None,
+            weight=None,
+            reduction: str = 'mean',
+            sum_classes: bool = False,
+            pos_weight=None,
+    ):
+        assert 0.0 <= smoothing < 1.0
+        self.smoothing = smoothing
+        self.target_threshold = target_threshold
+        self.reduction = 'none' if sum_classes else reduction
+        self.sum_classes = sum_classes
+        self.weight = weight
+        self.pos_weight = pos_weight
+
+    def __call__(self, x, target):
+        batch_size = x.shape[0]
+        num_classes = x.shape[-1]
+        if target.ndim == 1:
+            # dense int targets → one-hot w/ smoothing values
+            off_value = self.smoothing / num_classes
+            on_value = 1.0 - self.smoothing + off_value
+            target = jax.nn.one_hot(target, num_classes) * (on_value - off_value) + off_value
+        elif self.smoothing > 0.0:
+            off_value = self.smoothing / num_classes
+            target = target * (1.0 - self.smoothing) + off_value
+        if self.target_threshold is not None:
+            target = (target >= self.target_threshold).astype(x.dtype)
+
+        x = x.astype(jnp.float32)
+        target = target.astype(jnp.float32)
+        log_p = jax.nn.log_sigmoid(x)
+        log_not_p = jax.nn.log_sigmoid(-x)
+        if self.pos_weight is not None:
+            loss = -(self.pos_weight * target * log_p + (1.0 - target) * log_not_p)
+        else:
+            loss = -(target * log_p + (1.0 - target) * log_not_p)
+        if self.weight is not None:
+            loss = loss * self.weight
+
+        if self.sum_classes:
+            return loss.sum(axis=-1).mean()
+        if self.reduction == 'mean':
+            return loss.mean()
+        if self.reduction == 'sum':
+            return loss.sum()
+        return loss

@@ -1,0 +1,47 @@
+"""Cross-entropy losses (reference: timm/loss/cross_entropy.py).
+
+Losses are stateless callables: `loss = fn(logits, target)` returning a
+scalar mean over the batch. Integer targets are class indices; float targets
+of shape (B, C) are soft distributions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['LabelSmoothingCrossEntropy', 'SoftTargetCrossEntropy', 'cross_entropy']
+
+
+def cross_entropy(logits, target, smoothing: float = 0.0):
+    """CE over (B, C) logits; target (B,) int or (B, C) soft."""
+    logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    if target.ndim == logits.ndim:
+        loss = -(target * logprobs).sum(axis=-1)
+    else:
+        nll = -jnp.take_along_axis(logprobs, target[:, None], axis=-1)[:, 0]
+        if smoothing > 0.0:
+            smooth = -logprobs.mean(axis=-1)
+            loss = (1.0 - smoothing) * nll + smoothing * smooth
+        else:
+            loss = nll
+    return loss.mean()
+
+
+class LabelSmoothingCrossEntropy:
+    """NLL w/ uniform label smoothing (reference cross_entropy.py:11)."""
+
+    def __init__(self, smoothing: float = 0.1):
+        assert smoothing < 1.0
+        self.smoothing = smoothing
+
+    def __call__(self, x, target):
+        return cross_entropy(x, target, smoothing=self.smoothing)
+
+
+class SoftTargetCrossEntropy:
+    """CE against a soft target distribution (reference cross_entropy.py:29)."""
+
+    def __call__(self, x, target):
+        logprobs = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+        loss = -(target * logprobs).sum(axis=-1)
+        return loss.mean()

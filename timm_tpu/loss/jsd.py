@@ -1,0 +1,31 @@
+"""Jensen-Shannon divergence loss for AugMix (reference: timm/loss/jsd.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .cross_entropy import cross_entropy
+
+__all__ = ['JsdCrossEntropy']
+
+
+class JsdCrossEntropy:
+    """CE on the clean split + JSD consistency across aug splits
+    (reference jsd.py:10)."""
+
+    def __init__(self, num_splits: int = 3, alpha: float = 12.0, smoothing: float = 0.1):
+        self.num_splits = num_splits
+        self.alpha = alpha
+        self.smoothing = smoothing or 0.0
+
+    def __call__(self, output, target):
+        split_size = output.shape[0] // self.num_splits
+        logits_split = jnp.split(output, self.num_splits, axis=0)
+
+        loss = cross_entropy(logits_split[0], target[:split_size], smoothing=self.smoothing)
+        probs = [jax.nn.softmax(l.astype(jnp.float32), axis=-1) for l in logits_split]
+        mix = jnp.clip(sum(probs) / len(probs), 1e-7, 1.0)
+        logp_mixture = jnp.log(mix)
+        kl = sum((p * (jnp.log(jnp.clip(p, 1e-7, 1.0)) - logp_mixture)).sum(axis=-1).mean() for p in probs)
+        loss = loss + self.alpha * kl / len(probs)
+        return loss

@@ -197,7 +197,8 @@ def build_model_with_cfg(
         **kwargs,
 ):
     """Instantiate a model from an entrypoint + cfg (reference _builder.py:384-503)."""
-    pruned = kwargs.pop('pruned', False)
+    if kwargs.pop('pruned', False):
+        raise NotImplementedError('pruned model variants are not supported yet')
     features = False
     feature_cfg = feature_cfg or {}
 

@@ -28,7 +28,7 @@ def clean_state_dict(state_dict: Dict[str, Any]) -> Dict[str, Any]:
     """Strip wrapper prefixes (reference _helpers.py:79)."""
     cleaned = {}
     for k, v in state_dict.items():
-        for prefix in ('module.', '_orig_mod.', 'model.'):
+        for prefix in ('module.', '_orig_mod.'):
             if k.startswith(prefix):
                 k = k[len(prefix):]
         cleaned[k] = v
@@ -47,8 +47,8 @@ def model_state_dict(model: nnx.Module, include_stats: bool = True) -> Dict[str,
         value = leaf[...]
         if value is None:
             continue
-        if hasattr(value, 'dtype') and jnp.issubdtype(value.dtype, jnp.integer) and not include_stats:
-            continue
+        if not include_stats and not isinstance(leaf, nnx.Param):
+            continue  # drop batch stats / other non-param variables
         key = _path_str(path)
         if 'rngs' in key:
             continue  # rng stream state is not part of the weight contract

@@ -55,8 +55,16 @@ def group_with_matcher(
                 if r:
                     parts = (grp_ordinal,) + r.groups()
                     if suffix is not None:
-                        parts = parts + (suffix,)
-                    return tuple(map(float, filter(lambda x: x is not None, parts)))
+                        parts = parts + (tuple(suffix) if isinstance(suffix, (tuple, list)) else (suffix,))
+                    flat = []
+                    for p in parts:
+                        if p is None:
+                            continue
+                        if isinstance(p, (tuple, list)):
+                            flat.extend(float(q) for q in p if q is not None)
+                        else:
+                            flat.append(float(p))
+                    return tuple(flat)
             return (float('inf'),)
         ord_ = group_matcher(name)
         if not isinstance(ord_, collections_abc_iterable()):

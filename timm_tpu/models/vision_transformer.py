@@ -352,12 +352,13 @@ class VisionTransformer(nnx.Module):
         self.patch_embed.set_input_size(img_size=img_size, patch_size=patch_size)
         new_grid = self.patch_embed.grid_size
         if self.pos_embed is not None and new_grid != prev_grid:
-            self.pos_embed[...] = resample_abs_pos_embed(
+            # shape changes, so the Param must be replaced, not assigned into
+            self.pos_embed = nnx.Param(resample_abs_pos_embed(
                 self.pos_embed[...],
                 new_size=new_grid,
                 old_size=prev_grid,
                 num_prefix_tokens=0 if self.no_embed_class else self.num_prefix_tokens,
-            )
+            ))
 
     # ---- forward ----------------------------------------------------------
     def _pos_embed(self, x, grid_size: Optional[Tuple[int, int]] = None):

@@ -1,0 +1,14 @@
+from ._optim_factory import (
+    OptimInfo, Optimizer, OptimizerRegistry, create_optimizer_v2,
+    get_optimizer_info, list_optimizers, optimizer_kwargs,
+)
+from ._param_groups import param_groups_layer_decay, param_groups_weight_decay
+
+
+def create_optimizer(args, model, filter_bias_and_bn=True):
+    """Legacy factory signature (reference: timm/optim/_optim_factory.py legacy shim)."""
+    return create_optimizer_v2(
+        model,
+        **optimizer_kwargs(args),
+        filter_bias_and_bn=filter_bias_and_bn,
+    )

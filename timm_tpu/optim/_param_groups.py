@@ -1,0 +1,101 @@
+"""Parameter-group semantics as pytree masks/scales
+(reference: timm/optim/_param_groups.py:19-194).
+
+torch param groups don't exist in optax; the same semantics are expressed as
+pytrees aligned with the param state:
+  * weight-decay exclusion  → boolean mask tree (True = apply WD)
+  * layer-decay             → float lr-scale tree
+"""
+from __future__ import annotations
+
+import fnmatch
+import logging
+from typing import Any, Callable, Dict, Optional, Set, Tuple
+
+from flax import nnx
+
+from ..models._manipulate import group_with_matcher, named_parameters
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ['param_groups_weight_decay', 'param_groups_layer_decay', 'auto_group_layers']
+
+
+def _matches_no_decay(name: str, no_decay_names: Set[str]) -> bool:
+    for pat in no_decay_names:
+        if name == pat or name.startswith(pat + '.') or fnmatch.fnmatch(name, pat) or name.endswith(pat):
+            return True
+    return False
+
+
+def _tree_from_name_fn(model: nnx.Module, fn: Callable[[str, Any], Any]):
+    """Build a pytree over nnx.Param state with values from fn(name, value)."""
+    import jax
+    state = nnx.state(model, nnx.Param)
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, v: fn(_keypath_str(kp), v), state)
+
+
+def _keypath_str(kp) -> str:
+    parts = []
+    for p in kp:
+        if hasattr(p, 'key'):
+            parts.append(str(p.key))
+        elif hasattr(p, 'idx'):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return '.'.join(parts)
+
+
+def param_groups_weight_decay(
+        model: nnx.Module,
+        weight_decay: float = 1e-5,
+        no_weight_decay_list: Tuple[str, ...] = (),
+):
+    """Boolean WD mask: False for 1-d params / bias / listed names
+    (reference _param_groups.py:19)."""
+    no_decay = set(no_weight_decay_list)
+    if hasattr(model, 'no_weight_decay'):
+        no_decay |= set(model.no_weight_decay())
+
+    def decide(name, value):
+        if value is None or not hasattr(value, 'ndim'):
+            return False
+        if value.ndim <= 1 or name.endswith('.bias') or _matches_no_decay(name, no_decay):
+            return False
+        return True
+
+    return _tree_from_name_fn(model, decide)
+
+
+def auto_group_layers(model: nnx.Module, group_matcher=None, reverse: bool = True):
+    """name → layer-id mapping from the model's group_matcher."""
+    if group_matcher is None:
+        group_matcher = model.group_matcher(coarse=False)
+    return group_with_matcher(
+        named_parameters(model).items(), group_matcher, return_values=False, reverse=reverse)
+
+
+def param_groups_layer_decay(
+        model: nnx.Module,
+        weight_decay: float = 0.05,
+        no_weight_decay_list: Tuple[str, ...] = (),
+        layer_decay: float = 0.75,
+        end_layer_decay: Optional[float] = None,
+        min_scale: float = 0.0,
+):
+    """Float lr-scale tree via group_matcher layer ids
+    (reference _param_groups.py:113). Returns (scale_tree, wd_mask_tree)."""
+    wd_mask = param_groups_weight_decay(model, weight_decay, no_weight_decay_list)
+
+    param_to_layer = auto_group_layers(model, reverse=True)
+    num_layers = max(param_to_layer.values()) + 1 if param_to_layer else 1
+    layer_scales = [max(layer_decay ** (num_layers - i), min_scale) for i in range(num_layers + 1)]
+
+    def scale(name, value):
+        lid = param_to_layer.get(name, num_layers)
+        return layer_scales[lid]
+
+    scale_tree = _tree_from_name_fn(model, scale)
+    return scale_tree, wd_mask

@@ -1,0 +1,26 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX initializes.
+
+(The axon TPU plugin registers itself via sitecustomize and wins over
+JAX_PLATFORMS env, so the platform must be pinned via jax.config here.)
+"""
+import os
+
+os.environ.setdefault('XLA_FLAGS', '--xla_force_host_platform_device_count=8')
+
+import jax
+
+try:
+    jax.config.update('jax_platforms', 'cpu')
+    jax.config.update('jax_num_cpu_devices', 8)
+except Exception:
+    pass
+
+import pytest
+
+
+@pytest.fixture(scope='session')
+def mesh8():
+    from timm_tpu.parallel import create_mesh, set_global_mesh
+    mesh = create_mesh()
+    set_global_mesh(mesh)
+    return mesh

@@ -1,0 +1,155 @@
+"""Layer unit tests (reference: tests/test_layers.py, test_layers_drop.py,
+test_layers_pool.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+from timm_tpu.layers import (
+    Attention, DropPath, LayerScale, Mlp, PatchEmbed, SelectAdaptivePool2d,
+    calculate_drop_path_rates, get_act_fn, get_norm_layer, global_pool_nlc,
+    resample_abs_pos_embed,
+)
+
+
+def test_act_factory():
+    for name in ('relu', 'gelu', 'silu', 'hard_swish', 'mish', 'quick_gelu', 'gelu_tanh'):
+        fn = get_act_fn(name)
+        out = fn(jnp.asarray([-1.0, 0.0, 1.0]))
+        assert out.shape == (3,)
+    assert get_act_fn(None) is None
+    with pytest.raises(ValueError):
+        get_act_fn('bogus')
+
+
+def test_norm_factory():
+    rngs = nnx.Rngs(0)
+    for name in ('layernorm', 'rmsnorm', 'groupnorm', 'batchnorm2d', 'simplenorm'):
+        cls = get_norm_layer(name)
+        layer = cls(64, rngs=rngs)
+        out = layer(jnp.ones((2, 4, 4, 64)))
+        assert out.shape == (2, 4, 4, 64)
+
+
+def test_attention_shapes_and_mask():
+    rngs = nnx.Rngs(0)
+    attn = Attention(64, num_heads=4, qkv_bias=True, rngs=rngs)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 10, 64), jnp.float32)
+    out = attn(x)
+    assert out.shape == (2, 10, 64)
+    # boolean mask: masked key contributes nothing
+    mask = jnp.ones((2, 1, 10, 10), bool).at[:, :, :, -1].set(False)
+    out_masked = attn(x, attn_mask=mask)
+    x_zeroed = x.at[:, -1].set(1e9)  # huge value in masked slot must not leak
+    attn_out2 = attn(x_zeroed, attn_mask=mask)
+    assert bool(jnp.allclose(out_masked[:, :-1], attn_out2[:, :-1], atol=1e-3))
+
+
+def test_attention_qk_norm():
+    from timm_tpu.layers import LayerNorm
+    rngs = nnx.Rngs(0)
+    attn = Attention(64, num_heads=4, qk_norm=True, norm_layer=LayerNorm, rngs=rngs)
+    assert attn(jnp.ones((1, 5, 64))).shape == (1, 5, 64)
+
+
+def test_drop_path_stats():
+    rngs = nnx.Rngs(dropout=0)
+    dp = DropPath(0.5, rngs=rngs)
+    dp.train()
+    x = jnp.ones((512, 4))
+    out = dp(x)
+    kept = float((out[:, 0] != 0).mean())
+    assert 0.35 < kept < 0.65  # ~keep_prob
+    # kept rows scaled by 1/keep_prob
+    nz = np.asarray(out[out[:, 0] != 0])
+    assert np.allclose(nz, 2.0)
+    dp.eval()
+    assert bool(jnp.allclose(dp(x), x))
+
+
+def test_drop_path_rates():
+    rates = calculate_drop_path_rates(0.3, 4)
+    assert rates[0] == 0.0 and rates[-1] == pytest.approx(0.3)
+    stage = calculate_drop_path_rates(0.3, [2, 2], stagewise=True)
+    assert len(stage) == 2 and stage[1][1] == pytest.approx(0.3)
+
+
+def test_patch_embed():
+    rngs = nnx.Rngs(0)
+    pe = PatchEmbed(img_size=32, patch_size=8, in_chans=3, embed_dim=64, rngs=rngs)
+    out = pe(jnp.ones((2, 32, 32, 3)))
+    assert out.shape == (2, 16, 64)
+    assert pe.grid_size == (4, 4)
+    pe2 = PatchEmbed(img_size=None, patch_size=8, embed_dim=64, flatten=False, rngs=rngs)
+    assert pe2(jnp.ones((2, 40, 32, 3))).shape == (2, 5, 4, 64)
+
+
+def test_pos_embed_resample():
+    pe = jnp.asarray(np.random.RandomState(0).randn(1, 17, 8), jnp.float32)  # 4x4 + cls
+    out = resample_abs_pos_embed(pe, new_size=(8, 8), num_prefix_tokens=1)
+    assert out.shape == (1, 65, 8)
+    assert bool(jnp.allclose(out[:, 0], pe[:, 0]))  # prefix untouched
+    # non-square same-count must NOT no-op
+    out2 = resample_abs_pos_embed(pe, new_size=(2, 8), num_prefix_tokens=1)
+    assert out2.shape == (1, 17, 8)
+    assert not bool(jnp.allclose(out2[:, 1:], pe[:, 1:]))
+
+
+def test_pooling():
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 9, 16), jnp.float32)
+    assert global_pool_nlc(x, 'token').shape == (2, 16)
+    assert bool(jnp.allclose(global_pool_nlc(x, 'avg', num_prefix_tokens=1), x[:, 1:].mean(1)))
+    assert bool(jnp.allclose(global_pool_nlc(x, 'max', num_prefix_tokens=0), x.max(1)))
+    g = jnp.asarray(np.random.RandomState(1).randn(2, 4, 4, 16), jnp.float32)
+    assert SelectAdaptivePool2d(pool_type='avg')(g).shape == (2, 16)
+    assert SelectAdaptivePool2d(pool_type='catavgmax')(g).shape == (2, 32)
+    assert SelectAdaptivePool2d(pool_type='')(g).shape == g.shape
+
+
+def test_mlp_variants():
+    from timm_tpu.layers import GluMlp, SwiGLU
+    rngs = nnx.Rngs(0)
+    x = jnp.ones((2, 5, 32))
+    assert Mlp(32, 64, rngs=rngs)(x).shape == (2, 5, 32)
+    assert GluMlp(32, 64, rngs=rngs)(x).shape == (2, 5, 32)
+    assert SwiGLU(32, 64, rngs=rngs)(x).shape == (2, 5, 32)
+
+
+def test_layer_scale():
+    ls = LayerScale(16, init_values=1e-4, rngs=nnx.Rngs(0))
+    x = jnp.ones((2, 3, 16))
+    assert bool(jnp.allclose(ls(x), x * 1e-4))
+
+
+def test_sincos_pos_embed():
+    from timm_tpu.layers import build_sincos2d_pos_embed
+    emb = build_sincos2d_pos_embed((4, 4), dim=64)
+    assert emb.shape == (16, 64)
+    assert bool(jnp.isfinite(emb).all())
+
+
+def test_rotary_embed():
+    from timm_tpu.layers import RotaryEmbeddingCat
+    from timm_tpu.layers.attention import apply_rot_embed_cat
+    rope = RotaryEmbeddingCat(32, in_pixels=False, feat_shape=(4, 4))
+    emb = rope.get_embed()
+    assert emb.shape == (16, 64)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 4, 16, 32), jnp.float32)
+    out = apply_rot_embed_cat(x, emb)
+    assert out.shape == x.shape
+    # norm-preserving
+    assert bool(jnp.allclose(jnp.linalg.norm(out, axis=-1), jnp.linalg.norm(x, axis=-1), atol=1e-3))
+
+
+def test_clip_grads():
+    from timm_tpu.utils import adaptive_clip_grad, clip_grad_norm, clip_grad_value
+    grads = {'a': jnp.full((4, 4), 10.0), 'b': jnp.full((4,), -10.0)}
+    clipped, norm = clip_grad_norm(grads, 1.0)
+    from timm_tpu.utils import global_grad_norm
+    assert float(global_grad_norm(clipped)) == pytest.approx(1.0, abs=1e-3)
+    clipped, _ = clip_grad_value(grads, 0.5)
+    assert float(jnp.max(jnp.abs(clipped['a']))) == 0.5
+    params = {'a': jnp.ones((4, 4)), 'b': jnp.ones((4,))}
+    agc = adaptive_clip_grad(params, grads, clip_factor=0.01)
+    assert float(jnp.abs(jax.tree.leaves(agc)[0]).max()) < 10.0

@@ -1,0 +1,166 @@
+"""Model zoo tests (reference: tests/test_models.py — forward/backward/cfg
+consistency/features parametrized over the registry)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+import timm_tpu
+from timm_tpu.models import list_models, get_pretrained_cfg
+
+# size-capped like the reference (_get_input_size, EXCLUDE filters :79-113)
+EXCLUDE_FILTERS = ['*_large*', '*_huge*', '*so400m*', '*_384', '*_giant*', '*_gigantic*', '*_xlarge*']
+TEST_MODELS = list_models(exclude_filters=EXCLUDE_FILTERS)
+FWD_SIZE = 64
+
+
+def _create_small(model_name, **kwargs):
+    cfg = get_pretrained_cfg(model_name)
+    fixed = cfg is not None and cfg.fixed_input_size
+    try:
+        return timm_tpu.create_model(model_name, img_size=FWD_SIZE, num_classes=10, **kwargs), FWD_SIZE
+    except TypeError:
+        return timm_tpu.create_model(model_name, num_classes=10, **kwargs), (cfg.input_size[-1] if cfg else 224)
+
+
+@pytest.mark.base
+@pytest.mark.parametrize('model_name', TEST_MODELS)
+def test_model_forward(model_name):
+    model, size = _create_small(model_name)
+    model.eval()
+    x = jnp.asarray(np.random.rand(2, size, size, 3), jnp.float32)
+    out = model(x)
+    assert out.shape == (2, 10)
+    assert bool(jnp.isfinite(out).all()), 'Output contains NaN/Inf'
+
+
+@pytest.mark.base
+@pytest.mark.parametrize('model_name', list_models('test_*'))
+def test_model_backward(model_name):
+    model, size = _create_small(model_name)
+    model.train()
+    x = jnp.asarray(np.random.rand(2, size, size, 3), jnp.float32)
+    t = jnp.asarray([0, 1])
+
+    def loss_fn(model):
+        out = model(x)
+        return jnp.mean((out - jax.nn.one_hot(t, out.shape[-1])) ** 2)
+
+    grads = nnx.grad(loss_fn)(model)
+    num_params = len(jax.tree.leaves(nnx.state(model, nnx.Param)))
+    num_grads = len([g for g in jax.tree.leaves(grads) if g is not None])
+    assert num_params == num_grads, 'Some params missing gradients'
+    for g in jax.tree.leaves(grads):
+        assert bool(jnp.isfinite(g).all()), 'NaN/Inf gradient'
+
+
+@pytest.mark.cfg
+@pytest.mark.parametrize('model_name', TEST_MODELS)
+def test_model_default_cfg(model_name):
+    cfg = get_pretrained_cfg(model_name)
+    if cfg is None:
+        pytest.skip('no pretrained cfg')
+    assert cfg.num_classes > 0
+    assert len(cfg.input_size) == 3
+    assert cfg.classifier is not None
+    assert cfg.first_conv is not None
+
+
+@pytest.mark.cfg
+@pytest.mark.parametrize('model_name', list_models('test_*'))
+def test_model_classifier_reset(model_name):
+    model, size = _create_small(model_name)
+    model.eval()
+    x = jnp.asarray(np.random.rand(1, size, size, 3), jnp.float32)
+    # pre-logits / identity head
+    model.reset_classifier(0)
+    out = model(x)
+    assert out.ndim == 2 and out.shape[-1] == model.num_features
+    # new head size
+    model.reset_classifier(7)
+    assert model(x).shape == (1, 7)
+
+
+@pytest.mark.features
+@pytest.mark.parametrize('model_name', list_models('test_*'))
+def test_model_forward_intermediates(model_name):
+    model, size = _create_small(model_name)
+    model.eval()
+    x = jnp.asarray(np.random.rand(1, size, size, 3), jnp.float32)
+    final, intermediates = model.forward_intermediates(x, indices=2)
+    assert len(intermediates) == 2
+    for feat in intermediates:
+        assert feat.ndim == 4  # NHWC grid
+        assert feat.shape[0] == 1
+    # parity with features_only wrapper
+    wrapped = timm_tpu.create_model(model_name, img_size=size, num_classes=10, features_only=True, out_indices=(0, 1))
+    wrapped.eval()
+    feats = wrapped(x)
+    assert len(feats) == 2
+    assert feats[-1].shape == intermediates[-1].shape
+
+
+@pytest.mark.features
+def test_features_info():
+    model = timm_tpu.create_model('test_vit', features_only=True, out_indices=(0, 1))
+    assert len(model.feature_info.channels()) == 2
+    assert all(c == 64 for c in model.feature_info.channels())
+
+
+@pytest.mark.base
+def test_model_no_weight_decay():
+    model = timm_tpu.create_model('test_vit')
+    nwd = model.no_weight_decay()
+    assert 'pos_embed' in nwd and 'cls_token' in nwd
+
+
+@pytest.mark.base
+def test_model_group_matcher():
+    from timm_tpu.models import group_parameters
+    model = timm_tpu.create_model('test_vit')
+    groups = group_parameters(model, model.group_matcher())
+    # stem group + per-block groups + final-norm merged into last
+    assert len(groups) >= 3
+
+
+@pytest.mark.base
+def test_grad_checkpointing_forward_match():
+    model = timm_tpu.create_model('test_vit', num_classes=10, img_size=FWD_SIZE)
+    model.eval()
+    x = jnp.asarray(np.random.rand(1, FWD_SIZE, FWD_SIZE, 3), jnp.float32)
+    out_ref = model(x)
+    model.set_grad_checkpointing(True)
+    out_ckpt = model(x)
+    assert bool(jnp.allclose(out_ref, out_ckpt, atol=1e-5))
+
+
+@pytest.mark.base
+def test_state_dict_roundtrip(tmp_path):
+    from timm_tpu.models import load_checkpoint, model_state_dict, save_state_dict
+    m1 = timm_tpu.create_model('test_vit', num_classes=10, img_size=FWD_SIZE, seed=0)
+    m2 = timm_tpu.create_model('test_vit', num_classes=10, img_size=FWD_SIZE, seed=99)
+    m1.eval(), m2.eval()
+    x = jnp.asarray(np.random.rand(1, FWD_SIZE, FWD_SIZE, 3), jnp.float32)
+    path = str(tmp_path / 'w.safetensors')
+    save_state_dict(model_state_dict(m1), path)
+    load_checkpoint(m2, path)
+    assert bool(jnp.allclose(m1(x), m2(x), atol=1e-6))
+
+
+@pytest.mark.base
+def test_torch_checkpoint_conversion():
+    torch = pytest.importorskip('torch')
+    from timm_tpu.models._torch_convert import convert_torch_state_dict
+    sd = {
+        'head.weight': torch.zeros(10, 64).numpy(),
+        'head.bias': torch.zeros(10).numpy(),
+        'patch_embed.proj.weight': torch.zeros(64, 3, 16, 16).numpy(),
+        'norm.weight': torch.ones(64).numpy(),
+        'bn.running_mean': torch.zeros(64).numpy(),
+    }
+    out = convert_torch_state_dict(sd)
+    assert out['head.kernel'].shape == (64, 10)
+    assert out['patch_embed.proj.kernel'].shape == (16, 16, 3, 64)
+    assert 'norm.scale' in out
+    assert 'bn.mean' in out

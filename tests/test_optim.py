@@ -1,0 +1,128 @@
+"""Optimizer tests (reference: tests/test_optim.py — registry construction,
+convergence on a toy problem, layer-decay grouping, caution variants)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import nnx
+
+import timm_tpu
+from timm_tpu.optim import create_optimizer_v2, list_optimizers, param_groups_weight_decay
+
+ALL_OPTS = [o for o in list_optimizers() if o != 'lookahead']
+
+
+class Toy(nnx.Module):
+    def __init__(self, rngs):
+        self.fc1 = nnx.Linear(4, 8, rngs=rngs)
+        self.fc2 = nnx.Linear(8, 2, rngs=rngs)
+
+    def __call__(self, x):
+        return self.fc2(jax.nn.relu(self.fc1(x)))
+
+
+def _toy_problem():
+    model = Toy(nnx.Rngs(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(32, 4), jnp.float32)
+    w = jnp.asarray(np.random.RandomState(1).randn(4, 2), jnp.float32)
+    y = x @ w
+    return model, x, y
+
+
+@pytest.mark.parametrize('opt_name', ALL_OPTS)
+def test_optimizer_step(opt_name):
+    model, x, y = _toy_problem()
+    opt = create_optimizer_v2(model, opt=opt_name, lr=1e-2, weight_decay=0.01)
+    params = nnx.state(model, nnx.Param)
+    state = opt.init(params)
+
+    def loss_fn(p):
+        m = nnx.merge(nnx.graphdef(model), p)
+        return jnp.mean((m(x) - y) ** 2)
+
+    # two steps: some optimizers (ADOPT) only initialize state on step one
+    for _ in range(2):
+        loss0, grads = jax.value_and_grad(loss_fn)(params)
+        updates, state = opt.update(grads, state, params, lr=1e-2)
+        params = optax.apply_updates(params, updates)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(nnx.state(model, nnx.Param)), jax.tree.leaves(params)))
+
+
+@pytest.mark.parametrize('opt_name', ['sgd', 'adamw', 'lamb', 'lion', 'muon', 'nadamw', 'adopt'])
+def test_optimizer_converges(opt_name):
+    model, x, y = _toy_problem()
+    opt = create_optimizer_v2(model, opt=opt_name, lr=5e-2, weight_decay=0.0)
+    params = nnx.state(model, nnx.Param)
+    state = opt.init(params)
+    graphdef = nnx.graphdef(model)
+
+    @jax.jit
+    def step(params, state):
+        def loss_fn(p):
+            m = nnx.merge(graphdef, p)
+            return jnp.mean((m(x) - y) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, state = opt.update(grads, state, params, lr=5e-2)
+        return optax.apply_updates(params, updates), state, loss
+
+    losses = []
+    for _ in range(50):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, f'{opt_name} failed to reduce loss: {losses[0]} -> {losses[-1]}'
+
+
+def _flat_values(tree):
+    from timm_tpu.utils.serialization import _kp_str
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {_kp_str(kp): v for kp, v in flat}
+
+
+def test_weight_decay_mask():
+    model = timm_tpu.create_model('test_vit', num_classes=10, img_size=64)
+    mask = param_groups_weight_decay(model, weight_decay=0.05)
+    flat = _flat_values(mask)
+    assert flat['cls_token'] == False  # noqa: E712
+    assert flat['pos_embed'] == False  # noqa: E712
+    assert flat['blocks.0.attn.qkv.bias'] == False  # noqa: E712
+    assert flat['blocks.0.attn.qkv.kernel'] == True  # noqa: E712
+
+
+def test_layer_decay_scales():
+    from timm_tpu.optim import param_groups_layer_decay
+    model = timm_tpu.create_model('test_vit', num_classes=10, img_size=64)
+    scales, mask = param_groups_layer_decay(model, layer_decay=0.5)
+    flat = _flat_values(scales)
+    # stem gets smallest scale, head largest
+    assert flat['patch_embed.proj.kernel'] < flat['blocks.1.attn.qkv.kernel']
+    assert flat['head.kernel'] == 1.0
+
+
+def test_caution_masks_disagreeing_updates():
+    model, x, y = _toy_problem()
+    opt = create_optimizer_v2(model, opt='sgd', lr=1e-2, momentum=0.0, caution=True)
+    params = nnx.state(model, nnx.Param)
+    state = opt.init(params)
+
+    def loss_fn(p):
+        m = nnx.merge(nnx.graphdef(model), p)
+        return jnp.mean((m(x) - y) ** 2)
+
+    _, grads = jax.value_and_grad(loss_fn)(params)
+    updates, _ = opt.update(grads, state, params, lr=1e-2)
+    # plain SGD update = -lr*g, always sign-disagreeing with g → never masked
+    for u, g in zip(jax.tree.leaves(updates), jax.tree.leaves(grads)):
+        assert bool(jnp.all((np.asarray(u) == 0) | (np.sign(u) != np.sign(g))))
+
+
+def test_optimizer_kwargs_bridge():
+    from types import SimpleNamespace
+    from timm_tpu.optim import optimizer_kwargs
+    cfg = SimpleNamespace(opt='adamw', lr=1e-3, weight_decay=0.05, momentum=0.9,
+                          opt_eps=1e-8, opt_betas=(0.9, 0.95), layer_decay=0.75,
+                          layer_decay_min_scale=None, opt_kwargs={}, opt_caution=False)
+    kw = optimizer_kwargs(cfg)
+    assert kw['opt'] == 'adamw' and kw['betas'] == (0.9, 0.95) and kw['layer_decay'] == 0.75

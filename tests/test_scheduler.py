@@ -1,0 +1,85 @@
+"""Scheduler tests (reference: tests/test_scheduler.py — warmup, cycles,
+noise determinism, k-decay, state_dict round-trip, per-update stepping)."""
+import math
+
+import pytest
+
+from timm_tpu.scheduler import (
+    CosineLRScheduler, MultiStepLRScheduler, PlateauLRScheduler, PolyLRScheduler,
+    StepLRScheduler, TanhLRScheduler, create_scheduler_v2,
+)
+
+
+def test_cosine_warmup_and_decay():
+    sch = CosineLRScheduler(1.0, t_initial=10, warmup_t=2, warmup_lr_init=0.1, lr_min=0.0)
+    lrs = [sch.step(t)[0] for t in range(10)]
+    assert lrs[0] == pytest.approx(0.1)
+    assert lrs[1] == pytest.approx(0.55)
+    assert lrs[2] == pytest.approx(1.0 * 0.5 * (1 + math.cos(math.pi * 2 / 10)))
+    assert lrs[-1] < lrs[2]
+
+
+def test_cosine_cycles():
+    sch = CosineLRScheduler(1.0, t_initial=5, cycle_limit=2, cycle_decay=0.5)
+    lrs = [sch.step(t)[0] for t in range(10)]
+    assert lrs[0] == pytest.approx(1.0)
+    assert lrs[5] == pytest.approx(0.5)  # second cycle peak decayed
+
+
+def test_cosine_k_decay():
+    sch1 = CosineLRScheduler(1.0, t_initial=10, k_decay=1.0)
+    sch2 = CosineLRScheduler(1.0, t_initial=10, k_decay=2.0)
+    # higher k decays slower early
+    assert sch2.step(3)[0] > sch1.step(3)[0]
+
+
+def test_per_update_stepping():
+    sch = CosineLRScheduler(1.0, t_initial=100, t_in_epochs=False)
+    lr_epoch = sch.step(5)
+    assert lr_epoch == sch.get_last_lr()  # epoch stepping inert
+    lr_up = sch.step_update(50)[0]
+    assert lr_up == pytest.approx(0.5, abs=1e-2)
+
+
+def test_noise_determinism():
+    a = CosineLRScheduler(1.0, t_initial=10, noise_range_t=0, noise_seed=7)
+    b = CosineLRScheduler(1.0, t_initial=10, noise_range_t=0, noise_seed=7)
+    for t in range(10):
+        assert a.step(t) == b.step(t)
+
+
+def test_state_dict_roundtrip():
+    a = PlateauLRScheduler(1.0, decay_rate=0.5, patience_t=1)
+    for e in range(5):
+        a.step(e, metric=1.0)  # no improvement → decays
+    sd = a.state_dict()
+    b = PlateauLRScheduler(1.0)
+    b.load_state_dict(sd)
+    assert b.step(6, metric=1.0) == a.step(6, metric=1.0)
+
+
+def test_plateau_decays_on_stall():
+    sch = PlateauLRScheduler(1.0, decay_rate=0.1, patience_t=2, warmup_t=0, mode='max')
+    lrs = [sch.step(e, metric=0.5)[0] for e in range(8)]
+    assert lrs[0] == 1.0
+    assert lrs[-1] < 1.0
+
+
+def test_step_multistep_poly_tanh():
+    s = StepLRScheduler(1.0, decay_t=2, decay_rate=0.5, warmup_t=0)
+    assert s.step(0)[0] == 1.0 and s.step(2)[0] == 0.5 and s.step(4)[0] == 0.25
+    m = MultiStepLRScheduler(1.0, decay_t=[2, 4], decay_rate=0.1, warmup_t=0)
+    assert m.step(0)[0] == 1.0 and m.step(2)[0] == pytest.approx(0.1) and m.step(4)[0] == pytest.approx(0.01)
+    p = PolyLRScheduler(1.0, t_initial=10, power=1.0, warmup_t=0)
+    assert p.step(5)[0] == pytest.approx(0.5)
+    t = TanhLRScheduler(1.0, t_initial=10, warmup_t=0)
+    assert t.step(9)[0] < 0.1
+
+
+def test_factory():
+    sch, n = create_scheduler_v2(base_lr=0.1, sched='cosine', num_epochs=10, warmup_epochs=2, cooldown_epochs=3)
+    assert n == 13
+    sch, n = create_scheduler_v2(base_lr=0.1, sched='cosine', num_epochs=10, step_on_epochs=False, updates_per_epoch=100)
+    assert sch.step_update(500)[0] == pytest.approx(0.05, abs=1e-3)
+    with pytest.raises(ValueError):
+        create_scheduler_v2(sched='bogus')

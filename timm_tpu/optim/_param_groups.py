@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, Optional, Set, Tuple
 from flax import nnx
 
 from ..models._manipulate import group_with_matcher, named_parameters
+from ..utils.serialization import _kp_str as _keypath_str
 
 _logger = logging.getLogger(__name__)
 
@@ -36,16 +37,7 @@ def _tree_from_name_fn(model: nnx.Module, fn: Callable[[str, Any], Any]):
         lambda kp, v: fn(_keypath_str(kp), v), state)
 
 
-def _keypath_str(kp) -> str:
-    parts = []
-    for p in kp:
-        if hasattr(p, 'key'):
-            parts.append(str(p.key))
-        elif hasattr(p, 'idx'):
-            parts.append(str(p.idx))
-        else:
-            parts.append(str(p))
-    return '.'.join(parts)
+
 
 
 def param_groups_weight_decay(
@@ -82,7 +74,6 @@ def param_groups_layer_decay(
         weight_decay: float = 0.05,
         no_weight_decay_list: Tuple[str, ...] = (),
         layer_decay: float = 0.75,
-        end_layer_decay: Optional[float] = None,
         min_scale: float = 0.0,
 ):
     """Float lr-scale tree via group_matcher layer ids
@@ -91,10 +82,11 @@ def param_groups_layer_decay(
 
     param_to_layer = auto_group_layers(model, reverse=True)
     num_layers = max(param_to_layer.values()) + 1 if param_to_layer else 1
-    layer_scales = [max(layer_decay ** (num_layers - i), min_scale) for i in range(num_layers + 1)]
+    layer_max = num_layers - 1
+    layer_scales = [max(layer_decay ** (layer_max - i), min_scale) for i in range(num_layers)]
 
     def scale(name, value):
-        lid = param_to_layer.get(name, num_layers)
+        lid = param_to_layer.get(name, layer_max)
         return layer_scales[lid]
 
     scale_tree = _tree_from_name_fn(model, scale)

@@ -1,0 +1,92 @@
+"""Multi-host initialization + rank utilities
+(reference: timm/utils/distributed.py:17-159).
+
+The reference builds a torch.distributed process group (NCCL/gloo) from
+torchrun/SLURM env vars. On TPU pods the equivalent is
+`jax.distributed.initialize()` (one process per host), after which
+`jax.devices()` spans the pod and collectives are emitted by XLA — there is
+no explicit communication backend to select.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ['is_distributed_env', 'init_distributed_device', 'world_info', 'is_primary', 'reduce_tensor']
+
+_INITIALIZED = False
+
+
+def is_distributed_env() -> bool:
+    """Detect a multi-host launch (JAX coordinator / SLURM / OpenMPI vars)."""
+    for var in ('COORDINATOR_ADDRESS', 'JAX_COORDINATOR_ADDRESS'):
+        if os.environ.get(var):
+            return True
+    if os.environ.get('SLURM_NTASKS') and int(os.environ['SLURM_NTASKS']) > 1:
+        return True
+    if os.environ.get('OMPI_COMM_WORLD_SIZE') and int(os.environ['OMPI_COMM_WORLD_SIZE']) > 1:
+        return True
+    return False
+
+
+def init_distributed_device(args=None) -> Tuple[int, int, int]:
+    """Initialize multi-host JAX if needed; returns (world_size, global_rank,
+    local_rank) in *process* terms. Mirrors the reference contract of
+    init_distributed_device(args) mutating args.{distributed,world_size,rank,local_rank}.
+    """
+    global _INITIALIZED
+    if is_distributed_env() and not _INITIALIZED:
+        coord = os.environ.get('COORDINATOR_ADDRESS') or os.environ.get('JAX_COORDINATOR_ADDRESS')
+        kwargs = {}
+        if coord:
+            kwargs['coordinator_address'] = coord
+            if os.environ.get('NUM_PROCESSES'):
+                kwargs['num_processes'] = int(os.environ['NUM_PROCESSES'])
+            if os.environ.get('PROCESS_ID'):
+                kwargs['process_id'] = int(os.environ['PROCESS_ID'])
+        jax.distributed.initialize(**kwargs)
+        _INITIALIZED = True
+        _logger.info(f'Initialized multi-host JAX: process {jax.process_index()}/{jax.process_count()}')
+
+    world_size = jax.process_count()
+    rank = jax.process_index()
+    local_rank = 0
+    if args is not None:
+        args.distributed = world_size > 1
+        args.world_size = world_size
+        args.rank = rank
+        args.local_rank = local_rank
+        args.device = str(jax.devices()[0]).lower()
+    return world_size, rank, local_rank
+
+
+def world_info() -> Tuple[int, int]:
+    return jax.process_count(), jax.process_index()
+
+
+def is_primary(args=None) -> bool:
+    return jax.process_index() == 0
+
+
+def reduce_tensor(tensor, n: Optional[int] = None):
+    """Mean across data-parallel replicas (reference utils/distributed.py:17).
+
+    Under pjit, per-step metrics computed from a globally-sharded batch are
+    already global — this is the identity then. It exists for API parity and
+    for host-local values: a host-local numpy value is averaged across
+    processes via a tiny all-reduce.
+    """
+    import numpy as np
+    if isinstance(tensor, (int, float)) or (hasattr(tensor, 'ndim') and not isinstance(tensor, jax.Array)):
+        if jax.process_count() == 1:
+            return tensor
+        from jax.experimental import multihost_utils
+        val = multihost_utils.process_allgather(jnp.asarray(tensor))
+        return np.asarray(val).mean()
+    return tensor

@@ -1,0 +1,3 @@
+from .classification import ClassificationTask
+from .distillation import FeatureDistillationTask, LogitDistillationTask
+from .task import TrainingTask

@@ -1,0 +1,105 @@
+"""Distillation tasks (reference: timm/task/distillation.py).
+
+The frozen teacher's (graphdef, state) is closed over by the jitted step; it
+runs in eval mode inside the same XLA program as the student forward.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..loss import LabelSmoothingCrossEntropy
+from .task import TrainingTask
+
+__all__ = ['LogitDistillationTask', 'FeatureDistillationTask']
+
+
+class LogitDistillationTask(TrainingTask):
+    """KL(student_T || teacher_T) * T^2 blended with CE
+    (reference distillation.py LogitDistillationTask)."""
+
+    def __init__(
+            self,
+            model: nnx.Module,
+            teacher: nnx.Module,
+            optimizer=None,
+            train_loss_fn: Optional[Callable] = None,
+            distill_alpha: float = 0.5,
+            distill_temperature: float = 1.0,
+            **kwargs,
+    ):
+        super().__init__(model, optimizer=optimizer, **kwargs)
+        teacher.eval()
+        self._teacher_graphdef, self._teacher_state = nnx.split(teacher)
+        self.train_loss_fn = train_loss_fn or LabelSmoothingCrossEntropy(0.0)
+        self.alpha = distill_alpha
+        self.temperature = distill_temperature
+
+    def loss_forward(self, model: nnx.Module, batch: Dict[str, Any]):
+        x = batch['input']
+        output = model(x)
+        teacher = nnx.merge(self._teacher_graphdef, self._teacher_state)
+        teacher_logits = jax.lax.stop_gradient(teacher(x))
+
+        base_loss = self.train_loss_fn(output, batch['target'])
+        T = self.temperature
+        s = jax.nn.log_softmax(output.astype(jnp.float32) / T, axis=-1)
+        t = jax.nn.softmax(teacher_logits.astype(jnp.float32) / T, axis=-1)
+        kd = (t * (jnp.log(jnp.clip(t, 1e-9)) - s)).sum(axis=-1).mean() * (T * T)
+        loss = (1.0 - self.alpha) * base_loss + self.alpha * kd
+        return loss, output
+
+
+class FeatureDistillationTask(TrainingTask):
+    """Match intermediate features to a teacher via a learned projection
+    (reference distillation.py FeatureDistillationTask). The projection params
+    live in task_state and persist through checkpoints."""
+
+    def __init__(
+            self,
+            model: nnx.Module,
+            teacher: nnx.Module,
+            optimizer=None,
+            train_loss_fn: Optional[Callable] = None,
+            distill_alpha: float = 0.5,
+            feat_loss: str = 'cosine',
+            **kwargs,
+    ):
+        # projection must exist before the optimizer state is built
+        student_dim = getattr(model, 'num_features')
+        teacher_dim = getattr(teacher, 'num_features')
+        if student_dim != teacher_dim:
+            model.distill_proj = nnx.Linear(student_dim, teacher_dim, rngs=nnx.Rngs(0))
+        super().__init__(model, optimizer=optimizer, **kwargs)
+        teacher.eval()
+        self._teacher_graphdef, self._teacher_state = nnx.split(teacher)
+        self.train_loss_fn = train_loss_fn or LabelSmoothingCrossEntropy(0.0)
+        self.alpha = distill_alpha
+        self.feat_loss = feat_loss
+
+    def loss_forward(self, model: nnx.Module, batch: Dict[str, Any]):
+        x = batch['input']
+        feats = model.forward_features(x)
+        output = model.forward_head(feats)
+        teacher = nnx.merge(self._teacher_graphdef, self._teacher_state)
+        t_feats = jax.lax.stop_gradient(teacher.forward_features(x))
+
+        s_pool = feats.mean(axis=1) if feats.ndim == 3 else feats.mean(axis=(1, 2))
+        t_pool = t_feats.mean(axis=1) if t_feats.ndim == 3 else t_feats.mean(axis=(1, 2))
+        if hasattr(model, 'distill_proj'):
+            s_pool = model.distill_proj(s_pool)
+        s_pool = s_pool.astype(jnp.float32)
+        t_pool = t_pool.astype(jnp.float32)
+        if self.feat_loss == 'cosine':
+            sn = s_pool / (jnp.linalg.norm(s_pool, axis=-1, keepdims=True) + 1e-6)
+            tn = t_pool / (jnp.linalg.norm(t_pool, axis=-1, keepdims=True) + 1e-6)
+            kd = (1.0 - (sn * tn).sum(axis=-1)).mean()
+        else:  # mse
+            kd = jnp.mean(jnp.square(s_pool - t_pool))
+
+        base_loss = self.train_loss_fn(output, batch['target'])
+        loss = (1.0 - self.alpha) * base_loss + self.alpha * kd
+        return loss, output

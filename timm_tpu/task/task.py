@@ -1,0 +1,214 @@
+"""Training task abstraction (reference: timm/task/task.py:17-231).
+
+The task owns the model, optimizer, EMA and — unlike the torch reference —
+the **jitted train/eval step functions**. Design:
+
+  * one `nnx.jit` step covers forward+backward+clip+optimizer+EMA; nnx lifts
+    the module's variables (params, batch stats, RNG stream counters) in and
+    out of the compiled program, so RNG-consuming layers (dropout, drop-path)
+    work under grad without manual state plumbing.
+  * the reference's AMP scaler (utils/cuda.py:46) is unnecessary — bf16
+    compute is native on TPU and fp32 master params are the default.
+  * DDP wrap / no_sync (task.py:222, classification.py:64) have no analogue:
+    the batch is sharded over the mesh ('data' axis), params are replicated,
+    and XLA emits the gradient all-reduce over ICI.
+  * grad accumulation unrolls microbatches inside the same compiled step.
+"""
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import nnx
+
+from ..optim import Optimizer
+from ..parallel import get_global_mesh, replicate_sharding
+from ..utils.clip_grad import dispatch_clip_grad, global_grad_norm
+from ..utils.model_ema import ModelEmaV3, ema_update
+from ..utils.serialization import flatten_pytree, unflatten_into
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ['TrainingTask']
+
+
+class TrainingTask:
+    def __init__(
+            self,
+            model: nnx.Module,
+            optimizer: Optional[Optimizer] = None,
+            mesh=None,
+            grad_accum_steps: int = 1,
+            clip_grad: Optional[float] = None,
+            clip_mode: str = 'norm',
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh or get_global_mesh()
+        self.grad_accum_steps = max(1, grad_accum_steps)
+        self.clip_grad = clip_grad
+        self.clip_mode = clip_mode
+
+        # replicate model + optimizer state over the mesh
+        rep = replicate_sharding(self.mesh)
+        state = nnx.state(model)
+        nnx.update(model, jax.device_put(state, rep))
+        if self.optimizer is not None:
+            self.opt_state = jax.device_put(self.optimizer.init(nnx.state(model, nnx.Param)), rep)
+        else:
+            self.opt_state = None
+
+        self.ema: Optional[ModelEmaV3] = None
+        self.ema_params = None
+        self._train_step = None
+        self._eval_step = None
+        self.compiled = False  # jit is always on; flag kept for API parity
+
+    # -- overridables --------------------------------------------------------
+    def loss_forward(self, model: nnx.Module, batch: Dict[str, Any]):
+        """Return (loss, output). Subclasses implement the objective."""
+        raise NotImplementedError
+
+    def eval_forward(self, model: nnx.Module, batch: Dict[str, Any]):
+        return model(batch['input'])
+
+    # -- setup ---------------------------------------------------------------
+    def setup_ema(self, decay: float = 0.9999, warmup: bool = False, **kwargs):
+        """(reference task.py:110)."""
+        self.ema = ModelEmaV3(decay=decay, use_warmup=warmup, **kwargs)
+        self.ema_params = jax.tree.map(jnp.asarray, nnx.state(self.model, nnx.Param))
+
+    def compile(self, backend: str = ''):
+        self.compiled = True  # parity no-op; nnx.jit is always on (task.py:90)
+
+    def prepare_distributed(self):
+        return self  # sharded-batch DP needs no wrapping; parity (classification.py:64)
+
+    # -- jitted steps ----------------------------------------------------------
+    def _build_train_step(self):
+        optimizer = self.optimizer
+        accum = self.grad_accum_steps
+        clip_grad, clip_mode = self.clip_grad, self.clip_mode
+        has_ema = self.ema_params is not None
+        loss_forward = self.loss_forward
+
+        @nnx.jit
+        def train_step(model, opt_state, ema_params, batch, lr, ema_decay):
+            def loss_fn(model, mb):
+                loss, _output = loss_forward(model, mb)
+                return loss.astype(jnp.float32)
+
+            if accum > 1:
+                microbatches = jax.tree.map(
+                    lambda x: x.reshape(accum, -1, *x.shape[1:]), batch)
+                loss = jnp.zeros((), jnp.float32)
+                grads = None
+                for i in range(accum):
+                    mb = jax.tree.map(lambda x: x[i], microbatches)
+                    l_i, g_i = nnx.value_and_grad(loss_fn)(model, mb)
+                    loss = loss + l_i
+                    grads = g_i if grads is None else jax.tree.map(jnp.add, grads, g_i)
+                loss = loss / accum
+                grads = jax.tree.map(lambda g: g / accum, grads)
+            else:
+                loss, grads = nnx.value_and_grad(loss_fn)(model, batch)
+
+            grad_norm = global_grad_norm(grads)
+            if clip_grad is not None:
+                params_for_clip = nnx.state(model, nnx.Param) if clip_mode == 'agc' else None
+                grads, _ = dispatch_clip_grad(grads, clip_grad, mode=clip_mode, params=params_for_clip)
+
+            params = nnx.state(model, nnx.Param)
+            updates, opt_state = optimizer.update(grads, opt_state, params, lr=lr)
+            params = optax.apply_updates(params, updates)
+            nnx.update(model, params)
+
+            if has_ema:
+                ema_params = jax.lax.cond(
+                    ema_decay > 0.0,
+                    lambda e: ema_update(e, params, ema_decay),
+                    lambda e: e,
+                    ema_params,
+                )
+            metrics = {'loss': loss, 'grad_norm': grad_norm}
+            return opt_state, ema_params, metrics
+
+        return train_step
+
+    def _build_eval_step(self):
+        eval_forward = self.eval_forward
+
+        @nnx.jit
+        def eval_step(model, batch):
+            return eval_forward(model, batch)
+
+        return eval_step
+
+    # -- public step API -------------------------------------------------------
+    def train_step(self, batch: Dict[str, Any], lr: float, step: int = 0):
+        """One optimization step; `batch['input']` is NHWC, batch dim sharded
+        over the mesh (use parallel.shard_batch)."""
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        self.model.train()
+        ema_decay = self.ema.get_decay(step) if self.ema is not None else 0.0
+        ema_in = self.ema_params if self.ema_params is not None else ()
+        self.opt_state, ema_out, metrics = self._train_step(
+            self.model, self.opt_state, ema_in, batch,
+            jnp.asarray(lr, jnp.float32), jnp.asarray(ema_decay, jnp.float32))
+        if self.ema_params is not None:
+            self.ema_params = ema_out
+        return metrics
+
+    def update_ema(self, step: int):
+        pass  # fused into train_step; parity no-op (task.py update_ema)
+
+    def eval_step(self, batch: Dict[str, Any], use_ema: bool = False):
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        self.model.eval()
+        if use_ema and self.ema_params is not None:
+            train_params = jax.tree.map(jnp.asarray, nnx.state(self.model, nnx.Param))
+            nnx.update(self.model, self.ema_params)
+            out = self._eval_step(self.model, batch)
+            nnx.update(self.model, train_params)
+            return out
+        out = self._eval_step(self.model, batch)
+        self.model.train()
+        return out
+
+    # -- module sync / checkpoint ------------------------------------------------
+    def sync_model(self, use_ema: bool = False) -> nnx.Module:
+        if use_ema and self.ema_params is not None:
+            nnx.update(self.model, self.ema_params)
+        return self.model
+
+    def get_checkpoint_state(self) -> Dict[str, np.ndarray]:
+        """Flat checkpoint dict (schema mirrors reference checkpoint_saver.py:89)."""
+        state = flatten_pytree(nnx.state(self.model, nnx.Param), 'state_dict')
+        if self.ema_params is not None:
+            state.update(flatten_pytree(self.ema_params, 'state_dict_ema'))
+        if self.opt_state is not None:
+            state.update(flatten_pytree(self.opt_state, 'optimizer'))
+        # non-param model variables (e.g. BN stats) minus rng bookkeeping
+        other = nnx.state(self.model, nnx.Not(nnx.Param))
+        flat_other = {k: v for k, v in flatten_pytree(other, 'model_state').items() if 'rngs' not in k}
+        state.update(flat_other)
+        return state
+
+    def load_checkpoint_state(self, state: Dict[str, np.ndarray], strict: bool = True, load_opt: bool = True):
+        params = unflatten_into(nnx.state(self.model, nnx.Param), state, 'state_dict', strict=strict)
+        nnx.update(self.model, params)
+        if self.ema_params is not None and any(k.startswith('state_dict_ema.') for k in state):
+            self.ema_params = unflatten_into(self.ema_params, state, 'state_dict_ema', strict=strict)
+        if load_opt and self.opt_state is not None and any(k.startswith('optimizer.') for k in state):
+            self.opt_state = unflatten_into(self.opt_state, state, 'optimizer', strict=strict)
+        if any(k.startswith('model_state.') for k in state):
+            other = nnx.state(self.model, nnx.Not(nnx.Param))
+            other = unflatten_into(other, state, 'model_state', strict=False)
+            nnx.update(self.model, other)

@@ -1,0 +1,9 @@
+from .checkpoint_saver import CheckpointSaver
+from .clip_grad import adaptive_clip_grad, clip_grad_norm, clip_grad_value, dispatch_clip_grad, global_grad_norm
+from .log import FormatterNoInfo, setup_default_logging
+from .metrics import AverageMeter, accuracy
+from .model import freeze, get_state_dict, reparameterize_model, unfreeze, unwrap_model
+from .model_ema import ModelEmaV3, ema_update
+from .random import random_seed
+from .serialization import flatten_pytree, unflatten_into
+from .summary import get_outdir, update_summary

@@ -1,0 +1,140 @@
+"""Checkpoint retention + recovery (reference: timm/utils/checkpoint_saver.py:22-187).
+
+Checkpoint = one `.npz` file holding the flattened task state (model params,
+EMA, optimizer state, epoch metadata) — same single-file UX as the reference's
+torch.save dict, schema keys mirrored from checkpoint_saver.py:89-110.
+Retention: `last` always, top-k by metric, `model_best` copied.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import operator
+import os
+import shutil
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ['CheckpointSaver']
+
+
+class CheckpointSaver:
+    def __init__(
+            self,
+            task,
+            args=None,
+            checkpoint_prefix: str = 'checkpoint',
+            recovery_prefix: str = 'recovery',
+            checkpoint_dir: str = '',
+            recovery_dir: str = '',
+            decreasing: bool = False,
+            max_history: int = 10,
+    ):
+        self.task = task
+        self.args = args
+        self.checkpoint_files: List[Tuple[str, float]] = []
+        self.best_epoch: Optional[int] = None
+        self.best_metric: Optional[float] = None
+        self.curr_recovery_file = ''
+        self.prev_recovery_file = ''
+
+        self.checkpoint_dir = checkpoint_dir
+        self.recovery_dir = recovery_dir
+        self.save_prefix = checkpoint_prefix
+        self.recovery_prefix = recovery_prefix
+        self.extension = '.npz'
+        self.decreasing = decreasing
+        self.cmp = operator.lt if decreasing else operator.gt
+        self.max_history = max_history
+        assert self.max_history >= 1
+
+    def _save(self, save_path: str, epoch: int, metric: Optional[float] = None):
+        state = self.task.get_checkpoint_state()
+        state['epoch'] = np.asarray(epoch)
+        if metric is not None:
+            state['metric'] = np.asarray(metric)
+        np.savez(save_path, **state)
+        if self.args is not None:
+            meta_path = save_path.replace(self.extension, '.json')
+            with open(meta_path, 'w') as f:
+                json.dump({'epoch': epoch, 'metric': metric, 'arch': getattr(self.args, 'model', None),
+                           'args': {k: str(v) for k, v in vars(self.args).items()}}, f, indent=2, default=str)
+
+    def save_checkpoint(self, epoch: int, metric: Optional[float] = None):
+        assert epoch >= 0
+        tmp_save_path = os.path.join(self.checkpoint_dir, 'tmp' + self.extension)
+        last_save_path = os.path.join(self.checkpoint_dir, 'last' + self.extension)
+        self._save(tmp_save_path, epoch, metric)
+        if os.path.exists(last_save_path):
+            os.unlink(last_save_path)
+        os.rename(tmp_save_path, last_save_path)
+        tmp_meta = tmp_save_path.replace(self.extension, '.json')
+        if os.path.exists(tmp_meta):
+            os.replace(tmp_meta, last_save_path.replace(self.extension, '.json'))
+
+        worst_file = self.checkpoint_files[-1] if self.checkpoint_files else None
+        if len(self.checkpoint_files) < self.max_history or metric is None or self.cmp(metric, worst_file[1]):
+            if len(self.checkpoint_files) >= self.max_history:
+                self._cleanup_checkpoints(1)
+            filename = '-'.join([self.save_prefix, str(epoch)]) + self.extension
+            save_path = os.path.join(self.checkpoint_dir, filename)
+            shutil.copy2(last_save_path, save_path)
+            if self.args is not None and os.path.exists(last_save_path.replace(self.extension, '.json')):
+                shutil.copy2(last_save_path.replace(self.extension, '.json'),
+                             save_path.replace(self.extension, '.json'))
+            self.checkpoint_files.append((save_path, metric))
+            self.checkpoint_files = sorted(
+                self.checkpoint_files, key=lambda x: x[1] if x[1] is not None else -float('inf'),
+                reverse=not self.decreasing)
+
+            checkpoints_str = 'Current checkpoints:\n'
+            for c in self.checkpoint_files:
+                checkpoints_str += ' {}\n'.format(c)
+            _logger.info(checkpoints_str)
+
+            if metric is not None and (self.best_metric is None or self.cmp(metric, self.best_metric)):
+                self.best_epoch = epoch
+                self.best_metric = metric
+                best_save_path = os.path.join(self.checkpoint_dir, 'model_best' + self.extension)
+                shutil.copy2(last_save_path, best_save_path)
+
+        return (None, None) if self.best_metric is None else (self.best_metric, self.best_epoch)
+
+    def _cleanup_checkpoints(self, trim: int = 0):
+        trim = min(len(self.checkpoint_files), trim)
+        delete_index = self.max_history - trim
+        if delete_index < 0 or len(self.checkpoint_files) <= delete_index:
+            return
+        to_delete = self.checkpoint_files[delete_index:]
+        for d in to_delete:
+            try:
+                _logger.debug(f'Cleaning checkpoint: {d}')
+                os.remove(d[0])
+                meta = d[0].replace(self.extension, '.json')
+                if os.path.exists(meta):
+                    os.remove(meta)
+            except OSError:
+                _logger.error(f'Exception removing checkpoint {d}')
+        self.checkpoint_files = self.checkpoint_files[:delete_index]
+
+    def save_recovery(self, epoch: int, batch_idx: int = 0):
+        filename = '-'.join([self.recovery_prefix, str(epoch), str(batch_idx)]) + self.extension
+        save_path = os.path.join(self.recovery_dir, filename)
+        self._save(save_path, epoch)
+        if os.path.exists(self.prev_recovery_file):
+            try:
+                os.remove(self.prev_recovery_file)
+            except OSError:
+                _logger.error(f'Exception removing {self.prev_recovery_file}')
+        self.prev_recovery_file = self.curr_recovery_file
+        self.curr_recovery_file = save_path
+
+    def find_recovery(self) -> str:
+        recovery_path = os.path.join(self.recovery_dir, self.recovery_prefix)
+        files = glob.glob(recovery_path + '*' + self.extension)
+        files = sorted(files)
+        return files[0] if files else ''

@@ -1,0 +1,60 @@
+"""Gradient clipping (reference: timm/utils/clip_grad.py, agc.py).
+
+Pure functions over grad pytrees, composed inside the jitted train step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['clip_grad_norm', 'clip_grad_value', 'adaptive_clip_grad', 'dispatch_clip_grad', 'global_grad_norm']
+
+
+def global_grad_norm(grads) -> jnp.ndarray:
+    leaves = jax.tree.leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+
+
+def clip_grad_norm(grads, max_norm: float):
+    norm = global_grad_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: g * scale.astype(g.dtype), grads), norm
+
+
+def clip_grad_value(grads, clip_value: float):
+    return jax.tree.map(lambda g: jnp.clip(g, -clip_value, clip_value), grads), None
+
+
+def _unitwise_norm(x):
+    if x.ndim <= 1:
+        return jnp.abs(x)
+    # linear (I,O): norm over input dim; conv HWIO: norm over HWI
+    axes = tuple(range(x.ndim - 1))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True))
+
+
+def adaptive_clip_grad(params, grads, clip_factor: float = 0.01, eps: float = 1e-3):
+    """AGC (reference agc.py:30): clip grads unit-wise relative to param norms."""
+
+    def clip(p, g):
+        if p is None or g is None:
+            return g
+        p_norm = jnp.maximum(_unitwise_norm(p), eps)
+        g_norm = _unitwise_norm(g)
+        max_norm = p_norm * clip_factor
+        clipped = g * (max_norm / jnp.maximum(g_norm, 1e-6))
+        return jnp.where(g_norm > max_norm, clipped, g)
+
+    return jax.tree.map(clip, params, grads)
+
+
+def dispatch_clip_grad(grads, value: float, mode: str = 'norm', params=None):
+    """(reference clip_grad.py:dispatch_clip_grad). Returns (grads, grad_norm?)."""
+    if mode == 'norm':
+        return clip_grad_norm(grads, value)
+    if mode == 'value':
+        return clip_grad_value(grads, value)
+    if mode == 'agc':
+        assert params is not None, 'AGC requires params'
+        return adaptive_clip_grad(params, grads, clip_factor=value), None
+    raise ValueError(f'Unknown clip mode {mode}')

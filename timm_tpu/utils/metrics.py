@@ -1,0 +1,32 @@
+"""Metrics (reference: timm/utils/metrics.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ['AverageMeter', 'accuracy']
+
+
+class AverageMeter:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val, n: int = 1):
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+
+def accuracy(output, target, topk=(1,)):
+    """Top-k accuracy in percent (reference metrics.py:19)."""
+    maxk = min(max(topk), output.shape[-1])
+    batch_size = target.shape[0]
+    pred = jnp.argsort(output, axis=-1)[:, ::-1][:, :maxk]
+    correct = pred == target[:, None]
+    return [float(correct[:, :min(k, maxk)].any(axis=-1).sum()) * 100.0 / batch_size for k in topk]

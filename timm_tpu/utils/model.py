@@ -1,0 +1,65 @@
+"""Model utilities (reference: timm/utils/model.py)."""
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+from flax import nnx
+
+__all__ = ['unwrap_model', 'get_state_dict', 'freeze', 'unfreeze', 'reparameterize_model']
+
+
+def unwrap_model(model):
+    return getattr(model, 'model', model) if type(model).__name__ == 'FeatureGetterNet' else model
+
+
+def get_state_dict(model, unwrap_fn=unwrap_model):
+    from ..models._helpers import model_state_dict
+    return model_state_dict(unwrap_fn(model))
+
+
+class _Frozen(nnx.Variable):
+    """Marker variable type for frozen params (excluded from nnx.Param state)."""
+    pass
+
+
+def _iter_submodules(model: nnx.Module, prefix: str = ''):
+    yield prefix, model
+    for name, attr in vars(model).items():
+        if isinstance(attr, nnx.Module):
+            yield from _iter_submodules(attr, f'{prefix}.{name}' if prefix else name)
+        elif isinstance(attr, (list, tuple)) or type(attr).__name__ == 'List':
+            for i, item in enumerate(attr):
+                if isinstance(item, nnx.Module):
+                    yield from _iter_submodules(item, f'{prefix}.{name}.{i}' if prefix else f'{name}.{i}')
+
+
+def _set_frozen(module: nnx.Module, submodules: List[str], frozen: bool):
+    for name, sub in _iter_submodules(module):
+        if not submodules or any(name == s or name.startswith(s + '.') for s in submodules):
+            for attr_name, attr in list(vars(sub).items()):
+                if isinstance(attr, nnx.Param) and frozen:
+                    setattr(sub, attr_name, _Frozen(attr[...]))
+                elif isinstance(attr, _Frozen) and not frozen:
+                    setattr(sub, attr_name, nnx.Param(attr[...]))
+
+
+def freeze(module: nnx.Module, submodules: Union[str, List[str]] = ()):
+    """Convert Params to non-trainable variables (reference model.py:181)."""
+    if isinstance(submodules, str):
+        submodules = [submodules]
+    _set_frozen(module, list(submodules), True)
+
+
+def unfreeze(module: nnx.Module, submodules: Union[str, List[str]] = ()):
+    if isinstance(submodules, str):
+        submodules = [submodules]
+    _set_frozen(module, list(submodules), False)
+
+
+def reparameterize_model(model: nnx.Module, inplace: bool = False) -> nnx.Module:
+    """Fuse reparameterizable blocks (RepVGG-style) for inference
+    (reference model.py:233). Models expose `reparameterize()` per-module."""
+    for _, sub in _iter_submodules(model):
+        if hasattr(sub, 'reparameterize') and callable(sub.reparameterize):
+            sub.reparameterize()
+    return model

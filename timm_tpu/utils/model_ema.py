@@ -1,0 +1,56 @@
+"""Model EMA (reference: timm/utils/model_ema.py:135-261, ModelEmaV3).
+
+EMA weights are just a second param pytree; the update is a fused lerp inside
+the jitted train step (the reference needs torch._foreach_lerp_; XLA fuses the
+tree-map for free). The decay warmup schedule is computed host-side per step
+and passed in as a scalar.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['ema_update', 'ModelEmaV3']
+
+
+def ema_update(ema_params, params, decay):
+    """ema = decay * ema + (1-decay) * params."""
+    d = jnp.asarray(decay, jnp.float32)
+    return jax.tree.map(
+        lambda e, p: (e.astype(jnp.float32) * d + p.astype(jnp.float32) * (1.0 - d)).astype(e.dtype),
+        ema_params, params)
+
+
+class ModelEmaV3:
+    """Host-side EMA controller: owns the decay schedule; the param tree lives
+    with the train state (reference model_ema.py:135, warmup at :188-206)."""
+
+    def __init__(
+            self,
+            decay: float = 0.9999,
+            min_decay: float = 0.0,
+            update_after_step: int = 0,
+            use_warmup: bool = False,
+            warmup_gamma: float = 1.0,
+            warmup_power: float = 2.0 / 3.0,
+    ):
+        self.decay = decay
+        self.min_decay = min_decay
+        self.update_after_step = update_after_step
+        self.use_warmup = use_warmup
+        self.warmup_gamma = warmup_gamma
+        self.warmup_power = warmup_power
+
+    def get_decay(self, step: int) -> float:
+        step = max(0, step - self.update_after_step - 1)
+        if step <= 0:
+            return 0.0
+        if self.use_warmup:
+            decay = 1 - (1 + step / self.warmup_gamma) ** -self.warmup_power
+            decay = max(min(decay, self.decay), self.min_decay)
+        else:
+            decay = self.decay
+        return decay

@@ -1,0 +1,13 @@
+"""Seeding (reference: timm/utils/random.py)."""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+__all__ = ['random_seed']
+
+
+def random_seed(seed: int = 42, rank: int = 0):
+    random.seed(seed + rank)
+    np.random.seed(seed + rank)

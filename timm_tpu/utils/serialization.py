@@ -1,0 +1,70 @@
+"""Arbitrary-pytree ↔ flat-dict serialization for train-state checkpoints.
+
+Optimizer states are nested namedtuples/dataclasses; we flatten them with
+keypaths into a flat {str: array} dict (safetensors/npz-compatible) and
+restore into a freshly-built template of identical structure. This gives the
+reference's single-file checkpoint UX (checkpoint_saver.py:89-110) without a
+pickle dependency.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+__all__ = ['flatten_pytree', 'unflatten_into']
+
+
+def _kp_str(kp) -> str:
+    parts = []
+    for p in kp:
+        if hasattr(p, 'key'):
+            parts.append(str(p.key))
+        elif hasattr(p, 'idx'):
+            parts.append(str(p.idx))
+        elif hasattr(p, 'name'):
+            # drop the Variable '.value' attribute hop — params are addressed
+            # by their module path, matching model_state_dict naming
+            if str(p.name) == 'value':
+                continue
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return '.'.join(parts)
+
+
+def flatten_pytree(tree, prefix: str = '') -> Dict[str, np.ndarray]:
+    out = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for kp, leaf in flat:
+        if leaf is None:
+            continue
+        if hasattr(leaf, 'dtype') and jax.dtypes.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+            continue  # RNG stream keys aren't checkpoint content
+        key = _kp_str(kp)
+        if prefix:
+            key = f'{prefix}.{key}' if key else prefix
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def unflatten_into(template, flat_dict: Dict[str, np.ndarray], prefix: str = '', strict: bool = True):
+    """Rebuild a pytree with `template`'s structure from flat_dict values."""
+    import jax.numpy as jnp
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for kp, leaf in paths_leaves:
+        key = _kp_str(kp)
+        if prefix:
+            key = f'{prefix}.{key}' if key else prefix
+        if key in flat_dict:
+            val = jnp.asarray(flat_dict[key])
+            if leaf is not None and hasattr(leaf, 'dtype'):
+                val = val.astype(leaf.dtype)
+            new_leaves.append(val)
+        elif strict:
+            raise KeyError(f'Missing checkpoint key: {key}')
+        else:
+            new_leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)

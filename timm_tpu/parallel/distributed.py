@@ -88,5 +88,5 @@ def reduce_tensor(tensor, n: Optional[int] = None):
             return tensor
         from jax.experimental import multihost_utils
         val = multihost_utils.process_allgather(jnp.asarray(tensor))
-        return np.asarray(val).mean()
+        return np.asarray(val).mean(axis=0)  # element-wise mean across processes
     return tensor

@@ -58,6 +58,16 @@ class FeatureDistillationTask(TrainingTask):
     (reference distillation.py FeatureDistillationTask). The projection params
     live in task_state and persist through checkpoints."""
 
+    @staticmethod
+    def prepare_model(model: nnx.Module, teacher: nnx.Module, *, rngs: Optional[nnx.Rngs] = None) -> nnx.Module:
+        """Attach the student→teacher projection. Call BEFORE building the
+        optimizer so its weight-decay/lr-scale pytrees include the projection."""
+        student_dim = getattr(model, 'num_features')
+        teacher_dim = getattr(teacher, 'num_features')
+        if student_dim != teacher_dim and not hasattr(model, 'distill_proj'):
+            model.distill_proj = nnx.Linear(student_dim, teacher_dim, rngs=rngs or nnx.Rngs(0))
+        return model
+
     def __init__(
             self,
             model: nnx.Module,
@@ -68,11 +78,14 @@ class FeatureDistillationTask(TrainingTask):
             feat_loss: str = 'cosine',
             **kwargs,
     ):
-        # projection must exist before the optimizer state is built
-        student_dim = getattr(model, 'num_features')
-        teacher_dim = getattr(teacher, 'num_features')
-        if student_dim != teacher_dim:
-            model.distill_proj = nnx.Linear(student_dim, teacher_dim, rngs=nnx.Rngs(0))
+        needs_proj = getattr(model, 'num_features') != getattr(teacher, 'num_features')
+        if needs_proj and not hasattr(model, 'distill_proj'):
+            if optimizer is not None:
+                raise ValueError(
+                    'Student/teacher feature dims differ: call '
+                    'FeatureDistillationTask.prepare_model(model, teacher) before '
+                    'building the optimizer so its param pytrees include the projection.')
+            self.prepare_model(model, teacher)
         super().__init__(model, optimizer=optimizer, **kwargs)
         teacher.eval()
         self._teacher_graphdef, self._teacher_state = nnx.split(teacher)

@@ -82,6 +82,7 @@ class TrainingTask:
         """(reference task.py:110)."""
         self.ema = ModelEmaV3(decay=decay, use_warmup=warmup, **kwargs)
         self.ema_params = jax.tree.map(jnp.asarray, nnx.state(self.model, nnx.Param))
+        self._train_step = None  # EMA presence is baked into the jitted step; rebuild
 
     def compile(self, backend: str = ''):
         self.compiled = True  # parity no-op; nnx.jit is always on (task.py:90)

@@ -268,7 +268,8 @@ def main():
 
     # loss selection (ref train.py:886-913)
     if args.jsd_loss:
-        train_loss = JsdCrossEntropy(num_splits=3, smoothing=args.smoothing)
+        raise NotImplementedError(
+            '--jsd-loss requires the AugMix aug-splits pipeline, which is not wired up yet')
     elif args.mixup > 0 or args.cutmix > 0:
         train_loss = BinaryCrossEntropy(
             smoothing=0.0, target_threshold=args.bce_target_thresh, sum_classes=args.bce_sum,
@@ -458,6 +459,21 @@ def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
         if saver is not None and args.recovery_interval and (update_idx + 1) % args.recovery_interval == 0:
             saver.save_recovery(epoch, update_idx)
         update_idx += 1
+    if micro_inputs:
+        # flush trailing partial accumulation group: pad by wrapping samples so
+        # the step shape stays static (slight duplicate weighting on the tail)
+        input_all = np.concatenate(micro_inputs, axis=0)
+        target_all = np.concatenate(micro_targets, axis=0)
+        need = accum * micro_inputs[0].shape[0] - input_all.shape[0]
+        if need > 0:
+            reps = -(-need // input_all.shape[0])
+            input_all = np.concatenate([input_all] + [input_all] * reps, axis=0)[:accum * micro_inputs[0].shape[0]]
+            target_all = np.concatenate([target_all] + [target_all] * reps, axis=0)[:accum * micro_inputs[0].shape[0]]
+        batch = shard_batch({'input': jnp.asarray(input_all), 'target': jnp.asarray(target_all)}, mesh)
+        metrics = task.train_step(batch, lr=lr, step=num_updates)
+        num_updates += 1
+        if lr_scheduler is not None:
+            lr = lr_scheduler.step_update(num_updates)[0]
     return OrderedDict([('loss', loss_m.avg if loss_m.count else float(metrics.get('loss', 0.0))), ('lr', lr)])
 
 

@@ -1,0 +1,125 @@
+"""Data pipeline tests (reference: tests dir lacks loader tests; transforms/
+mixup invariants modeled on timm test style)."""
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from timm_tpu.data import (
+    Mixup, RandomErasing, create_dataset, create_loader, create_transform,
+    rand_augment_transform, resolve_data_config,
+)
+
+
+@pytest.fixture(scope='module')
+def image_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp('imgs')
+    rng = np.random.RandomState(0)
+    for split in ('train', 'val'):
+        for cls in ('a', 'b'):
+            d = root / split / cls
+            d.mkdir(parents=True)
+            for i in range(6 if split == 'train' else 3):
+                Image.fromarray(rng.randint(0, 255, (48, 56, 3), np.uint8)).save(d / f'{i}.jpg')
+    return str(root)
+
+
+def test_dataset_folder(image_root):
+    ds = create_dataset('', root=image_root, split='train')
+    assert len(ds) == 12
+    assert ds.reader.class_to_idx == {'a': 0, 'b': 1}
+    img, target = ds[0]
+    assert target in (0, 1)
+
+
+def test_dataset_split_search(image_root):
+    ds = create_dataset('', root=image_root, split='validation')  # resolves to val/
+    assert len(ds) == 6
+
+
+def test_train_loader(image_root):
+    ds = create_dataset('', root=image_root, split='train', is_training=True)
+    loader = create_loader(ds, input_size=(3, 32, 32), batch_size=4, is_training=True,
+                           num_workers=2, auto_augment='rand-m5', re_prob=0.3)
+    batches = list(loader)
+    assert len(batches) == 3  # 12 samples, drop_last
+    x, t = batches[0]
+    assert x.shape == (4, 32, 32, 3) and x.dtype == np.float32
+    assert 0.0 <= x.min() and x.max() <= 1.0
+    assert len(loader) == 3
+
+
+def test_eval_loader_keeps_tail(image_root):
+    ds = create_dataset('', root=image_root, split='val')
+    loader = create_loader(ds, input_size=(3, 32, 32), batch_size=4, is_training=False)
+    batches = list(loader)
+    assert sum(b[0].shape[0] for b in batches) == 6  # no samples dropped
+
+
+def test_loader_deterministic_order_eval(image_root):
+    ds = create_dataset('', root=image_root, split='val')
+    loader = create_loader(ds, input_size=(3, 32, 32), batch_size=3, is_training=False, num_workers=3)
+    t1 = np.concatenate([b[1] for b in loader])
+    t2 = np.concatenate([b[1] for b in loader])
+    assert np.array_equal(t1, t2)
+
+
+def test_transform_shapes():
+    img = Image.fromarray(np.random.RandomState(0).randint(0, 255, (60, 80, 3), np.uint8))
+    for is_training in (True, False):
+        tf = create_transform(48, is_training=is_training)
+        out = tf(img)
+        assert out.shape == (48, 48, 3)
+
+
+def test_rand_augment_config():
+    ra = rand_augment_transform('rand-m9-mstd0.5-inc1', {})
+    assert ra.num_layers == 2
+    assert all(op.magnitude == 9 for op in ra.ops)
+    assert all(op.magnitude_std == 0.5 for op in ra.ops)
+    names = {op.name for op in ra.ops}
+    assert 'PosterizeIncreasing' in names  # inc1 selected increasing set
+    img = Image.fromarray(np.random.RandomState(0).randint(0, 255, (40, 40, 3), np.uint8))
+    out = ra(img)
+    assert out.size == (40, 40)
+
+
+def test_mixup_batch_mode():
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 16, 16, 3).astype(np.float32)
+    t = rng.randint(0, 10, 8)
+    mix = Mixup(mixup_alpha=1.0, cutmix_alpha=1.0, num_classes=10, label_smoothing=0.1)
+    xm, tm = mix(x, t)
+    assert xm.shape == x.shape and tm.shape == (8, 10)
+    np.testing.assert_allclose(tm.sum(-1), np.ones(8), rtol=1e-5)
+
+
+def test_mixup_elem_mode():
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 16, 16, 3).astype(np.float32)
+    t = rng.randint(0, 10, 8)
+    mix = Mixup(mixup_alpha=1.0, mode='elem', num_classes=10)
+    xm, tm = mix(x, t)
+    assert xm.shape == x.shape and tm.shape == (8, 10)
+
+
+def test_random_erasing():
+    rng = np.random.RandomState(0)
+    x = np.ones((4, 32, 32, 3), np.float32)
+    re = RandomErasing(probability=1.0, mode='const')
+    out = re(x.copy())
+    assert (out == 0).any()  # something was erased
+    re_none = RandomErasing(probability=0.0)
+    out2 = re_none(x.copy())
+    assert (out2 == 1).all()
+
+
+def test_resolve_data_config_priority():
+    cfg = resolve_data_config(
+        {'img_size': 192, 'mean': (0.1,), 'crop_pct': 0.8},
+        pretrained_cfg={'input_size': (3, 224, 224), 'mean': (0.5, 0.5, 0.5), 'std': (0.2, 0.2, 0.2)})
+    assert cfg['input_size'] == (3, 192, 192)
+    assert cfg['mean'] == (0.1, 0.1, 0.1)  # single value expanded
+    assert cfg['std'] == (0.2, 0.2, 0.2)
+    assert cfg['crop_pct'] == 0.8

@@ -1,0 +1,88 @@
+"""Dataset factory (reference: timm/data/dataset_factory.py:63-230).
+
+Name-scheme dispatch: '' / 'folder' → ImageFolder; 'hfds/name' → HuggingFace
+map-style datasets (when the library is present). TFDS/WDS schemes raise with
+guidance until those readers land.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .dataset import ImageDataset
+
+__all__ = ['create_dataset']
+
+
+def _search_split(root: str, split: str) -> str:
+    split_name = split.split('[')[0]
+    try_root = os.path.join(root, split_name)
+    if os.path.exists(try_root):
+        return try_root
+    def _try(syn):
+        p = os.path.join(root, syn)
+        return p if os.path.exists(p) else None
+    if split_name in ('validation', 'val'):
+        for syn in ('val', 'validation', 'eval', 'test'):
+            p = _try(syn)
+            if p:
+                return p
+    if split_name == 'train':
+        p = _try('training')
+        if p:
+            return p
+    return root
+
+
+class HfdsWrapper:
+    """Map-style HF datasets → (PIL, label) samples."""
+
+    def __init__(self, name, root, split, input_key='image', target_key='label'):
+        import datasets as hfds
+        split = {'validation': 'validation', 'val': 'validation', 'train': 'train'}.get(split, split)
+        self.ds = hfds.load_dataset(name, cache_dir=root or None, split=split)
+        self.input_key = input_key
+        self.target_key = target_key
+        self.transform = None
+        self.target_transform = None
+
+    def __len__(self):
+        return len(self.ds)
+
+    def __getitem__(self, index):
+        item = self.ds[int(index)]
+        img = item[self.input_key]
+        if img.mode != 'RGB':
+            img = img.convert('RGB')
+        if self.transform is not None:
+            img = self.transform(img)
+        target = item.get(self.target_key, -1)
+        if self.target_transform is not None:
+            target = self.target_transform(target)
+        return img, target
+
+
+def create_dataset(
+        name: str = '',
+        root: Optional[str] = None,
+        split: str = 'validation',
+        search_split: bool = True,
+        class_map=None,
+        is_training: bool = False,
+        num_classes: Optional[int] = None,
+        input_img_mode: str = 'RGB',
+        **kwargs,
+):
+    """(reference dataset_factory.py:63)."""
+    kwargs = {k: v for k, v in kwargs.items() if v is not None}
+    name = name or ''
+    if name.startswith('hfds/'):
+        return HfdsWrapper(name[5:], root, split, **{k: kwargs[k] for k in ('input_key', 'target_key') if k in kwargs})
+    if name.startswith(('tfds/', 'wds/', 'hfids/', 'torch/')):
+        raise NotImplementedError(
+            f'Dataset scheme {name.split("/")[0]} is not wired up yet; use a folder dataset or hfds/.')
+    # folder / tar default
+    if search_split and root and os.path.isdir(root):
+        root = _search_split(root, split)
+    return ImageDataset(
+        root, split=split, class_map=class_map or '', input_img_mode=input_img_mode, **kwargs)

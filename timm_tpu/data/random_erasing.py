@@ -1,0 +1,79 @@
+"""Random Erasing on numpy batches (reference: timm/data/random_erasing.py).
+
+The reference erases on-device inside its CUDA prefetcher; here erasing is a
+cheap numpy op applied post-collate on the host batch (HWC float images),
+keeping the device step purely functional.
+"""
+from __future__ import annotations
+
+import math
+import random
+
+import numpy as np
+
+__all__ = ['RandomErasing']
+
+
+class RandomErasing:
+    def __init__(
+            self,
+            probability: float = 0.5,
+            min_area: float = 0.02,
+            max_area: float = 1 / 3,
+            min_aspect: float = 0.3,
+            max_aspect=None,
+            mode: str = 'const',
+            min_count: int = 1,
+            max_count=None,
+            num_splits: int = 0,
+            mean=None,
+            std=None,
+    ):
+        self.probability = probability
+        self.min_area = min_area
+        self.max_area = max_area
+        max_aspect = max_aspect or 1 / min_aspect
+        self.log_aspect_ratio = (math.log(min_aspect), math.log(max_aspect))
+        self.min_count = min_count
+        self.max_count = max_count or min_count
+        self.num_splits = num_splits
+        self.mode = mode.lower()
+        assert self.mode in ('const', 'rand', 'pixel')
+        # fills are expressed in *normalized* space (the reference erases after
+        # on-device normalization); since this runs on [0,1] images before the
+        # device normalize, map them back: x01 = mean + std * normalized
+        self.mean = np.asarray(mean if mean is not None else (0.0, 0.0, 0.0), np.float32)
+        self.std = np.asarray(std if std is not None else (1.0, 1.0, 1.0), np.float32)
+
+    def _erase_one(self, img):
+        h, w, c = img.shape
+        area = h * w
+        count = self.min_count if self.min_count == self.max_count else \
+            random.randint(self.min_count, self.max_count)
+        for _ in range(count):
+            for _ in range(10):
+                target_area = random.uniform(self.min_area, self.max_area) * area / count
+                aspect_ratio = math.exp(random.uniform(*self.log_aspect_ratio))
+                eh = int(round(math.sqrt(target_area * aspect_ratio)))
+                ew = int(round(math.sqrt(target_area / aspect_ratio)))
+                if ew < w and eh < h:
+                    top = random.randint(0, h - eh)
+                    left = random.randint(0, w - ew)
+                    if self.mode == 'pixel':
+                        noise = np.random.randn(eh, ew, c).astype(np.float32)
+                        img[top:top + eh, left:left + ew] = (self.mean + self.std * noise).astype(img.dtype)
+                    elif self.mode == 'rand':
+                        noise = np.random.randn(1, 1, c).astype(np.float32)
+                        img[top:top + eh, left:left + ew] = (self.mean + self.std * noise).astype(img.dtype)
+                    else:
+                        img[top:top + eh, left:left + ew] = self.mean.astype(img.dtype)
+                    break
+        return img
+
+    def __call__(self, batch):
+        """batch: (B, H, W, C) float ndarray, modified in place."""
+        batch_start = batch.shape[0] // self.num_splits if self.num_splits > 1 else 0
+        for i in range(batch_start, batch.shape[0]):
+            if random.random() <= self.probability:
+                self._erase_one(batch[i])
+        return batch

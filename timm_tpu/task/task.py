@@ -46,6 +46,8 @@ class TrainingTask:
             grad_accum_steps: int = 1,
             clip_grad: Optional[float] = None,
             clip_mode: str = 'norm',
+            mean=None,
+            std=None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -53,6 +55,13 @@ class TrainingTask:
         self.grad_accum_steps = max(1, grad_accum_steps)
         self.clip_grad = clip_grad
         self.clip_mode = clip_mode
+        # on-device input normalization, fused into the jitted step (the
+        # reference normalizes on-GPU in PrefetchLoader, loader.py:124-159)
+        if mean is not None:
+            self._norm_mean = jnp.asarray(mean, jnp.float32).reshape(1, 1, 1, -1)
+            self._norm_std = jnp.asarray(std if std is not None else 1.0, jnp.float32).reshape(1, 1, 1, -1)
+        else:
+            self._norm_mean = self._norm_std = None
 
         # replicate model + optimizer state over the mesh
         rep = replicate_sharding(self.mesh)
@@ -77,6 +86,14 @@ class TrainingTask:
     def eval_forward(self, model: nnx.Module, batch: Dict[str, Any]):
         return model(batch['input'])
 
+    def normalize_input(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        if self._norm_mean is None or 'input' not in batch:
+            return batch
+        x = batch['input']
+        x = (x.astype(jnp.float32) - self._norm_mean) / self._norm_std
+        return dict(batch, input=x.astype(batch['input'].dtype)
+                    if batch['input'].dtype != jnp.float32 else x)
+
     # -- setup ---------------------------------------------------------------
     def setup_ema(self, decay: float = 0.9999, warmup: bool = False, **kwargs):
         """(reference task.py:110)."""
@@ -98,8 +115,12 @@ class TrainingTask:
         has_ema = self.ema_params is not None
         loss_forward = self.loss_forward
 
+        normalize_input = self.normalize_input
+
         @nnx.jit
         def train_step(model, opt_state, ema_params, batch, lr, ema_decay):
+            batch = normalize_input(batch)
+
             def loss_fn(model, mb):
                 loss, _output = loss_forward(model, mb)
                 return loss.astype(jnp.float32)
@@ -143,10 +164,11 @@ class TrainingTask:
 
     def _build_eval_step(self):
         eval_forward = self.eval_forward
+        normalize_input = self.normalize_input
 
         @nnx.jit
         def eval_step(model, batch):
-            return eval_forward(model, batch)
+            return eval_forward(model, normalize_input(batch))
 
         return eval_step
 

@@ -236,11 +236,8 @@ def main():
     if args.grad_checkpointing:
         model.set_grad_checkpointing(True)
 
-    data_config = {'input_size': (3, 224, 224)}
-    if hasattr(model, 'pretrained_cfg'):
-        data_config['input_size'] = model.pretrained_cfg.input_size
-    if args.img_size:
-        data_config['input_size'] = (3, args.img_size, args.img_size)
+    from timm_tpu.data import resolve_data_config
+    data_config = resolve_data_config(vars(args), model=model, verbose=rank == 0)
     img_size = data_config['input_size'][-1]
 
     # LR auto-scale from global batch (ref train.py:837-849)
@@ -257,6 +254,8 @@ def main():
         _logger.info(f'LR ({args.lr}) from base ({args.lr_base}) * {scale} batch ratio')
 
     optimizer = create_optimizer_v2(model, **optimizer_kwargs(args))
+    norm_mean = data_config['mean']
+    norm_std = data_config['std']
     task = ClassificationTask(
         model,
         optimizer=optimizer,
@@ -264,6 +263,8 @@ def main():
         grad_accum_steps=args.grad_accum_steps,
         clip_grad=args.clip_grad,
         clip_mode=args.clip_mode,
+        mean=norm_mean,
+        std=norm_std,
     )
 
     # loss selection (ref train.py:886-913)
@@ -294,9 +295,8 @@ def main():
                                       img_size, args.num_classes, args.seed + 1)
         mixup_fn = None
     else:
-        from timm_tpu.data import create_dataset, create_loader, resolve_data_config
+        from timm_tpu.data import create_dataset, create_loader
         from timm_tpu.data.mixup import Mixup
-        data_config = resolve_data_config(vars(args), model=model, verbose=rank == 0)
         dataset_train = create_dataset(
             args.dataset, root=args.data_dir, split=args.train_split, is_training=True,
             class_map=args.class_map, num_classes=args.num_classes)

@@ -245,6 +245,11 @@ def create_loader(
     (images NHWC float32 [0,1], targets int) numpy batches."""
     import jax
 
+    if num_aug_repeats:
+        raise NotImplementedError('RepeatAugSampler (--aug-repeats) is not supported yet')
+    if collate_fn is not None:
+        raise NotImplementedError('custom collate_fn is not supported by ThreadedLoader')
+
     re_num_splits = 0
     if re_split:
         re_num_splits = num_aug_splits or 2

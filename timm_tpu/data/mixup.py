@@ -127,7 +127,7 @@ class Mixup:
         batch_size = x.shape[0]
         num_elem = batch_size // 2 if pair else batch_size
         lam_out = np.ones(batch_size, dtype=np.float32)
-        x_orig = x.copy()
+        x_orig = x  # read-only source; single copy below is mutated
         x = x.copy()
         for i in range(num_elem):
             j = batch_size - i - 1

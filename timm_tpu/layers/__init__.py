@@ -29,4 +29,4 @@ from .pos_embed_sincos import (
     build_sincos2d_pos_embed, freq_bands, pixel_freq_bands,
 )
 from .squeeze_excite import EffectiveSEModule, SEModule, SqueezeExcite
-from .weight_init import lecun_normal_, trunc_normal_, trunc_normal_tf_, variance_scaling_
+from .weight_init import lecun_normal_, ones_, trunc_normal_, trunc_normal_tf_, variance_scaling_, zeros_

@@ -13,4 +13,8 @@ from ._registry import (
     list_pretrained, model_entrypoint, register_model, split_model_name_tag,
 )
 
+from .convnext import ConvNeXt
+from .efficientnet import EfficientNet
+from .mlp_mixer import MlpMixer
+from .resnet import ResNet
 from .vision_transformer import VisionTransformer

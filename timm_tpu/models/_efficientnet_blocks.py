@@ -1,0 +1,229 @@
+"""EfficientNet-family building blocks, NHWC
+(reference: timm/models/_efficientnet_blocks.py:1-761).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNormAct2d, DropPath, SqueezeExcite, create_conv2d, get_act_fn, make_divisible
+
+__all__ = ['ConvBnAct', 'DepthwiseSeparableConv', 'InvertedResidual', 'EdgeResidual', 'SqueezeExcite']
+
+
+def num_groups(group_size, channels):
+    if not group_size:
+        return 1
+    assert channels % group_size == 0
+    return channels // group_size
+
+
+class ConvBnAct(nnx.Module):
+    def __init__(
+            self,
+            in_chs: int,
+            out_chs: int,
+            kernel_size: int = 3,
+            stride: int = 1,
+            dilation: int = 1,
+            group_size: int = 0,
+            pad_type: str = '',
+            skip: bool = False,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            drop_path_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        groups = num_groups(group_size, in_chs)
+        self.has_skip = skip and stride == 1 and in_chs == out_chs
+        self.conv = create_conv2d(
+            in_chs, out_chs, kernel_size, stride=stride, dilation=dilation, groups=groups,
+            padding=pad_type or 'same', dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn1 = norm_layer(out_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path = DropPath(drop_path_rate, rngs=rngs)
+
+    def feature_info(self, location):
+        return dict(module='conv', num_chs=self.conv.out_features)
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.bn1(self.conv(x))
+        if self.has_skip:
+            x = self.drop_path(x) + shortcut
+        return x
+
+
+class DepthwiseSeparableConv(nnx.Module):
+    """DW conv + PW conv (reference _efficientnet_blocks.py DepthwiseSeparableConv)."""
+
+    def __init__(
+            self,
+            in_chs: int,
+            out_chs: int,
+            dw_kernel_size: int = 3,
+            stride: int = 1,
+            dilation: int = 1,
+            group_size: int = 1,
+            pad_type: str = '',
+            noskip: bool = False,
+            pw_kernel_size: int = 1,
+            pw_act: bool = False,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            se_layer: Optional[Callable] = None,
+            drop_path_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.has_skip = (stride == 1 and in_chs == out_chs) and not noskip
+        self.has_pw_act = pw_act
+
+        self.conv_dw = create_conv2d(
+            in_chs, in_chs, dw_kernel_size, stride=stride, dilation=dilation,
+            depthwise=True, padding=pad_type or 'same', dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn1 = norm_layer(in_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.se = se_layer(in_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
+            if se_layer else None
+        self.conv_pw = create_conv2d(
+            in_chs, out_chs, pw_kernel_size, padding=pad_type or 'same',
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn2 = norm_layer(
+            out_chs, apply_act=self.has_pw_act, act_layer=act_layer,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path = DropPath(drop_path_rate, rngs=rngs)
+
+    def feature_info(self, location):
+        return dict(module='conv_pw', num_chs=self.conv_pw.out_features)
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.bn1(self.conv_dw(x))
+        if self.se is not None:
+            x = self.se(x)
+        x = self.bn2(self.conv_pw(x))
+        if self.has_skip:
+            x = self.drop_path(x) + shortcut
+        return x
+
+
+class InvertedResidual(nnx.Module):
+    """MBConv (reference _efficientnet_blocks.py InvertedResidual)."""
+
+    def __init__(
+            self,
+            in_chs: int,
+            out_chs: int,
+            dw_kernel_size: int = 3,
+            stride: int = 1,
+            dilation: int = 1,
+            group_size: int = 1,
+            pad_type: str = '',
+            noskip: bool = False,
+            exp_ratio: float = 1.0,
+            exp_kernel_size: int = 1,
+            pw_kernel_size: int = 1,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            se_layer: Optional[Callable] = None,
+            drop_path_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        mid_chs = make_divisible(in_chs * exp_ratio)
+        self.has_skip = (in_chs == out_chs and stride == 1) and not noskip
+
+        self.conv_pw = create_conv2d(
+            in_chs, mid_chs, exp_kernel_size, padding=pad_type or 'same',
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn1 = norm_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv_dw = create_conv2d(
+            mid_chs, mid_chs, dw_kernel_size, stride=stride, dilation=dilation,
+            depthwise=True, padding=pad_type or 'same', dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn2 = norm_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.se = se_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
+            if se_layer else None
+        self.conv_pwl = create_conv2d(
+            mid_chs, out_chs, pw_kernel_size, padding=pad_type or 'same',
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn3 = norm_layer(out_chs, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path = DropPath(drop_path_rate, rngs=rngs)
+
+    def feature_info(self, location):
+        return dict(module='conv_pwl', num_chs=self.conv_pwl.out_features)
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.bn1(self.conv_pw(x))
+        x = self.bn2(self.conv_dw(x))
+        if self.se is not None:
+            x = self.se(x)
+        x = self.bn3(self.conv_pwl(x))
+        if self.has_skip:
+            x = self.drop_path(x) + shortcut
+        return x
+
+
+class EdgeResidual(nnx.Module):
+    """FusedMBConv (reference _efficientnet_blocks.py EdgeResidual)."""
+
+    def __init__(
+            self,
+            in_chs: int,
+            out_chs: int,
+            exp_kernel_size: int = 3,
+            stride: int = 1,
+            dilation: int = 1,
+            group_size: int = 0,
+            pad_type: str = '',
+            force_in_chs: int = 0,
+            noskip: bool = False,
+            exp_ratio: float = 1.0,
+            pw_kernel_size: int = 1,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            se_layer: Optional[Callable] = None,
+            drop_path_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        if force_in_chs > 0:
+            mid_chs = make_divisible(force_in_chs * exp_ratio)
+        else:
+            mid_chs = make_divisible(in_chs * exp_ratio)
+        self.has_skip = (in_chs == out_chs and stride == 1) and not noskip
+
+        self.conv_exp = create_conv2d(
+            in_chs, mid_chs, exp_kernel_size, stride=stride, dilation=dilation,
+            padding=pad_type or 'same', dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn1 = norm_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.se = se_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
+            if se_layer else None
+        self.conv_pwl = create_conv2d(
+            mid_chs, out_chs, pw_kernel_size, padding=pad_type or 'same',
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn2 = norm_layer(out_chs, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path = DropPath(drop_path_rate, rngs=rngs)
+
+    def feature_info(self, location):
+        return dict(module='conv_pwl', num_chs=self.conv_pwl.out_features)
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.bn1(self.conv_exp(x))
+        if self.se is not None:
+            x = self.se(x)
+        x = self.bn2(self.conv_pwl(x))
+        if self.has_skip:
+            x = self.drop_path(x) + shortcut
+        return x

@@ -1,0 +1,276 @@
+"""EfficientNet arch-string decoder + stage builder
+(reference: timm/models/_efficientnet_builder.py:43-581).
+
+The same block-string DSL as the reference: e.g. 'ir_r4_k3_s2_e6_c128_se0.25'
+decodes to 4 repeats of an InvertedResidual k3 s2 expand-6 out-128 w/ SE 0.25.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import re
+from copy import deepcopy
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNormAct2d, SqueezeExcite, get_act_fn, make_divisible
+from ._efficientnet_blocks import ConvBnAct, DepthwiseSeparableConv, EdgeResidual, InvertedResidual
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ['EfficientNetBuilder', 'decode_arch_def', 'round_channels', 'resolve_bn_args', 'resolve_act_layer']
+
+BN_MOMENTUM_TF_DEFAULT = 1 - 0.99
+BN_EPS_TF_DEFAULT = 1e-3
+
+
+def resolve_bn_args(kwargs):
+    bn_args = {}
+    if kwargs.pop('bn_tf', False):
+        bn_args = dict(momentum=BN_MOMENTUM_TF_DEFAULT, eps=BN_EPS_TF_DEFAULT)
+    bn_momentum = kwargs.pop('bn_momentum', None)
+    if bn_momentum is not None:
+        bn_args['momentum'] = bn_momentum
+    bn_eps = kwargs.pop('bn_eps', None)
+    if bn_eps is not None:
+        bn_args['eps'] = bn_eps
+    return bn_args
+
+
+def resolve_act_layer(kwargs, default='relu'):
+    return kwargs.pop('act_layer', default) or default
+
+
+def round_channels(channels, multiplier: float = 1.0, divisor: int = 8, channel_min=None, round_limit: float = 0.9):
+    """(reference _efficientnet_builder.py:62)."""
+    if not multiplier:
+        return channels
+    return make_divisible(channels * multiplier, divisor, channel_min, round_limit=round_limit)
+
+
+def _parse_ksize(ss: str) -> int:
+    if ss.isdigit():
+        return int(ss)
+    return [int(k) for k in ss.split('.')][0]  # mixed kernels collapse to first
+
+
+def _decode_block_str(block_str: str) -> Dict[str, Any]:
+    """Decode one block definition string (reference _efficientnet_builder.py:81)."""
+    assert isinstance(block_str, str)
+    ops = block_str.split('_')
+    block_type = ops[0]
+    ops = ops[1:]
+    options: Dict[str, str] = {}
+    skip = None
+    for op in ops:
+        if op == 'noskip':
+            skip = False
+        elif op == 'skip':
+            skip = True
+        elif op.startswith('n'):
+            # activation fn
+            options['n'] = op[1:]
+        else:
+            splits = re.split(r'(\d.*)', op)
+            if len(splits) >= 2:
+                key, value = splits[:2]
+                options[key] = value
+
+    act_layer = options.get('n', None)
+    start_kwargs = dict(
+        block_type=block_type,
+        out_chs=int(options['c']),
+        stride=int(options.get('s', 1)),
+        act_layer=act_layer,
+    )
+    num_repeat = int(options.get('r', 1))
+
+    if block_type == 'ir':
+        start_kwargs.update(dict(
+            dw_kernel_size=_parse_ksize(options['k']),
+            exp_kernel_size=_parse_ksize(options.get('a', '1')),
+            pw_kernel_size=_parse_ksize(options.get('p', '1')),
+            exp_ratio=float(options.get('e', 1.0)),
+            se_ratio=float(options.get('se', 0.0)),
+            noskip=skip is False,
+        ))
+    elif block_type == 'ds' or block_type == 'dsa':
+        start_kwargs.update(dict(
+            dw_kernel_size=_parse_ksize(options['k']),
+            pw_kernel_size=_parse_ksize(options.get('p', '1')),
+            se_ratio=float(options.get('se', 0.0)),
+            pw_act=block_type == 'dsa',
+            noskip=block_type == 'dsa' or skip is False,
+        ))
+    elif block_type == 'er':
+        start_kwargs.update(dict(
+            exp_kernel_size=_parse_ksize(options['k']),
+            pw_kernel_size=_parse_ksize(options.get('p', '1')),
+            exp_ratio=float(options.get('e', 1.0)),
+            se_ratio=float(options.get('se', 0.0)),
+            force_in_chs=int(options.get('fc', 0)),
+            noskip=skip is False,
+        ))
+    elif block_type == 'cn':
+        start_kwargs.update(dict(
+            kernel_size=int(options['k']),
+            skip=skip is True,
+        ))
+    else:
+        raise AssertionError(f'Unknown block type ({block_type})')
+
+    return start_kwargs, num_repeat
+
+
+def _scale_stage_depth(stack_args, repeats, depth_multiplier=1.0, depth_trunc='ceil'):
+    """(reference _efficientnet_builder.py:~230)."""
+    num_repeat = sum(repeats)
+    if depth_trunc == 'round':
+        num_repeat_scaled = max(1, round(num_repeat * depth_multiplier))
+    else:
+        num_repeat_scaled = int(math.ceil(num_repeat * depth_multiplier))
+
+    repeats_scaled = []
+    for r in repeats[::-1]:
+        rs = max(1, round((r / num_repeat * num_repeat_scaled)))
+        repeats_scaled.append(rs)
+        num_repeat -= r
+        num_repeat_scaled -= rs
+    repeats_scaled = repeats_scaled[::-1]
+
+    sa_scaled = []
+    for ba, rep in zip(stack_args, repeats_scaled):
+        sa_scaled.extend([deepcopy(ba) for _ in range(rep)])
+    return sa_scaled
+
+
+def decode_arch_def(
+        arch_def: List[List[str]],
+        depth_multiplier: Union[float, tuple] = 1.0,
+        depth_trunc: str = 'ceil',
+        experts_multiplier: int = 1,
+        fix_first_last: bool = False,
+        group_size=None,
+):
+    """(reference _efficientnet_builder.py:270)."""
+    arch_args = []
+    if isinstance(depth_multiplier, tuple):
+        assert len(depth_multiplier) == len(arch_def)
+    else:
+        depth_multiplier = (depth_multiplier,) * len(arch_def)
+    for stack_idx, (block_strings, multiplier) in enumerate(zip(arch_def, depth_multiplier)):
+        assert isinstance(block_strings, list)
+        stack_args = []
+        repeats = []
+        for block_str in block_strings:
+            ba, rep = _decode_block_str(block_str)
+            stack_args.append(ba)
+            repeats.append(rep)
+        if fix_first_last and (stack_idx == 0 or stack_idx == len(arch_def) - 1):
+            arch_args.append(_scale_stage_depth(stack_args, repeats, 1.0, depth_trunc))
+        else:
+            arch_args.append(_scale_stage_depth(stack_args, repeats, multiplier, depth_trunc))
+    return arch_args
+
+
+class EfficientNetBuilder:
+    """Builds stage lists from decoded args (reference _efficientnet_builder.py:316)."""
+
+    def __init__(
+            self,
+            output_stride: int = 32,
+            pad_type: str = '',
+            round_chs_fn: Callable = round_channels,
+            se_from_exp: bool = False,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            se_layer: Callable = SqueezeExcite,
+            drop_path_rate: float = 0.0,
+            feature_location: str = '',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.output_stride = output_stride
+        self.pad_type = pad_type
+        self.round_chs_fn = round_chs_fn
+        self.se_from_exp = se_from_exp
+        self.act_layer = act_layer
+        self.norm_layer = norm_layer
+        self.se_layer = se_layer
+        self.drop_path_rate = drop_path_rate
+        self.dtype = dtype
+        self.param_dtype = param_dtype
+        self.rngs = rngs
+        self.in_chs = None
+        self.features = []
+
+    def _make_block(self, ba: Dict, block_idx: int, block_count: int):
+        drop_path_rate = self.drop_path_rate * block_idx / block_count
+        bt = ba.pop('block_type')
+        ba['in_chs'] = self.in_chs
+        ba['out_chs'] = self.round_chs_fn(ba['out_chs'])
+        if 'force_in_chs' in ba and ba['force_in_chs']:
+            ba['force_in_chs'] = self.round_chs_fn(ba['force_in_chs'])
+        ba['pad_type'] = self.pad_type
+        ba['act_layer'] = ba.pop('act_layer', None) or self.act_layer
+        ba['norm_layer'] = self.norm_layer
+        se_ratio = ba.pop('se_ratio', 0.0)
+        se_layer = None
+        if se_ratio > 0.0 and self.se_layer is not None:
+            if not self.se_from_exp:
+                se_ratio /= ba.get('exp_ratio', 1.0)
+            se_layer = partial(self.se_layer, rd_ratio=se_ratio)
+        common = dict(dtype=self.dtype, param_dtype=self.param_dtype, rngs=self.rngs)
+
+        if bt == 'ir':
+            block = InvertedResidual(drop_path_rate=drop_path_rate, se_layer=se_layer, **ba, **common)
+        elif bt in ('ds', 'dsa'):
+            ba.pop('exp_ratio', None)
+            ba.pop('exp_kernel_size', None)
+            block = DepthwiseSeparableConv(drop_path_rate=drop_path_rate, se_layer=se_layer, **ba, **common)
+        elif bt == 'er':
+            block = EdgeResidual(drop_path_rate=drop_path_rate, se_layer=se_layer, **ba, **common)
+        elif bt == 'cn':
+            block = ConvBnAct(drop_path_rate=drop_path_rate, **ba, **common)
+        else:
+            raise AssertionError(f'Unknown block type ({bt})')
+        self.in_chs = ba['out_chs']
+        return block
+
+    def __call__(self, in_chs: int, model_block_args: List[List[Dict]]):
+        self.in_chs = in_chs
+        total_block_count = sum(len(s) for s in model_block_args)
+        block_idx = 0
+        current_stride = 2  # after stem
+        current_dilation = 1
+        stages = []
+        self.features = []
+        for stack_idx, stack_args in enumerate(model_block_args):
+            blocks = []
+            for i, ba in enumerate(stack_args):
+                ba = deepcopy(ba)
+                if i > 0:
+                    ba['stride'] = 1
+                # stride→dilation conversion compounds across stages
+                # (reference _efficientnet_builder.py:495-503)
+                next_dilation = current_dilation
+                if ba.get('stride', 1) > 1:
+                    next_output_stride = current_stride * ba['stride']
+                    if next_output_stride > self.output_stride:
+                        next_dilation = current_dilation * ba['stride']
+                        ba['stride'] = 1
+                    else:
+                        current_stride = next_output_stride
+                ba['dilation'] = current_dilation
+                current_dilation = next_dilation
+                blocks.append(self._make_block(ba, block_idx, total_block_count))
+                block_idx += 1
+            stages.append(nnx.List(blocks))
+            self.features.append(dict(
+                num_chs=self.in_chs, reduction=current_stride, module=f'blocks.{stack_idx}'))
+        return stages

@@ -1,0 +1,501 @@
+"""ConvNeXt / ConvNeXt-V2, TPU-native NHWC.
+
+Re-designed from the reference (timm/models/convnext.py:1-1437): blocks are
+dwconv7x7 → LN → pointwise-MLP (Linear on channels-last) → LayerScale →
+DropPath, all in NHWC so the MLP is a plain matmul on the MXU. V2 swaps
+LayerScale for GRN in the MLP.
+
+Contract parity: forward_features/forward_head, get/reset_classifier,
+group_matcher, set_grad_checkpointing, forward_intermediates, feature_info.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    ClassifierHead, DropPath, GlobalResponseNormMlp, LayerNorm, LayerScale, Mlp,
+    NormMlpClassifierHead, calculate_drop_path_rates, create_conv2d, get_norm_layer,
+    trunc_normal_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['ConvNeXt', 'ConvNeXtBlock']
+
+
+class Downsample(nnx.Module):
+    def __init__(self, in_chs, out_chs, stride=1, dilation=1, *, dtype=None, param_dtype=jnp.float32, rngs):
+        if in_chs != out_chs or stride > 1:
+            self.conv = create_conv2d(
+                in_chs, out_chs, 1, stride=stride, dilation=dilation,
+                bias=True, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        else:
+            self.conv = None
+
+    def __call__(self, x):
+        if self.conv is None:
+            return x
+        return self.conv(x)
+
+
+class ConvNeXtBlock(nnx.Module):
+    """(reference convnext.py ConvNeXtBlock)."""
+
+    def __init__(
+            self,
+            in_chs: int,
+            out_chs: Optional[int] = None,
+            kernel_size: int = 7,
+            stride: int = 1,
+            dilation: int = 1,
+            mlp_ratio: float = 4.0,
+            conv_bias: bool = True,
+            use_grn: bool = False,
+            ls_init_value: Optional[float] = 1e-6,
+            act_layer: Union[str, Callable] = 'gelu',
+            norm_layer: Optional[Callable] = None,
+            drop_path: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        out_chs = out_chs or in_chs
+        norm_layer = norm_layer or LayerNorm
+        self.use_shortcut = stride == 1 and in_chs == out_chs
+
+        self.conv_dw = create_conv2d(
+            in_chs, out_chs, kernel_size, stride=stride, dilation=dilation,
+            depthwise=True, bias=conv_bias, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm = norm_layer(out_chs, rngs=rngs)
+        mlp_layer = GlobalResponseNormMlp if use_grn else Mlp
+        self.mlp = mlp_layer(
+            out_chs, int(mlp_ratio * out_chs), act_layer=act_layer,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.ls = LayerScale(out_chs, ls_init_value, param_dtype=param_dtype, rngs=rngs) \
+            if ls_init_value is not None else None
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+        self.shortcut = None if self.use_shortcut else Downsample(
+            in_chs, out_chs, stride=stride, dilation=dilation,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.conv_dw(x)
+        x = self.norm(x)
+        x = self.mlp(x)
+        if self.ls is not None:
+            x = self.ls(x)
+        x = self.drop_path(x)
+        if self.shortcut is not None:
+            shortcut = self.shortcut(shortcut)
+        return x + shortcut
+
+
+class ConvNeXtStage(nnx.Module):
+    def __init__(
+            self,
+            in_chs: int,
+            out_chs: int,
+            kernel_size: int = 7,
+            stride: int = 2,
+            depth: int = 2,
+            dilation=(1, 1),
+            drop_path_rates=None,
+            ls_init_value: Optional[float] = 1.0,
+            conv_bias: bool = True,
+            use_grn: bool = False,
+            act_layer: Union[str, Callable] = 'gelu',
+            norm_layer: Optional[Callable] = None,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        norm_layer = norm_layer or LayerNorm
+        if in_chs != out_chs or stride > 1 or dilation[0] != dilation[1]:
+            self.downsample_norm = norm_layer(in_chs, rngs=rngs)
+            self.downsample_conv = create_conv2d(
+                in_chs, out_chs, stride if stride > 1 else 1,
+                stride=stride, dilation=dilation[0], bias=conv_bias,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            in_chs = out_chs
+        else:
+            self.downsample_norm = None
+            self.downsample_conv = None
+
+        drop_path_rates = drop_path_rates or [0.0] * depth
+        self.blocks = nnx.List([
+            ConvNeXtBlock(
+                in_chs=in_chs if i == 0 else out_chs,
+                out_chs=out_chs,
+                kernel_size=kernel_size,
+                dilation=dilation[1],
+                drop_path=drop_path_rates[i],
+                ls_init_value=ls_init_value,
+                conv_bias=conv_bias,
+                use_grn=use_grn,
+                act_layer=act_layer,
+                norm_layer=norm_layer,
+                dtype=dtype,
+                param_dtype=param_dtype,
+                rngs=rngs,
+            )
+            for i in range(depth)
+        ])
+        self.grad_checkpointing = False
+
+    def __call__(self, x):
+        if self.downsample_norm is not None:
+            x = self.downsample_norm(x)
+            x = self.downsample_conv(x)
+        if self.grad_checkpointing:
+            x = checkpoint_seq(self.blocks, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
+        return x
+
+
+class ConvNeXt(nnx.Module):
+    def __init__(
+            self,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            output_stride: int = 32,
+            depths: Tuple[int, ...] = (3, 3, 9, 3),
+            dims: Tuple[int, ...] = (96, 192, 384, 768),
+            kernel_sizes: Union[int, Tuple[int, ...]] = 7,
+            ls_init_value: Optional[float] = 1e-6,
+            stem_type: str = 'patch',
+            patch_size: int = 4,
+            head_init_scale: float = 1.0,
+            head_norm_first: bool = False,
+            head_hidden_size: Optional[int] = None,
+            conv_bias: bool = True,
+            use_grn: bool = False,
+            act_layer: Union[str, Callable] = 'gelu',
+            norm_layer: Optional[Union[str, Callable]] = None,
+            drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert output_stride in (8, 16, 32)
+        if isinstance(kernel_sizes, int):
+            kernel_sizes = (kernel_sizes,) * 4
+        norm_layer = get_norm_layer(norm_layer) or LayerNorm
+
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+
+        # stem
+        assert stem_type in ('patch', 'overlap', 'overlap_tiered')
+        if stem_type == 'patch':
+            self.stem_conv = create_conv2d(
+                in_chans, dims[0], patch_size, stride=patch_size, bias=conv_bias,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.stem_conv2 = None
+            self.stem_norm = norm_layer(dims[0], rngs=rngs)
+            stem_stride = patch_size
+        else:
+            mid_chs = dims[0] // 2 if 'tiered' in stem_type else dims[0]
+            self.stem_conv = create_conv2d(
+                in_chans, mid_chs, 3, stride=2, padding='same', bias=conv_bias,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.stem_conv2 = create_conv2d(
+                mid_chs, dims[0], 3, stride=2, padding='same', bias=conv_bias,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.stem_norm = norm_layer(dims[0], rngs=rngs)
+            stem_stride = 4
+
+        # stages
+        dp_rates = calculate_drop_path_rates(drop_path_rate, list(depths), stagewise=True)
+        stages = []
+        prev_chs = dims[0]
+        curr_stride = stem_stride
+        dilation = 1
+        self.feature_info = []
+        for i in range(len(depths)):
+            stride = 2 if curr_stride == 2 or i > 0 else 1
+            if curr_stride >= output_stride and stride > 1:
+                dilation *= stride
+                stride = 1
+            curr_stride *= stride
+            first_dilation = 1 if dilation in (1, 2) else 2
+            out_chs = dims[i]
+            stages.append(ConvNeXtStage(
+                prev_chs,
+                out_chs,
+                kernel_size=kernel_sizes[i],
+                stride=stride,
+                dilation=(first_dilation, dilation),
+                depth=depths[i],
+                drop_path_rates=dp_rates[i],
+                ls_init_value=ls_init_value,
+                conv_bias=conv_bias,
+                use_grn=use_grn,
+                act_layer=act_layer,
+                norm_layer=norm_layer,
+                dtype=dtype,
+                param_dtype=param_dtype,
+                rngs=rngs,
+            ))
+            prev_chs = out_chs
+            self.feature_info += [dict(num_chs=prev_chs, reduction=curr_stride, module=f'stages.{i}')]
+        self.stages = nnx.List(stages)
+
+        self.num_features = self.head_hidden_size = prev_chs
+        if head_norm_first:
+            self.norm_pre = norm_layer(self.num_features, rngs=rngs)
+            self.head = ClassifierHead(
+                self.num_features, num_classes, pool_type=global_pool, drop_rate=drop_rate,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        else:
+            self.norm_pre = None
+            self.head = NormMlpClassifierHead(
+                self.num_features, num_classes,
+                hidden_size=head_hidden_size,
+                pool_type=global_pool,
+                drop_rate=drop_rate,
+                norm_layer=norm_layer,
+                act_layer='gelu',
+                dtype=dtype,
+                param_dtype=param_dtype,
+                rngs=rngs,
+            )
+            if head_hidden_size:
+                self.head_hidden_size = head_hidden_size
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^stem_',
+            blocks=r'^stages\.(\d+)' if coarse else [
+                (r'^stages\.(\d+)\.downsample', (0,)),
+                (r'^stages\.(\d+)\.blocks\.(\d+)', None),
+                (r'^norm_pre', (99999,)),
+            ],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        for s in self.stages:
+            s.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        self.head.reset(num_classes, pool_type=global_pool, rngs=rngs)
+
+    # -- forward -------------------------------------------------------------
+    def _stem(self, x):
+        x = self.stem_conv(x)
+        if self.stem_conv2 is not None:
+            x = self.stem_conv2(x)
+        return self.stem_norm(x)
+
+    def forward_features(self, x):
+        x = self._stem(x)
+        for stage in self.stages:
+            x = stage(x)
+        if self.norm_pre is not None:
+            x = self.norm_pre(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        return self.head(x, pre_logits=pre_logits)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self,
+            x,
+            indices: Optional[Union[int, List[int]]] = None,
+            norm: bool = False,
+            stop_early: bool = False,
+            output_fmt: str = 'NHWC',
+            intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC', 'Conv models emit NHWC features'
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        x = self._stem(x)
+        intermediates = []
+        stages = self.stages if not stop_early else list(self.stages)[:max_index + 1]
+        for i, stage in enumerate(stages):
+            x = stage(x)
+            if i in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        if self.norm_pre is not None:
+            x = self.norm_pre(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        self.stages = nnx.List(list(self.stages)[:max_index + 1])
+        if prune_norm:
+            self.norm_pre = None
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': (7, 7),
+        'crop_pct': 0.875,
+        'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406),
+        'std': (0.229, 0.224, 0.225),
+        'first_conv': 'stem_conv',
+        'classifier': 'head.fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'convnext_atto.d2_in1k': _cfg(hf_hub_id='timm/'),
+    'convnext_femto.d1_in1k': _cfg(hf_hub_id='timm/'),
+    'convnext_pico.d1_in1k': _cfg(hf_hub_id='timm/'),
+    'convnext_nano.d1h_in1k': _cfg(hf_hub_id='timm/'),
+    'convnext_tiny.fb_in1k': _cfg(hf_hub_id='timm/'),
+    'convnext_small.fb_in1k': _cfg(hf_hub_id='timm/'),
+    'convnext_base.fb_in1k': _cfg(hf_hub_id='timm/'),
+    'convnext_large.fb_in1k': _cfg(hf_hub_id='timm/'),
+    'convnextv2_atto.fcmae_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'convnextv2_nano.fcmae_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'convnextv2_tiny.fcmae_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'convnextv2_base.fcmae_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'test_convnext.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+    'test_convnext2.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+    'test_convnext3.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+})
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    return convert_torch_state_dict(state_dict, model)
+
+
+def _create_convnext(variant: str, pretrained: bool = False, **kwargs) -> ConvNeXt:
+    out_indices = kwargs.pop('out_indices', (0, 1, 2, 3))
+    return build_model_with_cfg(
+        ConvNeXt,
+        variant,
+        pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices),
+        **kwargs,
+    )
+
+
+@register_model
+def convnext_atto(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 6, 2), dims=(40, 80, 160, 320), conv_bias=False)
+    return _create_convnext('convnext_atto', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_femto(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 6, 2), dims=(48, 96, 192, 384), conv_bias=False)
+    return _create_convnext('convnext_femto', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_pico(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 6, 2), dims=(64, 128, 256, 512), conv_bias=False)
+    return _create_convnext('convnext_pico', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_nano(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 8, 2), dims=(80, 160, 320, 640), conv_bias=False)
+    return _create_convnext('convnext_nano', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_tiny(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(3, 3, 9, 3), dims=(96, 192, 384, 768))
+    return _create_convnext('convnext_tiny', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_small(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(3, 3, 27, 3), dims=(96, 192, 384, 768))
+    return _create_convnext('convnext_small', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_base(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(3, 3, 27, 3), dims=(128, 256, 512, 1024))
+    return _create_convnext('convnext_base', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_large(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(3, 3, 27, 3), dims=(192, 384, 768, 1536))
+    return _create_convnext('convnext_large', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnextv2_atto(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 6, 2), dims=(40, 80, 160, 320), use_grn=True, ls_init_value=None, conv_bias=True)
+    return _create_convnext('convnextv2_atto', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnextv2_nano(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 8, 2), dims=(80, 160, 320, 640), use_grn=True, ls_init_value=None, conv_bias=True)
+    return _create_convnext('convnextv2_nano', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnextv2_tiny(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(3, 3, 9, 3), dims=(96, 192, 384, 768), use_grn=True, ls_init_value=None)
+    return _create_convnext('convnextv2_tiny', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnextv2_base(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(3, 3, 27, 3), dims=(128, 256, 512, 1024), use_grn=True, ls_init_value=None)
+    return _create_convnext('convnextv2_base', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_convnext(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(1, 2, 4, 2), dims=(24, 32, 48, 64), norm_layer='layernorm')
+    return _create_convnext('test_convnext', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_convnext2(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(1, 1, 1, 1), dims=(32, 64, 96, 128))
+    return _create_convnext('test_convnext2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_convnext3(pretrained=False, **kwargs) -> ConvNeXt:
+    model_args = dict(
+        depths=(1, 1, 1, 1), dims=(32, 64, 96, 128), stem_type='overlap_tiered', use_grn=True, ls_init_value=None)
+    return _create_convnext('test_convnext3', pretrained=pretrained, **dict(model_args, **kwargs))

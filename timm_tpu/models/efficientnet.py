@@ -1,0 +1,352 @@
+"""EfficientNet / EfficientNetV2 family, TPU-native NHWC
+(reference: timm/models/efficientnet.py:1-2973).
+
+Depthwise + SE + SiLU conv nets driven by the arch-string decoder
+(_efficientnet_builder.py). NHWC depthwise convs map directly onto the TPU
+conv units without the reference's channels_last workarounds.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNormAct2d, SelectAdaptivePool2d, create_conv2d, get_act_fn, get_norm_layer
+from ..layers.drop import Dropout
+from ..layers.weight_init import trunc_normal_, zeros_
+from ._builder import build_model_with_cfg
+from ._efficientnet_builder import (
+    EfficientNetBuilder, decode_arch_def, resolve_act_layer, resolve_bn_args, round_channels,
+)
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['EfficientNet']
+
+
+class EfficientNet(nnx.Module):
+    def __init__(
+            self,
+            block_args: List[List[Dict]],
+            num_classes: int = 1000,
+            num_features: int = 1280,
+            in_chans: int = 3,
+            stem_size: int = 32,
+            stem_kernel_size: int = 3,
+            fix_stem: bool = False,
+            output_stride: int = 32,
+            pad_type: str = '',
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            se_from_exp: bool = False,
+            round_chs_fn: Callable = round_channels,
+            drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            global_pool: str = 'avg',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+
+        if not fix_stem:
+            stem_size = round_chs_fn(stem_size)
+        self.conv_stem = create_conv2d(
+            in_chans, stem_size, stem_kernel_size, stride=2, padding=pad_type or 'same',
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn1 = norm_layer(stem_size, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        builder = EfficientNetBuilder(
+            output_stride=output_stride,
+            pad_type=pad_type,
+            round_chs_fn=round_chs_fn,
+            se_from_exp=se_from_exp,
+            act_layer=act_layer,
+            norm_layer=norm_layer,
+            drop_path_rate=drop_path_rate,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            rngs=rngs,
+        )
+        self.blocks = nnx.List(builder(stem_size, block_args))
+        self.feature_info = builder.features
+        head_chs = builder.in_chs
+
+        # head
+        self.num_features = num_features
+        self.conv_head = create_conv2d(
+            head_chs, num_features, 1, padding=pad_type or 'same',
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn2 = norm_layer(num_features, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.head_hidden_size = num_features
+        self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=True)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.classifier = nnx.Linear(
+            num_features, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self.grad_checkpointing = False
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^conv_stem|bn1',
+            blocks=[
+                (r'^blocks\.(\d+)' if coarse else r'^blocks\.(\d+)\.(\d+)', None),
+                (r'conv_head|bn2', (99999,)),
+            ],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.classifier
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=True)
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.classifier = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.bn1(self.conv_stem(x))
+        for stage in self.blocks:
+            if self.grad_checkpointing:
+                x = checkpoint_seq(stage, x)
+            else:
+                for b in stage:
+                    x = b(x)
+        x = self.bn2(self.conv_head(x))
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = self.global_pool(x)
+        x = self.head_drop(x)
+        if pre_logits or self.classifier is None:
+            return x
+        return self.classifier(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self,
+            x,
+            indices: Optional[Union[int, List[int]]] = None,
+            norm: bool = False,
+            stop_early: bool = False,
+            output_fmt: str = 'NHWC',
+            intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        x = self.bn1(self.conv_stem(x))
+        intermediates = []
+        stages = self.blocks if not stop_early else list(self.blocks)[:max_index + 1]
+        for i, stage in enumerate(stages):
+            for b in stage:
+                x = b(x)
+            if i in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        x = self.bn2(self.conv_head(x))
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def _gen_efficientnet(variant, channel_multiplier=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
+    """EfficientNet B0-B7 generator (reference efficientnet.py _gen_efficientnet)."""
+    arch_def = [
+        ['ds_r1_k3_s1_e1_c16_se0.25'],
+        ['ir_r2_k3_s2_e6_c24_se0.25'],
+        ['ir_r2_k5_s2_e6_c40_se0.25'],
+        ['ir_r3_k3_s2_e6_c80_se0.25'],
+        ['ir_r3_k5_s1_e6_c112_se0.25'],
+        ['ir_r4_k5_s2_e6_c192_se0.25'],
+        ['ir_r1_k3_s1_e6_c320_se0.25'],
+    ]
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier)
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, depth_multiplier),
+        num_features=round_chs_fn(1280),
+        stem_size=32,
+        round_chs_fn=round_chs_fn,
+        act_layer=resolve_act_layer(kwargs, 'silu'),
+        **kwargs,
+    )
+    return build_model_with_cfg(
+        EfficientNet, variant, pretrained,
+        pretrained_filter_fn=_filter_fn,
+        feature_cfg=dict(out_indices=(1, 2, 3, 4, 5)),
+        **model_kwargs,
+    )
+
+
+def _gen_efficientnetv2_s(variant, channel_multiplier=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
+    """EfficientNet-V2 small (reference efficientnet.py _gen_efficientnetv2_s)."""
+    arch_def = [
+        ['cn_r2_k3_s1_e1_c24_skip'],
+        ['er_r4_k3_s2_e4_c48'],
+        ['er_r4_k3_s2_e4_c64'],
+        ['ir_r6_k3_s2_e4_c128_se0.25'],
+        ['ir_r9_k3_s1_e6_c160_se0.25'],
+        ['ir_r15_k3_s2_e6_c256_se0.25'],
+    ]
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier)
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, depth_multiplier),
+        num_features=round_chs_fn(1280),
+        stem_size=24,
+        round_chs_fn=round_chs_fn,
+        act_layer=resolve_act_layer(kwargs, 'silu'),
+        **kwargs,
+    )
+    return build_model_with_cfg(
+        EfficientNet, variant, pretrained,
+        pretrained_filter_fn=_filter_fn,
+        feature_cfg=dict(out_indices=(1, 2, 3, 4, 5)),
+        **model_kwargs,
+    )
+
+
+def _gen_efficientnetv2_m(variant, pretrained=False, **kwargs):
+    arch_def = [
+        ['cn_r3_k3_s1_e1_c24_skip'],
+        ['er_r5_k3_s2_e4_c48'],
+        ['er_r5_k3_s2_e4_c80'],
+        ['ir_r7_k3_s2_e4_c160_se0.25'],
+        ['ir_r14_k3_s1_e6_c176_se0.25'],
+        ['ir_r18_k3_s2_e6_c304_se0.25'],
+        ['ir_r5_k3_s1_e6_c512_se0.25'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        num_features=1280,
+        stem_size=24,
+        act_layer=resolve_act_layer(kwargs, 'silu'),
+        **kwargs,
+    )
+    return build_model_with_cfg(
+        EfficientNet, variant, pretrained,
+        pretrained_filter_fn=_filter_fn,
+        feature_cfg=dict(out_indices=(1, 2, 3, 4, 5)),
+        **model_kwargs,
+    )
+
+
+def _filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    return convert_torch_state_dict(state_dict, model)
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': (7, 7),
+        'crop_pct': 0.875,
+        'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406),
+        'std': (0.229, 0.224, 0.225),
+        'first_conv': 'conv_stem',
+        'classifier': 'classifier',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'efficientnet_b0.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'efficientnet_b1.ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), crop_pct=0.882),
+    'efficientnet_b2.ra_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.89),
+    'efficientnet_b3.ra2_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 288, 288), crop_pct=0.904),
+    'efficientnetv2_s.in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 300, 300), test_input_size=(3, 384, 384), crop_pct=1.0),
+    'efficientnetv2_m.untrained': _cfg(input_size=(3, 320, 320), test_input_size=(3, 416, 416), crop_pct=1.0),
+    'tf_efficientnetv2_s.in1k': _cfg(
+        hf_hub_id='timm/', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5),
+        input_size=(3, 300, 300), test_input_size=(3, 384, 384), crop_pct=1.0),
+    'test_efficientnet.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+})
+
+
+@register_model
+def efficientnet_b0(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnet('efficientnet_b0', 1.0, 1.0, pretrained, **kwargs)
+
+
+@register_model
+def efficientnet_b1(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnet('efficientnet_b1', 1.0, 1.1, pretrained, **kwargs)
+
+
+@register_model
+def efficientnet_b2(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnet('efficientnet_b2', 1.1, 1.2, pretrained, **kwargs)
+
+
+@register_model
+def efficientnet_b3(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnet('efficientnet_b3', 1.2, 1.4, pretrained, **kwargs)
+
+
+@register_model
+def efficientnetv2_s(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnetv2_s('efficientnetv2_s', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def efficientnetv2_m(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnetv2_m('efficientnetv2_m', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def tf_efficientnetv2_s(pretrained=False, **kwargs) -> EfficientNet:
+    """TF-origin weights variant; same arch, SAME padding is already native."""
+    return _gen_efficientnetv2_s('tf_efficientnetv2_s', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def test_efficientnet(pretrained=False, **kwargs) -> EfficientNet:
+    """Tiny fixture (reference efficientnet.py:2902)."""
+    arch_def = [
+        ['cn_r1_k3_s1_e1_c16_skip'],
+        ['er_r1_k3_s2_e4_c24'],
+        ['er_r1_k3_s2_e4_c32'],
+        ['ir_r1_k3_s2_e4_c48_se0.25'],
+        ['ir_r1_k3_s2_e4_c64_se0.25'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        num_features=256,
+        stem_size=16,
+        act_layer=resolve_act_layer(kwargs, 'silu'),
+        **kwargs,
+    )
+    return build_model_with_cfg(
+        EfficientNet, 'test_efficientnet', pretrained,
+        pretrained_filter_fn=_filter_fn,
+        feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
+        **model_kwargs,
+    )

@@ -1,0 +1,325 @@
+"""MLP-Mixer / gMLP (reference: timm/models/mlp_mixer.py:1-880), TPU-native."""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    DropPath, Dropout, GatedMlp, GluMlp, LayerNorm, Mlp, PatchEmbed,
+    calculate_drop_path_rates, get_norm_layer, global_pool_nlc, trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['MlpMixer', 'MixerBlock', 'SpatialGatingUnit']
+
+
+class MixerBlock(nnx.Module):
+    """token-mixing MLP over N + channel-mixing MLP over C (reference mlp_mixer.py MixerBlock)."""
+
+    def __init__(
+            self,
+            dim: int,
+            seq_len: int,
+            mlp_ratio=(0.5, 4.0),
+            mlp_layer: Callable = Mlp,
+            norm_layer: Callable = LayerNorm,
+            act_layer: Union[str, Callable] = 'gelu',
+            drop: float = 0.0,
+            drop_path: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        tokens_dim, channels_dim = [int(x * dim) for x in mlp_ratio]
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.mlp_tokens = mlp_layer(seq_len, tokens_dim, act_layer=act_layer, drop=drop,
+                                    dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp_channels = mlp_layer(dim, channels_dim, act_layer=act_layer, drop=drop,
+                                      dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        x = x + self.drop_path1(self.mlp_tokens(self.norm1(x).transpose(0, 2, 1)).transpose(0, 2, 1))
+        x = x + self.drop_path2(self.mlp_channels(self.norm2(x)))
+        return x
+
+
+class SpatialGatingUnit(nnx.Module):
+    """gMLP spatial gating (reference mlp_mixer.py SpatialGatingUnit)."""
+
+    def __init__(self, dim: int, seq_len: int, norm_layer: Callable = LayerNorm, *,
+                 dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        gate_dim = dim // 2
+        self.norm = norm_layer(gate_dim, rngs=rngs)
+        self.proj = nnx.Linear(
+            seq_len, seq_len, kernel_init=nnx.initializers.normal(1e-6), bias_init=nnx.initializers.ones,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        u, v = jnp.split(x, 2, axis=-1)
+        v = self.norm(v)
+        v = self.proj(v.transpose(0, 2, 1)).transpose(0, 2, 1)
+        return u * v
+
+
+class SpatialGatingBlock(nnx.Module):
+    def __init__(
+            self,
+            dim: int,
+            seq_len: int,
+            mlp_ratio: float = 4.0,
+            norm_layer: Callable = LayerNorm,
+            act_layer: Union[str, Callable] = 'gelu',
+            drop: float = 0.0,
+            drop_path: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        channel_dim = int(dim * mlp_ratio)
+        self.norm = norm_layer(dim, rngs=rngs)
+        sgu = partial(SpatialGatingUnit, seq_len=seq_len, dtype=dtype, param_dtype=param_dtype)
+        self.mlp_channels = GatedMlp(
+            dim, channel_dim, act_layer=act_layer, gate_layer=lambda d, rngs: sgu(d, rngs=rngs),
+            drop=drop, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        return x + self.drop_path(self.mlp_channels(self.norm(x)))
+
+
+class MlpMixer(nnx.Module):
+    def __init__(
+            self,
+            num_classes: int = 1000,
+            img_size: int = 224,
+            in_chans: int = 3,
+            patch_size: int = 16,
+            num_blocks: int = 8,
+            embed_dim: int = 512,
+            mlp_ratio=(0.5, 4.0),
+            block_layer: Callable = MixerBlock,
+            mlp_layer: Callable = Mlp,
+            norm_layer: Optional[Union[str, Callable]] = None,
+            act_layer: Union[str, Callable] = 'gelu',
+            drop_rate: float = 0.0,
+            proj_drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            stem_norm: bool = False,
+            global_pool: str = 'avg',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.num_classes = num_classes
+        self.num_features = self.head_hidden_size = self.embed_dim = embed_dim
+        self.grad_checkpointing = False
+        self.global_pool = global_pool
+        norm_layer = get_norm_layer(norm_layer) or LayerNorm
+
+        self.stem = PatchEmbed(
+            img_size=img_size, patch_size=patch_size, in_chans=in_chans, embed_dim=embed_dim,
+            norm_layer=norm_layer if stem_norm else None,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        reduction = self.stem.patch_size[0]
+        dpr = calculate_drop_path_rates(drop_path_rate, num_blocks)
+        self.blocks = nnx.List([
+            block_layer(
+                embed_dim,
+                self.stem.num_patches,
+                mlp_ratio=mlp_ratio,
+                mlp_layer=mlp_layer,
+                norm_layer=norm_layer,
+                act_layer=act_layer,
+                drop=proj_drop_rate,
+                drop_path=dpr[i],
+                dtype=dtype,
+                param_dtype=param_dtype,
+                rngs=rngs,
+            ) if block_layer is MixerBlock else block_layer(
+                embed_dim,
+                self.stem.num_patches,
+                mlp_ratio=mlp_ratio if not isinstance(mlp_ratio, (tuple, list)) else 4.0,
+                norm_layer=norm_layer,
+                act_layer=act_layer,
+                drop=proj_drop_rate,
+                drop_path=dpr[i],
+                dtype=dtype,
+                param_dtype=param_dtype,
+                rngs=rngs,
+            )
+            for i in range(num_blocks)
+        ])
+        self.feature_info = [
+            dict(module=f'blocks.{i}', num_chs=embed_dim, reduction=reduction) for i in range(num_blocks)]
+        self.norm = norm_layer(embed_dim, rngs=rngs)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.head = nnx.Linear(
+            embed_dim, num_classes, kernel_init=zeros_, bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^stem',
+            blocks=[(r'^blocks\.(\d+)', None), (r'^norm', (99999,))],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            assert global_pool in ('', 'avg', 'max', 'avgmax')
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = nnx.Linear(
+            self.embed_dim, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    def forward_features(self, x):
+        x = self.stem(x)
+        if self.grad_checkpointing:
+            x = checkpoint_seq(self.blocks, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
+        return self.norm(x)
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = global_pool_nlc(x, pool_type=self.global_pool, num_prefix_tokens=0)
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt in ('NHWC', 'NLC')
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        B, H, W, _ = x.shape
+        grid = self.stem.dynamic_feat_size((H, W))
+        x = self.stem(x)
+        intermediates = []
+        blocks = self.blocks if not stop_early else list(self.blocks)[:max_index + 1]
+        for i, blk in enumerate(blocks):
+            x = blk(x)
+            if i in take_indices:
+                y = self.norm(x) if norm else x
+                if output_fmt == 'NHWC':
+                    y = y.reshape(B, grid[0], grid[1], -1)
+                intermediates.append(y)
+        if intermediates_only:
+            return intermediates
+        x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
+        if prune_norm:
+            self.norm = LayerNorm(self.embed_dim, rngs=nnx.Rngs(0))
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': None,
+        'crop_pct': 0.875,
+        'interpolation': 'bicubic',
+        'fixed_input_size': True,
+        'mean': (0.5, 0.5, 0.5),
+        'std': (0.5, 0.5, 0.5),
+        'first_conv': 'stem.proj',
+        'classifier': 'head',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'mixer_s32_224.untrained': _cfg(),
+    'mixer_s16_224.untrained': _cfg(),
+    'mixer_b32_224.untrained': _cfg(),
+    'mixer_b16_224.goog_in21k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'mixer_l16_224.goog_in21k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'gmlp_s16_224.ra3_in1k': _cfg(hf_hub_id='timm/'),
+    'test_mixer.untrained': _cfg(input_size=(3, 160, 160)),
+})
+
+
+def _create_mixer(variant, pretrained=False, **kwargs):
+    from ._torch_convert import convert_torch_state_dict
+    out_indices = kwargs.pop('out_indices', 3)
+    return build_model_with_cfg(
+        MlpMixer, variant, pretrained,
+        pretrained_filter_fn=convert_torch_state_dict,
+        feature_cfg=dict(out_indices=out_indices),
+        **kwargs,
+    )
+
+
+@register_model
+def mixer_s32_224(pretrained=False, **kwargs) -> MlpMixer:
+    return _create_mixer('mixer_s32_224', pretrained, **dict(dict(patch_size=32, num_blocks=8, embed_dim=512), **kwargs))
+
+
+@register_model
+def mixer_s16_224(pretrained=False, **kwargs) -> MlpMixer:
+    return _create_mixer('mixer_s16_224', pretrained, **dict(dict(patch_size=16, num_blocks=8, embed_dim=512), **kwargs))
+
+
+@register_model
+def mixer_b32_224(pretrained=False, **kwargs) -> MlpMixer:
+    return _create_mixer('mixer_b32_224', pretrained, **dict(dict(patch_size=32, num_blocks=12, embed_dim=768), **kwargs))
+
+
+@register_model
+def mixer_b16_224(pretrained=False, **kwargs) -> MlpMixer:
+    return _create_mixer('mixer_b16_224', pretrained, **dict(dict(patch_size=16, num_blocks=12, embed_dim=768), **kwargs))
+
+
+@register_model
+def mixer_l16_224(pretrained=False, **kwargs) -> MlpMixer:
+    return _create_mixer('mixer_l16_224', pretrained, **dict(dict(patch_size=16, num_blocks=24, embed_dim=1024), **kwargs))
+
+
+@register_model
+def gmlp_s16_224(pretrained=False, **kwargs) -> MlpMixer:
+    model_args = dict(
+        patch_size=16, num_blocks=30, embed_dim=256, mlp_ratio=6.0, block_layer=SpatialGatingBlock)
+    return _create_mixer('gmlp_s16_224', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_mixer(pretrained=False, **kwargs) -> MlpMixer:
+    model_args = dict(img_size=160, patch_size=16, num_blocks=2, embed_dim=64)
+    return _create_mixer('test_mixer', pretrained, **dict(model_args, **kwargs))

@@ -1,0 +1,495 @@
+"""ResNet / ResNeXt / SE-ResNet, TPU-native NHWC.
+
+Re-designed from the reference (timm/models/resnet.py:1-2266). BatchNorm here
+is natively a SyncBN under pjit (stats reduce over the global sharded batch),
+so the reference's convert_sync_batchnorm/distribute_bn machinery is absent
+by design (see timm_tpu/layers/norm.py BatchNorm2d).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    BatchNormAct2d, ClassifierHead, DropPath, SEModule, calculate_drop_path_rates,
+    create_conv2d, get_act_fn,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['ResNet', 'BasicBlock', 'Bottleneck']
+
+
+def avg_pool2d(x, kernel: int = 2, stride: int = 2, pad_same: bool = False):
+    """NHWC average pool (count_include_pad=False semantics, matching the
+    reference's AvgPool2d in downsample_avg, resnet.py:324)."""
+    import jax
+    padding = 'SAME' if pad_same else 'VALID'
+    window = (1, kernel, kernel, 1)
+    strides = (1, stride, stride, 1)
+    out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, padding)
+    if pad_same:
+        ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, padding)
+        return out / counts
+    return out / (kernel * kernel)
+
+
+def max_pool2d(x, kernel: int = 3, stride: int = 2):
+    import jax
+    neg = -jnp.inf if x.dtype == jnp.float32 else jnp.finfo(x.dtype).min
+    return jax.lax.reduce_window(
+        x, neg, jax.lax.max, (1, kernel, kernel, 1), (1, stride, stride, 1), 'SAME')
+
+
+class DownsampleConv(nnx.Module):
+    def __init__(self, in_chs, out_chs, stride=1, dilation=1, norm_layer=None, *, dtype=None, param_dtype=jnp.float32, rngs):
+        norm_layer = norm_layer or BatchNormAct2d
+        self.conv = create_conv2d(
+            in_chs, out_chs, 1, stride=stride, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn = norm_layer(out_chs, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        return self.bn(self.conv(x))
+
+
+class DownsampleAvg(nnx.Module):
+    """avg-pool + 1x1 conv downsample ('d' variants, reference resnet.py downsample_avg)."""
+
+    def __init__(self, in_chs, out_chs, stride=1, dilation=1, norm_layer=None, *, dtype=None, param_dtype=jnp.float32, rngs):
+        norm_layer = norm_layer or BatchNormAct2d
+        self.pool_stride = stride if dilation == 1 else 1
+        self.conv = create_conv2d(in_chs, out_chs, 1, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn = norm_layer(out_chs, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        if self.pool_stride > 1:
+            x = avg_pool2d(x, 2, self.pool_stride, pad_same=True)
+        return self.bn(self.conv(x))
+
+
+class BasicBlock(nnx.Module):
+    expansion = 1
+
+    def __init__(
+            self,
+            inplanes: int,
+            planes: int,
+            stride: int = 1,
+            downsample=None,
+            cardinality: int = 1,
+            base_width: int = 64,
+            reduce_first: int = 1,
+            dilation: int = 1,
+            first_dilation: Optional[int] = None,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            attn_layer: Optional[Callable] = None,
+            drop_path: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert cardinality == 1 and base_width == 64, 'BasicBlock only supports default cardinality/width'
+        first_planes = planes // reduce_first
+        outplanes = planes * self.expansion
+        first_dilation = first_dilation or dilation
+
+        self.conv1 = create_conv2d(
+            inplanes, first_planes, 3, stride=stride, dilation=first_dilation, padding='same',
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn1 = norm_layer(first_planes, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv2 = create_conv2d(
+            first_planes, outplanes, 3, dilation=dilation, padding='same',
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn2 = norm_layer(outplanes, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.se = attn_layer(outplanes, dtype=dtype, param_dtype=param_dtype, rngs=rngs) if attn_layer else None
+        self.act = get_act_fn(act_layer)
+        self.downsample = downsample
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def zero_init_last(self):
+        if hasattr(self.bn2, 'scale'):
+            self.bn2.scale[...] = jnp.zeros_like(self.bn2.scale[...])
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.bn1(self.conv1(x))
+        x = self.bn2(self.conv2(x))
+        if self.se is not None:
+            x = self.se(x)
+        x = self.drop_path(x)
+        if self.downsample is not None:
+            shortcut = self.downsample(shortcut)
+        return self.act(x + shortcut)
+
+
+class Bottleneck(nnx.Module):
+    expansion = 4
+
+    def __init__(
+            self,
+            inplanes: int,
+            planes: int,
+            stride: int = 1,
+            downsample=None,
+            cardinality: int = 1,
+            base_width: int = 64,
+            reduce_first: int = 1,
+            dilation: int = 1,
+            first_dilation: Optional[int] = None,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            attn_layer: Optional[Callable] = None,
+            drop_path: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        width = int(math.floor(planes * (base_width / 64)) * cardinality)
+        first_planes = width // reduce_first
+        outplanes = planes * self.expansion
+        first_dilation = first_dilation or dilation
+
+        self.conv1 = create_conv2d(inplanes, first_planes, 1, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn1 = norm_layer(first_planes, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv2 = create_conv2d(
+            first_planes, width, 3, stride=stride, dilation=first_dilation, groups=cardinality,
+            padding='same', dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn2 = norm_layer(width, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv3 = create_conv2d(width, outplanes, 1, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn3 = norm_layer(outplanes, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.se = attn_layer(outplanes, dtype=dtype, param_dtype=param_dtype, rngs=rngs) if attn_layer else None
+        self.act = get_act_fn(act_layer)
+        self.downsample = downsample
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def zero_init_last(self):
+        if hasattr(self.bn3, 'scale'):
+            self.bn3.scale[...] = jnp.zeros_like(self.bn3.scale[...])
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.bn1(self.conv1(x))
+        x = self.bn2(self.conv2(x))
+        x = self.bn3(self.conv3(x))
+        if self.se is not None:
+            x = self.se(x)
+        x = self.drop_path(x)
+        if self.downsample is not None:
+            shortcut = self.downsample(shortcut)
+        return self.act(x + shortcut)
+
+
+class ResNet(nnx.Module):
+    def __init__(
+            self,
+            block: Union[Type[BasicBlock], Type[Bottleneck], str] = Bottleneck,
+            layers: Tuple[int, ...] = (3, 4, 6, 3),
+            channels: Tuple[int, ...] = (64, 128, 256, 512),
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            output_stride: int = 32,
+            global_pool: str = 'avg',
+            cardinality: int = 1,
+            base_width: int = 64,
+            stem_width: int = 64,
+            stem_type: str = '',
+            replace_stem_pool: bool = False,
+            avg_down: bool = False,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            se_layer: Optional[Callable] = None,
+            drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            zero_init_last: bool = True,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        if isinstance(block, str):
+            block = {'basic': BasicBlock, 'bottleneck': Bottleneck}[block.lower()]
+        assert output_stride in (8, 16, 32)
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+
+        # stem
+        deep_stem = 'deep' in stem_type
+        inplanes = stem_width * 2 if deep_stem else 64
+        if deep_stem:
+            stem_chs = (stem_width, stem_width)
+            if 'tiered' in stem_type:
+                stem_chs = (3 * (stem_width // 4), stem_width)
+            self.conv1 = nnx.List([
+                create_conv2d(in_chans, stem_chs[0], 3, stride=2, padding='same',
+                              dtype=dtype, param_dtype=param_dtype, rngs=rngs),
+                create_conv2d(stem_chs[0], stem_chs[1], 3, padding='same',
+                              dtype=dtype, param_dtype=param_dtype, rngs=rngs),
+                create_conv2d(stem_chs[1], inplanes, 3, padding='same',
+                              dtype=dtype, param_dtype=param_dtype, rngs=rngs),
+            ])
+            self.bn_stem = nnx.List([
+                norm_layer(stem_chs[0], act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs),
+                norm_layer(stem_chs[1], act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs),
+            ])
+        else:
+            self.conv1 = create_conv2d(
+                in_chans, inplanes, 7, stride=2, padding='same',
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.bn_stem = None
+        self.bn1 = norm_layer(inplanes, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.feature_info = [dict(num_chs=inplanes, reduction=2, module='bn1')]
+
+        # stages
+        stage_blocks = []
+        total_blocks = sum(layers)
+        dpr = calculate_drop_path_rates(drop_path_rate, list(layers), stagewise=True)
+        net_stride = 4
+        dilation = 1
+        for stage_idx, (planes, num_blocks) in enumerate(zip(channels, layers)):
+            stride = 1 if stage_idx == 0 else 2
+            if net_stride >= output_stride and stride > 1:
+                dilation *= stride
+                stride = 1
+            else:
+                net_stride *= stride
+            downsample = None
+            if stride != 1 or inplanes != planes * block.expansion:
+                ds_cls = DownsampleAvg if avg_down else DownsampleConv
+                downsample = ds_cls(
+                    inplanes, planes * block.expansion, stride=stride, dilation=dilation,
+                    norm_layer=norm_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            blocks = []
+            for block_idx in range(num_blocks):
+                blocks.append(block(
+                    inplanes,
+                    planes,
+                    stride=stride if block_idx == 0 else 1,
+                    downsample=downsample if block_idx == 0 else None,
+                    cardinality=cardinality,
+                    base_width=base_width,
+                    dilation=dilation,
+                    act_layer=act_layer,
+                    norm_layer=norm_layer,
+                    attn_layer=se_layer,
+                    drop_path=dpr[stage_idx][block_idx],
+                    dtype=dtype,
+                    param_dtype=param_dtype,
+                    rngs=rngs,
+                ))
+                inplanes = planes * block.expansion
+            stage_blocks.append(nnx.List(blocks))
+            self.feature_info.append(dict(
+                num_chs=inplanes, reduction=net_stride, module=f'layer{stage_idx + 1}'))
+        self.layer1, self.layer2, self.layer3, self.layer4 = stage_blocks
+
+        self.num_features = self.head_hidden_size = inplanes
+        self.head = ClassifierHead(
+            self.num_features, num_classes, pool_type=global_pool, drop_rate=drop_rate,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.grad_checkpointing = False
+
+        if zero_init_last:
+            for stage in stage_blocks:
+                for b in stage:
+                    if hasattr(b, 'zero_init_last'):
+                        b.zero_init_last()
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^conv1|^bn1|^bn_stem',
+            blocks=r'^layer(\d+)' if coarse else r'^layer(\d+)\.(\d+)',
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        self.head.reset(num_classes, pool_type=global_pool, rngs=rngs)
+
+    # -- forward -------------------------------------------------------------
+    def _stem(self, x):
+        if self.bn_stem is not None:
+            x = self.bn_stem[0](self.conv1[0](x))
+            x = self.bn_stem[1](self.conv1[1](x))
+            x = self.conv1[2](x)
+        else:
+            x = self.conv1(x)
+        x = self.bn1(x)
+        return max_pool2d(x, 3, 2)
+
+    def _stages(self):
+        return [self.layer1, self.layer2, self.layer3, self.layer4]
+
+    def forward_features(self, x):
+        x = self._stem(x)
+        for stage in self._stages():
+            if self.grad_checkpointing:
+                x = checkpoint_seq(stage, x)
+            else:
+                for b in stage:
+                    x = b(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        return self.head(x, pre_logits=pre_logits)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self,
+            x,
+            indices: Optional[Union[int, List[int]]] = None,
+            norm: bool = False,
+            stop_early: bool = False,
+            output_fmt: str = 'NHWC',
+            intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        stages = self._stages()
+        take_indices, max_index = feature_take_indices(len(stages) + 1, indices)
+        intermediates = []
+        x = self._stem(x)
+        if 0 in take_indices:
+            intermediates.append(x)
+        for i, stage in enumerate(stages):
+            if not stop_early or i <= max_index - 1:
+                for b in stage:
+                    x = b(x)
+                if (i + 1) in take_indices:
+                    intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, _ = feature_take_indices(5, indices)
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': (7, 7),
+        'crop_pct': 0.875,
+        'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406),
+        'std': (0.229, 0.224, 0.225),
+        'first_conv': 'conv1',
+        'classifier': 'head.fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'resnet18.a1_in1k': _cfg(hf_hub_id='timm/'),
+    'resnet26.bt_in1k': _cfg(hf_hub_id='timm/'),
+    'resnet34.a1_in1k': _cfg(hf_hub_id='timm/'),
+    'resnet50.a1_in1k': _cfg(hf_hub_id='timm/'),
+    'resnet50d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+    'resnet101.a1_in1k': _cfg(hf_hub_id='timm/'),
+    'resnet152.a1_in1k': _cfg(hf_hub_id='timm/'),
+    'resnext50_32x4d.a1_in1k': _cfg(hf_hub_id='timm/'),
+    'wide_resnet50_2.racm_in1k': _cfg(hf_hub_id='timm/'),
+    'seresnet50.ra2_in1k': _cfg(hf_hub_id='timm/'),
+    'test_resnet.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+})
+
+
+def _create_resnet(variant: str, pretrained: bool = False, **kwargs) -> ResNet:
+    from ._torch_convert import convert_torch_state_dict
+    return build_model_with_cfg(
+        ResNet, variant, pretrained,
+        pretrained_filter_fn=convert_torch_state_dict,
+        feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
+        **kwargs,
+    )
+
+
+@register_model
+def resnet18(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=BasicBlock, layers=(2, 2, 2, 2))
+    return _create_resnet('resnet18', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet26(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(2, 2, 2, 2))
+    return _create_resnet('resnet26', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet34(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=BasicBlock, layers=(3, 4, 6, 3))
+    return _create_resnet('resnet34', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet50(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3))
+    return _create_resnet('resnet50', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet50d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnet50d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet101(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3))
+    return _create_resnet('resnet101', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet152(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 8, 36, 3))
+    return _create_resnet('resnet152', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnext50_32x4d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), cardinality=32, base_width=4)
+    return _create_resnet('resnext50_32x4d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def wide_resnet50_2(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), base_width=128)
+    return _create_resnet('wide_resnet50_2', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnet50(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), se_layer=SEModule)
+    return _create_resnet('seresnet50', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_resnet(pretrained=False, **kwargs) -> ResNet:
+    """Tiny fixture (reference resnet.py:2213)."""
+    model_args = dict(block=BasicBlock, layers=(1, 1, 1, 1), channels=(32, 48, 48, 96))
+    return _create_resnet('test_resnet', pretrained, **dict(model_args, **kwargs))

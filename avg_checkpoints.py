@@ -1,0 +1,56 @@
+#!/usr/bin/env python3
+"""Average N checkpoints into one (reference: avg_checkpoints.py:1-153)."""
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+
+import numpy as np
+
+parser = argparse.ArgumentParser(description='Checkpoint averager')
+parser.add_argument('--input', default='', type=str, metavar='PATH', help='checkpoint dir or glob')
+parser.add_argument('--output', default='./averaged.safetensors', type=str, metavar='PATH')
+parser.add_argument('--filter', default='checkpoint-*.npz', type=str)
+parser.add_argument('-n', type=int, default=10, help='average the last/best n')
+parser.add_argument('--use-ema', action='store_true')
+
+
+def load_model_weights(path: str, use_ema: bool):
+    from timm_tpu.models import load_state_dict
+    return load_state_dict(path, use_ema=use_ema)
+
+
+def main():
+    args = parser.parse_args()
+    pattern = args.input
+    if os.path.isdir(pattern):
+        pattern = os.path.join(pattern, args.filter)
+    def _num_key(path):
+        import re
+        nums = re.findall(r'(\d+)', os.path.basename(path))
+        return [int(n) for n in nums] if nums else [0]
+
+    files = sorted(glob.glob(pattern), key=_num_key)[-args.n:]
+    assert files, f'No checkpoints found for {pattern}'
+    print(f'Averaging {len(files)} checkpoints:')
+    for f in files:
+        print(f'  {f}')
+
+    avg = None
+    for f in files:
+        sd = load_model_weights(f, args.use_ema)
+        if avg is None:
+            avg = {k: v.astype(np.float64) for k, v in sd.items()}
+        else:
+            for k, v in sd.items():
+                avg[k] += v.astype(np.float64)
+    avg = {k: (v / len(files)).astype(np.float32) for k, v in avg.items()}
+
+    from timm_tpu.models import save_state_dict
+    save_state_dict(avg, args.output)
+    print(f'Wrote averaged checkpoint to {args.output}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Strip a training checkpoint to release weights
+(reference: clean_checkpoint.py:1-115): drops optimizer/model_state entries,
+keeps (EMA) weights, writes safetensors with a hash-tagged filename.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+
+import numpy as np
+
+parser = argparse.ArgumentParser(description='Checkpoint cleaner')
+parser.add_argument('--checkpoint', default='', type=str, metavar='PATH')
+parser.add_argument('--output', default='', type=str, metavar='PATH')
+parser.add_argument('--use-ema', dest='use_ema', action='store_true')
+parser.add_argument('--no-hash', dest='no_hash', action='store_true')
+
+
+def main():
+    from timm_tpu.models import load_state_dict, save_state_dict
+    args = parser.parse_args()
+    assert args.checkpoint, '--checkpoint required'
+
+    sd = load_state_dict(args.checkpoint, use_ema=args.use_ema)
+    # already unwrapped to plain weight keys by load_state_dict
+    print(f"Loaded {len(sd)} weight tensors from '{args.checkpoint}'")
+
+    out = args.output or os.path.splitext(args.checkpoint)[0] + '_clean.safetensors'
+    save_state_dict(sd, out)
+
+    if not args.no_hash:
+        with open(out, 'rb') as f:
+            sha = hashlib.sha256(f.read()).hexdigest()
+        base, ext = os.path.splitext(out)
+        final = f'{base}-{sha[:8]}{ext}'
+        os.rename(out, final)
+        out = final
+    print(f"Wrote cleaned checkpoint to '{out}'")
+
+
+if __name__ == '__main__':
+    main()

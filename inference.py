@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""Folder inference → top-k predictions to csv/json/parquet
+(reference: inference.py:1-389).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_logger = logging.getLogger('inference')
+
+parser = argparse.ArgumentParser(description='TPU-native inference')
+parser.add_argument('data', nargs='?', metavar='DIR', const=None)
+parser.add_argument('--data-dir', metavar='DIR')
+parser.add_argument('--dataset', metavar='NAME', default='')
+parser.add_argument('--split', metavar='NAME', default='validation')
+parser.add_argument('--model', '-m', metavar='NAME', default='vit_tiny_patch16_224')
+parser.add_argument('--pretrained', action='store_true')
+parser.add_argument('--checkpoint', default='', type=str, metavar='PATH')
+parser.add_argument('--use-ema', action='store_true')
+parser.add_argument('-b', '--batch-size', default=256, type=int)
+parser.add_argument('--img-size', default=None, type=int)
+parser.add_argument('--input-size', default=None, nargs=3, type=int)
+parser.add_argument('--crop-pct', default=None, type=float)
+parser.add_argument('--crop-mode', default=None, type=str)
+parser.add_argument('--num-classes', type=int, default=None)
+parser.add_argument('--class-map', default='', type=str)
+parser.add_argument('--label-type', default='index', type=str, choices=['index', 'name'],
+                    help="'name' uses dataset class-folder names when available")
+parser.add_argument('-j', '--workers', default=4, type=int)
+parser.add_argument('--amp', action='store_true', default=False)
+parser.add_argument('--topk', default=1, type=int, metavar='N')
+parser.add_argument('--fullname', action='store_true', default=False)
+parser.add_argument('--outputs-name', default=None)
+parser.add_argument('--output-dir', default=None)
+parser.add_argument('--output-type', default='csv', choices=['csv', 'json', 'parquet'])
+parser.add_argument('--filename-col', default='filename')
+
+
+def main():
+    import timm_tpu
+    from timm_tpu.data import create_dataset, create_loader, resolve_data_config
+    from timm_tpu.models import load_checkpoint
+    from timm_tpu.utils import setup_default_logging
+    from flax import nnx
+
+    setup_default_logging()
+    args = parser.parse_args()
+
+    dtype = jnp.bfloat16 if args.amp else None
+    try:
+        model = timm_tpu.create_model(
+            args.model, pretrained=args.pretrained, num_classes=args.num_classes,
+            img_size=args.img_size, dtype=dtype)
+    except TypeError:
+        model = timm_tpu.create_model(
+            args.model, pretrained=args.pretrained, num_classes=args.num_classes, dtype=dtype)
+    if args.checkpoint:
+        load_checkpoint(model, args.checkpoint, use_ema=args.use_ema)
+    model.eval()
+
+    data_config = resolve_data_config(vars(args), model=model)
+    root = args.data_dir or args.data
+    dataset = create_dataset(args.dataset, root=root, split=args.split, class_map=args.class_map)
+    loader = create_loader(
+        dataset,
+        input_size=data_config['input_size'],
+        batch_size=args.batch_size,
+        interpolation=data_config['interpolation'],
+        mean=data_config['mean'],
+        std=data_config['std'],
+        num_workers=args.workers,
+        crop_pct=data_config['crop_pct'],
+        crop_mode=data_config['crop_mode'],
+    )
+
+    graphdef, state = nnx.split(model)
+    mean = jnp.asarray(data_config['mean'], jnp.float32).reshape(1, 1, 1, -1)
+    std = jnp.asarray(data_config['std'], jnp.float32).reshape(1, 1, 1, -1)
+    k = min(args.topk, args.num_classes or model.num_classes)
+
+    @jax.jit
+    def infer_step(state, x):
+        x = (x - mean) / std
+        if dtype is not None:
+            x = x.astype(dtype)
+        logits = nnx.merge(graphdef, state)(x).astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        order = jnp.argsort(probs, axis=-1)[:, ::-1][:, :k]
+        top_probs = jnp.take_along_axis(probs, order, axis=-1)
+        return order, top_probs
+
+    all_indices, all_probs = [], []
+    t0 = time.time()
+    for x_np, _ in loader:
+        idx, prb = infer_step(state, jnp.asarray(x_np))
+        all_indices.append(np.asarray(idx))
+        all_probs.append(np.asarray(prb))
+    if not all_indices:
+        raise RuntimeError(f'No images found for inference under {root!r} (split {args.split!r})')
+    num = sum(a.shape[0] for a in all_indices)
+    _logger.info(f'Inference complete: {num} images in {time.time() - t0:.1f}s')
+
+    indices = np.concatenate(all_indices)
+    probs = np.concatenate(all_probs)
+    filenames = dataset.filenames(basename=not args.fullname)[:num]
+
+    idx_to_name = None
+    if args.label_type == 'name' and hasattr(dataset, 'reader') and hasattr(dataset.reader, 'class_to_idx'):
+        idx_to_name = {v: k for k, v in dataset.reader.class_to_idx.items()}
+
+    def _label(i: int):
+        return idx_to_name.get(i, i) if idx_to_name is not None else int(i)
+
+    rows = []
+    for fn, ind, prb in zip(filenames, indices, probs):
+        row = {args.filename_col: fn}
+        if k == 1:
+            row['label'] = _label(int(ind[0]))
+            row['prob'] = float(prb[0])
+        else:
+            for j in range(k):
+                row[f'label_{j}'] = _label(int(ind[j]))
+                row[f'prob_{j}'] = float(prb[j])
+        rows.append(row)
+
+    out_dir = args.output_dir or '.'
+    os.makedirs(out_dir, exist_ok=True)
+    base = os.path.join(out_dir, args.outputs_name or f'{args.model}-results')
+    if args.output_type == 'json':
+        with open(base + '.json', 'w') as f:
+            json.dump(rows, f, indent=2)
+    elif args.output_type == 'parquet':
+        import pandas as pd
+        pd.DataFrame(rows).set_index(args.filename_col).to_parquet(base + '.parquet')
+    else:
+        import csv
+        with open(base + '.csv', 'w') as f:
+            dw = csv.DictWriter(f, fieldnames=rows[0].keys())
+            dw.writeheader()
+            for r in rows:
+                dw.writerow(r)
+    _logger.info(f'Wrote results to {base}.{args.output_type}')
+
+
+if __name__ == '__main__':
+    main()

@@ -121,12 +121,17 @@ def load_state_dict(checkpoint_path: str, use_ema: bool = True) -> Dict[str, np.
         sd = load_torch_state_dict(checkpoint_path, use_ema=use_ema)
     else:
         raise ValueError(f'Unsupported checkpoint format: {checkpoint_path}')
-    # unwrap EMA/nested containers saved by our CheckpointSaver
+    # unwrap EMA/nested containers saved by our CheckpointSaver; non-param
+    # model variables (BN stats) live under 'model_state.' and are part of
+    # the weight contract either way
+    stats = {k[len('model_state.'):]: v for k, v in sd.items() if k.startswith('model_state.')}
     ema_keys = [k for k in sd if k.startswith('state_dict_ema.')]
     if use_ema and ema_keys:
-        sd = {k[len('state_dict_ema.'):]: v for k in ema_keys for v in [sd[k]]}
+        sd = {k[len('state_dict_ema.'):]: sd[k] for k in ema_keys}
+        sd.update(stats)
     elif any(k.startswith('state_dict.') for k in sd):
         sd = {k[len('state_dict.'):]: v for k, v in sd.items() if k.startswith('state_dict.')}
+        sd.update(stats)
     return clean_state_dict(sd)
 
 

@@ -1,0 +1,102 @@
+"""NaFlex tests (reference: tests/test_naflex_dataset.py — collator/batching
+invariants; plus model masking invariance)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import timm_tpu
+from timm_tpu.data.naflex_loader import (
+    NaFlexCollator, calculate_naflex_batch_size, patchify_np, resize_to_seq_len,
+)
+from timm_tpu.models.naflexvit import create_attention_mask, global_pool_naflex
+
+
+def test_batch_size_from_token_budget():
+    assert calculate_naflex_batch_size(1024, 256) == 4
+    assert calculate_naflex_batch_size(1000, 256) == 3
+    assert calculate_naflex_batch_size(1024, 256, max_size=2) == 2
+    assert calculate_naflex_batch_size(100, 1024) == 1  # never zero
+
+
+def test_collator_pads_and_masks():
+    coll = NaFlexCollator(patch_size=16)
+    p1, c1 = np.ones((10, 768), np.float32), np.zeros((10, 2), np.int32)
+    p2, c2 = np.ones((16, 768), np.float32), np.zeros((16, 2), np.int32)
+    batch = coll([(p1, c1, 3), (p2, c2, 7)], seq_len=16)
+    assert batch['patches'].shape == (2, 16, 768)
+    assert batch['patch_valid'][0].sum() == 10
+    assert batch['patch_valid'][1].sum() == 16
+    assert (batch['patches'][0, 10:] == 0).all()
+    assert list(batch['target']) == [3, 7]
+
+
+def test_patchify_roundtrip_coords():
+    arr = np.arange(32 * 48 * 3, dtype=np.float32).reshape(32, 48, 3)
+    patches, coord = patchify_np(arr, 16)
+    assert patches.shape == (6, 768)
+    assert coord.max(axis=0).tolist() == [1, 2]
+    # first patch is the top-left block
+    expect = arr[:16, :16].reshape(-1)
+    np.testing.assert_array_equal(patches[0], expect)
+
+
+def test_resize_respects_budget():
+    from PIL import Image
+    img = Image.new('RGB', (640, 480))
+    out = resize_to_seq_len(img, seq_len=576, patch_size=16)
+    gw, gh = out.size[0] // 16, out.size[1] // 16
+    assert gw * gh <= 576
+    assert gw * gh >= 576 * 0.7  # uses most of the budget
+    # aspect roughly preserved
+    assert abs((out.size[0] / out.size[1]) - (640 / 480)) < 0.4
+
+
+def test_attention_mask_shapes():
+    valid = jnp.asarray([[True, True, False], [True, False, False]])
+    m = create_attention_mask(valid, num_prefix_tokens=1)
+    assert m.shape == (2, 1, 4, 4)
+    assert bool(m[0, 0, 0, 0]) and not bool(m[0, 0, 0, 3])
+    mk = create_attention_mask(valid, symmetric=False)
+    assert mk.shape == (2, 1, 1, 3)
+
+
+def test_masked_pooling():
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(1, 4, 3))
+    valid = jnp.asarray([[True, True, False, False]])
+    avg = global_pool_naflex(x, valid, 'avg')
+    np.testing.assert_allclose(np.asarray(avg)[0], x[0, :2].mean(axis=0))
+
+
+def test_model_padding_invariance():
+    m = timm_tpu.create_model('test_naflexvit', num_classes=10)
+    m.eval()
+    rng = np.random.RandomState(0)
+    B, L = 2, 32
+    patches = jnp.asarray(rng.rand(B, L, 768), jnp.float32)
+    coord = jnp.asarray(rng.randint(0, 5, (B, L, 2)))
+    valid = jnp.asarray(np.arange(L)[None, :] < np.array([20, 32])[:, None])
+    out1 = m({'patches': patches, 'patch_coord': coord, 'patch_valid': valid})
+    out2 = m({'patches': patches.at[0, 20:].set(123.0), 'patch_coord': coord, 'patch_valid': valid})
+    assert bool(jnp.allclose(out1, out2, atol=1e-4))
+
+
+def test_naflex_loader_buckets(tmp_path):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for cls in ('a', 'b'):
+        d = tmp_path / 'train' / cls
+        d.mkdir(parents=True)
+        for i in range(8):
+            Image.fromarray(rng.randint(0, 255, (40 + 8 * i, 56, 3), np.uint8)).save(d / f'{i}.jpg')
+    from timm_tpu.data import create_dataset
+    from timm_tpu.data.naflex_loader import create_naflex_loader
+    ds = create_dataset('', root=str(tmp_path), split='train')
+    loader = create_naflex_loader(
+        ds, patch_size=16, train_seq_lens=(16, 25), max_seq_len=25, batch_size=4, is_training=True)
+    seen = set()
+    for batch in loader:
+        assert batch['patches'].shape[1] == batch['seq_len']
+        assert batch['patches'].shape[1] in (16, 25)
+        assert batch['patch_valid'].any(axis=1).all()  # every row has tokens
+        seen.add(batch['seq_len'])
+    assert seen  # produced at least one batch

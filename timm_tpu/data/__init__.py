@@ -11,6 +11,7 @@ from .dataset import AugMixDataset, ImageDataset
 from .dataset_factory import create_dataset
 from .loader import ThreadedLoader, create_loader
 from .mixup import FastCollateMixup, Mixup
+from .naflex_loader import NaFlexCollator, NaFlexLoader, calculate_naflex_batch_size, create_naflex_loader
 from .random_erasing import RandomErasing
 from .readers import ReaderImageFolder, create_reader
 from .transforms import (

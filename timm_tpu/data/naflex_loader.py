@@ -1,0 +1,239 @@
+"""NaFlex data pipeline: variable-resolution images → padded token batches
+(reference: timm/data/naflex_dataset.py:31-565, naflex_loader.py:27-458,
+naflex_transforms.py:496-849).
+
+TPU-first: a fixed set of seq-len buckets, each with an adaptive batch size
+from a token budget — batch shapes are static per bucket, so the train step
+compiles once per bucket (no recompile storms from variable resolution).
+
+Batches are dicts: {patches (B, L, P*P*C), patch_coord (B, L, 2),
+patch_valid (B, L), seq_len, target (B,)}.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from PIL import Image
+
+from .constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+from .transforms import RandomHorizontalFlip, str_to_pil_interp
+
+__all__ = ['NaFlexCollator', 'NaFlexLoader', 'calculate_naflex_batch_size',
+           'create_naflex_loader', 'patchify_np', 'resize_to_seq_len']
+
+
+def calculate_naflex_batch_size(
+        tokens_per_batch: int,
+        seq_len: int,
+        max_size: Optional[int] = None,
+        divisor: int = 1,
+        rounding: str = 'floor',
+) -> int:
+    """Token budget → batch size (reference naflex_dataset.py:31)."""
+    batch_size = tokens_per_batch / seq_len
+    if rounding == 'floor':
+        batch_size = int(math.floor(batch_size / divisor) * divisor)
+    elif rounding == 'ceil':
+        batch_size = int(math.ceil(batch_size / divisor) * divisor)
+    else:
+        batch_size = int(round(batch_size / divisor) * divisor)
+    batch_size = max(divisor, batch_size)
+    if max_size is not None:
+        batch_size = min(batch_size, max_size)
+    return batch_size
+
+
+def resize_to_seq_len(img: Image.Image, seq_len: int, patch_size: int, interpolation='bicubic'):
+    """Resize preserving aspect so grid_h*grid_w <= seq_len
+    (reference naflex_transforms.py:496 RandomResizedCropToSequence eval path)."""
+    w, h = img.size
+    p = patch_size
+    aspect = w / h
+    # largest (gh, gw) with gh*gw <= seq_len and gw/gh ~= aspect
+    gh = max(1, int(math.floor(math.sqrt(seq_len / aspect))))
+    gw = max(1, int(math.floor(gh * aspect)))
+    while gh * gw > seq_len:
+        if gw >= gh:
+            gw -= 1
+        else:
+            gh -= 1
+    while (gh + 1) * gw <= seq_len and (gh + 1) * p <= h * 4:
+        gh += 1
+    while gh * (gw + 1) <= seq_len and (gw + 1) * p <= w * 4:
+        gw += 1
+    interp = str_to_pil_interp(interpolation) if isinstance(interpolation, str) else interpolation
+    return img.resize((gw * p, gh * p), interp)
+
+
+def patchify_np(arr: np.ndarray, patch_size: int):
+    """HWC float array → (N, P*P*C) patches + (N, 2) coords."""
+    H, W, C = arr.shape
+    P = patch_size
+    gh, gw = H // P, W // P
+    arr = arr[:gh * P, :gw * P]
+    patches = arr.reshape(gh, P, gw, P, C).transpose(0, 2, 1, 3, 4).reshape(gh * gw, P * P * C)
+    yy, xx = np.meshgrid(np.arange(gh), np.arange(gw), indexing='ij')
+    coord = np.stack([yy, xx], axis=-1).reshape(gh * gw, 2)
+    return patches, coord
+
+
+class NaFlexCollator:
+    """Pad a list of (patches, coord, target) to seq_len
+    (reference naflex_dataset.py:74-153)."""
+
+    def __init__(self, patch_size: int = 16, in_chans: int = 3):
+        self.patch_size = patch_size
+        self.patch_dim = patch_size * patch_size * in_chans
+
+    def __call__(self, samples: List[Tuple[np.ndarray, np.ndarray, int]], seq_len: int) -> Dict:
+        B = len(samples)
+        patches = np.zeros((B, seq_len, self.patch_dim), np.float32)
+        coord = np.zeros((B, seq_len, 2), np.int32)
+        valid = np.zeros((B, seq_len), bool)
+        targets = np.zeros((B,), np.int64)
+        for i, (p, c, t) in enumerate(samples):
+            n = min(len(p), seq_len)
+            patches[i, :n] = p[:n]
+            coord[i, :n] = c[:n]
+            valid[i, :n] = True
+            targets[i] = t
+        return {
+            'patches': patches,
+            'patch_coord': coord,
+            'patch_valid': valid,
+            'seq_len': seq_len,
+            'target': targets,
+        }
+
+
+class NaFlexLoader:
+    """Iterable over token-budget batches with per-epoch (seq_len, batch_size)
+    schedules (reference NaFlexMapDatasetWrapper, naflex_dataset.py:200)."""
+
+    def __init__(
+            self,
+            dataset,
+            tokens_per_batch: int = 576 * 64,
+            seq_lens: Sequence[int] = (128, 256, 576, 784, 1024),
+            patch_size: int = 16,
+            is_training: bool = False,
+            mean=IMAGENET_DEFAULT_MEAN,
+            std=IMAGENET_DEFAULT_STD,
+            interpolation: str = 'bicubic',
+            hflip: float = 0.5,
+            seed: int = 42,
+            process_index: int = 0,
+            process_count: int = 1,
+    ):
+        self.dataset = dataset
+        self.tokens_per_batch = tokens_per_batch
+        self.seq_lens = tuple(sorted(seq_lens))
+        self.patch_size = patch_size
+        self.is_training = is_training
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.interpolation = interpolation
+        self.hflip = RandomHorizontalFlip(hflip) if is_training and hflip > 0 else None
+        self.seed = seed
+        self.epoch = 0
+        self.process_index = process_index
+        self.process_count = process_count
+        self.collator = NaFlexCollator(patch_size)
+        # dataset must yield PIL images: disable any tensor transform
+        if getattr(dataset, 'transform', None) is not None:
+            import logging
+            logging.getLogger(__name__).warning(
+                'NaFlexLoader clearing existing dataset.transform — the NaFlex '
+                'pipeline does its own resize/patchify; do not share this '
+                'dataset instance with a tensor loader')
+            dataset.transform = None
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def _schedule(self) -> List[Tuple[int, int, List[int]]]:
+        """Assign samples to (seq_len, batch) groups for this epoch.
+
+        Multi-host safe: the schedule is computed over the GLOBAL index list
+        with per-batch sizes divisible by process_count, and every process
+        takes its slice of every batch — all hosts see the same batch count
+        and shapes, so SPMD collectives stay in sync.
+        """
+        rng = random.Random(self.seed + self.epoch)
+        n = len(self.dataset)
+        indices = list(range(n))
+        if self.is_training:
+            rng.shuffle(indices)
+        batches = []
+        pos = 0
+        while pos < len(indices):
+            seq_len = rng.choice(self.seq_lens) if self.is_training else self.seq_lens[-1]
+            bs = calculate_naflex_batch_size(
+                self.tokens_per_batch, seq_len, divisor=self.process_count)
+            group = indices[pos:pos + bs]
+            pos += bs
+            if len(group) < bs:
+                if self.is_training:
+                    break  # drop ragged trailing batch in training (all hosts agree)
+                # eval: pad by wrapping so the batch shape stays full
+                group = group + indices[:bs - len(group)]
+            # this host's slice of the global batch
+            local = group[self.process_index::self.process_count]
+            batches.append((seq_len, bs // self.process_count, local))
+        return batches
+
+    def __len__(self):
+        return len(self._schedule())
+
+    def __iter__(self):
+        for seq_len, bs, group in self._schedule():
+            samples = []
+            for idx in group:
+                img, target = self.dataset[idx]
+                if self.hflip is not None:
+                    img = self.hflip(img)
+                img = resize_to_seq_len(img, seq_len, self.patch_size, self.interpolation)
+                arr = np.asarray(img, np.float32) / 255.0
+                if arr.ndim == 2:
+                    arr = arr[:, :, None]
+                arr = (arr - self.mean) / self.std
+                p, c = patchify_np(arr, self.patch_size)
+                samples.append((p, c, target))
+            yield self.collator(samples, seq_len)
+
+
+def create_naflex_loader(
+        dataset,
+        patch_size: int = 16,
+        train_seq_lens: Sequence[int] = (128, 256, 576, 784, 1024),
+        max_seq_len: int = 576,
+        batch_size: int = 32,  # batch size at max_seq_len → token budget
+        is_training: bool = False,
+        mean=IMAGENET_DEFAULT_MEAN,
+        std=IMAGENET_DEFAULT_STD,
+        interpolation: str = 'bicubic',
+        hflip: float = 0.5,
+        seed: int = 42,
+        **kwargs,
+):
+    """(reference naflex_loader.py:225)."""
+    import jax
+    tokens_per_batch = batch_size * max_seq_len
+    seq_lens = train_seq_lens if is_training else (max_seq_len,)
+    return NaFlexLoader(
+        dataset,
+        tokens_per_batch=tokens_per_batch,
+        seq_lens=seq_lens,
+        patch_size=patch_size,
+        is_training=is_training,
+        mean=mean,
+        std=std,
+        interpolation=interpolation,
+        hflip=hflip,
+        seed=seed,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )

@@ -16,5 +16,6 @@ from ._registry import (
 from .convnext import ConvNeXt
 from .efficientnet import EfficientNet
 from .mlp_mixer import MlpMixer
+from .naflexvit import NaFlexVit
 from .resnet import ResNet
 from .vision_transformer import VisionTransformer

@@ -1,0 +1,397 @@
+"""NaFlexVit — variable-resolution, sequence-packed ViT, TPU-native.
+
+Re-designed from the reference (timm/models/naflexvit.py:59-2122). The
+reference's variable shapes become **bucketed static shapes**: the loader
+emits batches padded to a fixed seq-len bucket, so each bucket compiles once
+and never again (XLA-friendly — see SURVEY §5 long-context notes).
+
+Inputs are pre-patchified on the host:
+  patches      (B, L, P*P*C) float
+  patch_coord  (B, L, 2)     int (y, x) grid coords per token
+  patch_valid  (B, L)        bool
+
+Position embeddings are gather-based (factorized row+col tables or a 2D
+learned grid indexed by coords) instead of the reference's per-sample
+interpolation loops — same capability, no dynamic resize inside jit.
+
+Contract parity: forward_features/forward_head/__call__,
+get/reset_classifier, group_matcher, set_grad_checkpointing, no_weight_decay.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    Dropout, LayerNorm, Mlp, calculate_drop_path_rates, get_norm_layer,
+    trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._registry import generate_default_cfgs, register_model
+from .vision_transformer import Block
+
+__all__ = ['NaFlexVit']
+
+
+def create_attention_mask(patch_valid, num_prefix_tokens: int = 0, symmetric: bool = True, dtype=jnp.bool_):
+    """Token-validity → attention mask (reference naflexvit.py:972).
+
+    Returns (B, 1, L, L) bool when symmetric else key-only (B, 1, 1, L).
+    """
+    B, L = patch_valid.shape
+    if num_prefix_tokens:
+        prefix = jnp.ones((B, num_prefix_tokens), jnp.bool_)
+        patch_valid = jnp.concatenate([prefix, patch_valid], axis=1)
+    if symmetric:
+        mask = patch_valid[:, None, :, None] & patch_valid[:, None, None, :]
+    else:
+        mask = patch_valid[:, None, None, :]
+    return mask
+
+
+def global_pool_naflex(x, patch_valid, pool_type: str = 'avg', num_prefix_tokens: int = 0):
+    """Masked pooling over valid tokens (reference naflexvit.py:1041)."""
+    if pool_type == 'token':
+        return x[:, 0]
+    if num_prefix_tokens:
+        x = x[:, num_prefix_tokens:]
+    w = patch_valid.astype(x.dtype)[..., None]
+    if pool_type == 'avg':
+        return (x * w).sum(axis=1) / jnp.maximum(w.sum(axis=1), 1.0)
+    if pool_type == 'max':
+        neg = jnp.finfo(x.dtype).min
+        return jnp.where(w > 0, x, neg).max(axis=1)
+    raise ValueError(f'Unsupported NaFlex pool type {pool_type}')
+
+
+class NaFlexEmbeds(nnx.Module):
+    """Linear patch projection + gather-based pos embed
+    (reference naflexvit.py:339)."""
+
+    def __init__(
+            self,
+            patch_size: int = 16,
+            in_chans: int = 3,
+            embed_dim: int = 768,
+            max_grid_size: int = 64,
+            pos_embed: str = 'factorized',
+            pos_drop_rate: float = 0.0,
+            class_token: bool = False,
+            reg_tokens: int = 0,
+            norm_layer: Optional[Callable] = None,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert pos_embed in ('factorized', 'learn', 'none')
+        self.patch_size = patch_size
+        self.embed_dim = embed_dim
+        self.max_grid_size = max_grid_size
+        self.pos_embed_type = pos_embed
+        self.num_prefix_tokens = (1 if class_token else 0) + reg_tokens
+        self.num_reg_tokens = reg_tokens
+
+        patch_dim = patch_size * patch_size * in_chans
+        self.proj = nnx.Linear(
+            patch_dim, embed_dim, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm = norm_layer(embed_dim, rngs=rngs) if norm_layer is not None else None
+
+        self.cls_token = nnx.Param(jnp.zeros((1, 1, embed_dim), param_dtype)) if class_token else None
+        self.reg_token = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (1, reg_tokens, embed_dim), param_dtype)) if reg_tokens else None
+
+        if pos_embed == 'factorized':
+            self.pos_embed_y = nnx.Param(
+                trunc_normal_(std=0.02)(rngs.params(), (max_grid_size, embed_dim), param_dtype))
+            self.pos_embed_x = nnx.Param(
+                trunc_normal_(std=0.02)(rngs.params(), (max_grid_size, embed_dim), param_dtype))
+            self.pos_embed_grid = None
+        elif pos_embed == 'learn':
+            self.pos_embed_grid = nnx.Param(
+                trunc_normal_(std=0.02)(rngs.params(), (max_grid_size, max_grid_size, embed_dim), param_dtype))
+            self.pos_embed_y = self.pos_embed_x = None
+        else:
+            self.pos_embed_grid = self.pos_embed_y = self.pos_embed_x = None
+        self.pos_drop = Dropout(pos_drop_rate, rngs=rngs)
+
+    def __call__(self, patches, patch_coord):
+        # patches (B, L, P*P*C), patch_coord (B, L, 2) int
+        x = self.proj(patches)
+        B, L, D = x.shape
+        yy = jnp.clip(patch_coord[..., 0], 0, self.max_grid_size - 1)
+        xx = jnp.clip(patch_coord[..., 1], 0, self.max_grid_size - 1)
+        if self.pos_embed_type == 'factorized':
+            pos = jnp.take(self.pos_embed_y[...], yy, axis=0) + jnp.take(self.pos_embed_x[...], xx, axis=0)
+            x = x + pos.astype(x.dtype)
+        elif self.pos_embed_type == 'learn':
+            pos = self.pos_embed_grid[...][yy, xx]
+            x = x + pos.astype(x.dtype)
+
+        to_cat = []
+        if self.cls_token is not None:
+            to_cat.append(jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (B, 1, D)))
+        if self.reg_token is not None:
+            to_cat.append(jnp.broadcast_to(self.reg_token[...].astype(x.dtype), (B, self.num_reg_tokens, D)))
+        if to_cat:
+            x = jnp.concatenate(to_cat + [x], axis=1)
+        if self.norm is not None:
+            x = self.norm(x)
+        return self.pos_drop(x)
+
+
+class NaFlexVit(nnx.Module):
+    def __init__(
+            self,
+            patch_size: int = 16,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            embed_dim: int = 768,
+            depth: int = 12,
+            num_heads: int = 12,
+            mlp_ratio: float = 4.0,
+            qkv_bias: bool = True,
+            qk_norm: bool = False,
+            init_values: Optional[float] = None,
+            class_token: bool = False,
+            reg_tokens: int = 0,
+            pos_embed: str = 'factorized',
+            max_grid_size: int = 64,
+            final_norm: bool = True,
+            fc_norm: Optional[bool] = None,
+            drop_rate: float = 0.0,
+            pos_drop_rate: float = 0.0,
+            proj_drop_rate: float = 0.0,
+            attn_drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            norm_layer: Optional[Union[str, Callable]] = None,
+            act_layer: Union[str, Callable] = 'gelu',
+            block_fn: Callable = Block,
+            mlp_layer: Callable = Mlp,
+            mask_mode: str = 'symmetric',
+            img_size=None,  # accepted for factory compatibility; unused
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert global_pool in ('', 'avg', 'max', 'token')
+        assert not (global_pool == 'token' and not class_token)
+        norm_layer = get_norm_layer(norm_layer) or LayerNorm
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.num_features = self.head_hidden_size = self.embed_dim = embed_dim
+        self.mask_mode = mask_mode  # 'symmetric' (full LxL) or 'key' (key-only)
+        self.grad_checkpointing = False
+
+        self.embeds = NaFlexEmbeds(
+            patch_size=patch_size,
+            in_chans=in_chans,
+            embed_dim=embed_dim,
+            max_grid_size=max_grid_size,
+            pos_embed=pos_embed,
+            pos_drop_rate=pos_drop_rate,
+            class_token=class_token,
+            reg_tokens=reg_tokens,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            rngs=rngs,
+        )
+        self.num_prefix_tokens = self.embeds.num_prefix_tokens
+
+        dpr = calculate_drop_path_rates(drop_path_rate, depth)
+        self.blocks = nnx.List([
+            block_fn(
+                dim=embed_dim,
+                num_heads=num_heads,
+                mlp_ratio=mlp_ratio,
+                qkv_bias=qkv_bias,
+                qk_norm=qk_norm,
+                init_values=init_values,
+                proj_drop=proj_drop_rate,
+                attn_drop=attn_drop_rate,
+                drop_path=dpr[i],
+                norm_layer=norm_layer,
+                act_layer=act_layer,
+                mlp_layer=mlp_layer,
+                dtype=dtype,
+                param_dtype=param_dtype,
+                rngs=rngs,
+            )
+            for i in range(depth)
+        ])
+        if fc_norm is None:
+            fc_norm = global_pool == 'avg'
+        self.norm = norm_layer(embed_dim, rngs=rngs) if final_norm and not fc_norm else None
+        self.fc_norm = norm_layer(embed_dim, rngs=rngs) if final_norm and fc_norm else None
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.head = nnx.Linear(
+            embed_dim, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'embeds.cls_token', 'embeds.reg_token', 'embeds.pos_embed_y',
+                'embeds.pos_embed_x', 'embeds.pos_embed_grid'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^embeds',
+            blocks=[(r'^blocks\.(\d+)', None), (r'^norm|^fc_norm', (99999,))],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = nnx.Linear(
+            self.embed_dim, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, patches, patch_coord, patch_valid=None):
+        x = self.embeds(patches, patch_coord)
+        attn_mask = None
+        if patch_valid is not None:
+            attn_mask = create_attention_mask(
+                patch_valid, num_prefix_tokens=self.num_prefix_tokens,
+                symmetric=self.mask_mode == 'symmetric')
+        for blk in self.blocks:
+            x = blk(x, attn_mask=attn_mask)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+    def forward_head(self, x, patch_valid=None, pre_logits: bool = False):
+        if not self.global_pool:
+            return x  # '' → unpooled tokens (matches global_pool_nlc contract)
+        if patch_valid is None:
+            # mask covers patch tokens only; prefix tokens are appended inside x
+            patch_valid = jnp.ones((x.shape[0], x.shape[1] - self.num_prefix_tokens), jnp.bool_)
+        x = global_pool_naflex(
+            x, patch_valid, pool_type=self.global_pool,
+            num_prefix_tokens=self.num_prefix_tokens)
+        if self.fc_norm is not None:
+            x = self.fc_norm(x)
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, patches, patch_coord=None, patch_valid=None):
+        """Accepts either a NaFlex dict batch or (patches, coord, valid) arrays.
+
+        For compatibility with image-tensor callers, a 4D NHWC input is
+        patchified on the fly (all patches valid)."""
+        if isinstance(patches, dict):
+            d = patches
+            patches, patch_coord, patch_valid = d['patches'], d['patch_coord'], d.get('patch_valid')
+        elif patches.ndim == 4:
+            patches, patch_coord, patch_valid = patchify_image(patches, self.embeds.patch_size)
+        x = self.forward_features(patches, patch_coord, patch_valid)
+        return self.forward_head(x, patch_valid)
+
+
+def patchify_image(x, patch_size: int):
+    """NHWC image → (patches, coords, valid) (reference naflex_transforms.py:751)."""
+    B, H, W, C = x.shape
+    P = patch_size
+    gh, gw = H // P, W // P
+    x = x[:, :gh * P, :gw * P]
+    x = x.reshape(B, gh, P, gw, P, C).transpose(0, 1, 3, 2, 4, 5).reshape(B, gh * gw, P * P * C)
+    yy, xx = jnp.meshgrid(jnp.arange(gh), jnp.arange(gw), indexing='ij')
+    coord = jnp.stack([yy, xx], axis=-1).reshape(1, gh * gw, 2)
+    coord = jnp.broadcast_to(coord, (B, gh * gw, 2))
+    valid = jnp.ones((B, gh * gw), jnp.bool_)
+    return x, coord, valid
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 384, 384),
+        'pool_size': None,
+        'crop_pct': 1.0,
+        'interpolation': 'bicubic',
+        'mean': (0.5, 0.5, 0.5),
+        'std': (0.5, 0.5, 0.5),
+        'first_conv': 'embeds.proj',
+        'classifier': 'head',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'naflexvit_base_patch16_gap.e300_s576_in1k': _cfg(hf_hub_id='timm/'),
+    'naflexvit_base_patch16_par_gap.e300_s576_in1k': _cfg(hf_hub_id='timm/'),
+    'naflexvit_base_patch16_map.untrained': _cfg(),
+    'naflexvit_so150m2_patch16_reg1_gap.untrained': _cfg(),
+    'test_naflexvit.untrained': _cfg(input_size=(3, 160, 160)),
+})
+
+
+def _create_naflexvit(variant: str, pretrained: bool = False, **kwargs) -> NaFlexVit:
+    from ._torch_convert import convert_torch_state_dict
+    return build_model_with_cfg(
+        NaFlexVit, variant, pretrained,
+        pretrained_filter_fn=convert_torch_state_dict,
+        **kwargs,
+    )
+
+
+@register_model
+def naflexvit_base_patch16_gap(pretrained=False, **kwargs) -> NaFlexVit:
+    """ViT-B/16 NaFlex w/ global average pooling."""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, global_pool='avg',
+        pos_embed='factorized', reg_tokens=0)
+    return _create_naflexvit('naflexvit_base_patch16_gap', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def naflexvit_base_patch16_par_gap(pretrained=False, **kwargs) -> NaFlexVit:
+    """ViT-B/16 NaFlex w/ patch-aspect-ratio training + GAP (reference cfg)."""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, global_pool='avg',
+        pos_embed='factorized', reg_tokens=0)
+    return _create_naflexvit('naflexvit_base_patch16_par_gap', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def naflexvit_base_patch16_map(pretrained=False, **kwargs) -> NaFlexVit:
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, global_pool='avg',
+        pos_embed='factorized', reg_tokens=1)
+    return _create_naflexvit('naflexvit_base_patch16_map', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def naflexvit_so150m2_patch16_reg1_gap(pretrained=False, **kwargs) -> NaFlexVit:
+    model_args = dict(
+        patch_size=16, embed_dim=832, depth=21, num_heads=13, mlp_ratio=34 / 8,
+        global_pool='avg', pos_embed='factorized', reg_tokens=1, qkv_bias=False)
+    return _create_naflexvit('naflexvit_so150m2_patch16_reg1_gap', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_naflexvit(pretrained=False, **kwargs) -> NaFlexVit:
+    model_args = dict(
+        patch_size=16, embed_dim=64, depth=2, num_heads=2, mlp_ratio=3,
+        global_pool='avg', pos_embed='factorized', max_grid_size=24)
+    return _create_naflexvit('test_naflexvit', pretrained=pretrained, **dict(model_args, **kwargs))

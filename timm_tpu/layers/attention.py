@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from flax import nnx
 
 from .config import use_fused_attn
-from .drop import Dropout
+from .drop import Dropout, dropout_rng_key
 from .weight_init import trunc_normal_, zeros_
 
 __all__ = ['Attention', 'AttentionRope', 'maybe_add_mask', 'apply_rot_embed_cat']
@@ -135,7 +135,7 @@ class Attention(nnx.Module):
         B, N, C = x.shape
         q, k, v = self._qkv(x)
         dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop_rate
-        dropout_key = self.attn_drop.rngs.dropout() if (dropout_p > 0.0 and self.attn_drop.rngs is not None) else None
+        dropout_key = dropout_rng_key(self.attn_drop) if dropout_p > 0.0 else None
         x = scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale,
         )
@@ -167,7 +167,7 @@ class AttentionRope(Attention):
             q = q.astype(v.dtype)
             k = k.astype(v.dtype)
         dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop_rate
-        dropout_key = self.attn_drop.rngs.dropout() if (dropout_p > 0.0 and self.attn_drop.rngs is not None) else None
+        dropout_key = dropout_rng_key(self.attn_drop) if dropout_p > 0.0 else None
         x = scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale,
         )

@@ -1,0 +1,120 @@
+"""Differential attention (reference: timm/layers/diff_attention.py:21-179).
+
+Attn = softmax(Q1 K1ᵀ) − λ · softmax(Q2 K2ᵀ), λ reparameterized via
+exp(λq1·λk1) − exp(λq2·λk2) + λ_init with depth-dependent λ_init
+(0.8 − 0.6·exp(−0.3·depth)); per-head RMS sub-norm scaled by (1 − λ_init).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from .attention import maybe_add_mask
+from .drop import Dropout, dropout_rng_key
+from .norm import RmsNorm
+from .weight_init import normal_, trunc_normal_, zeros_
+
+__all__ = ['DiffAttention']
+
+
+class DiffAttention(nnx.Module):
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int = 8,
+            qkv_bias: bool = False,
+            qk_norm: bool = False,
+            scale_norm: bool = False,
+            proj_bias: bool = True,
+            attn_drop: float = 0.0,
+            proj_drop: float = 0.0,
+            norm_layer: Optional[Callable] = None,
+            depth: int = 0,
+            dual_lambda: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert dim % num_heads == 0, 'dim should be divisible by num_heads'
+        norm_layer = norm_layer or RmsNorm
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads // 2
+        self.scale = self.head_dim ** -0.5
+        self.attn_drop_rate = attn_drop
+
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.qkv = linear(dim, dim * 3, use_bias=qkv_bias)
+        self.q_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.k_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.norm = norm_layer(dim, rngs=rngs) if scale_norm else None
+        self.proj = linear(dim, dim, use_bias=proj_bias)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+        self.dual_lambda = dual_lambda
+        if dual_lambda:
+            self.lambda_a = nnx.Param(jnp.zeros((), jnp.float32))
+            self.lambda_b = nnx.Param(jnp.zeros((), jnp.float32))
+            self.lambda_q1 = self.lambda_k1 = self.lambda_q2 = self.lambda_k2 = None
+        else:
+            self.lambda_a = self.lambda_b = None
+            init = normal_(0.1)
+            self.lambda_q1 = nnx.Param(init(rngs.params(), (self.head_dim,), jnp.float32))
+            self.lambda_k1 = nnx.Param(init(rngs.params(), (self.head_dim,), jnp.float32))
+            self.lambda_q2 = nnx.Param(init(rngs.params(), (self.head_dim,), jnp.float32))
+            self.lambda_k2 = nnx.Param(init(rngs.params(), (self.head_dim,), jnp.float32))
+
+        self.sub_norm = RmsNorm(2 * self.head_dim, eps=1e-5, rngs=rngs)
+        self.lambda_init = 0.8 - 0.6 * math.exp(-0.3 * depth)
+
+    def _compute_lambda(self):
+        if self.lambda_a is not None:
+            l1 = jnp.exp(self.lambda_a[...])
+            l2 = jnp.exp(self.lambda_b[...])
+        else:
+            l1 = jnp.exp(jnp.sum(self.lambda_q1[...] * self.lambda_k1[...]))
+            l2 = jnp.exp(jnp.sum(self.lambda_q2[...] * self.lambda_k2[...]))
+        return l1 - l2 + self.lambda_init
+
+    def __call__(self, x, attn_mask=None):
+        B, N, C = x.shape
+        qkv = self.qkv(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, N, 2 * self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, N, 2 * self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, N, self.num_heads, 2 * self.head_dim).transpose(0, 2, 1, 3)
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+        if self.k_norm is not None:
+            k = self.k_norm(k)
+
+        lam = self._compute_lambda().astype(jnp.float32)
+
+        q = q * self.scale
+        attn = jnp.einsum('bhqd,bhkd->bhqk', q, k).astype(jnp.float32)
+        attn = maybe_add_mask(attn, attn_mask)
+        attn = jax.nn.softmax(attn, axis=-1)
+        if self.attn_drop_rate > 0.0 and not self.attn_drop.deterministic:
+            key = dropout_rng_key(self.attn_drop)
+            if key is not None:
+                keep = jax.random.bernoulli(key, 1.0 - self.attn_drop_rate, attn.shape)
+                attn = jnp.where(keep, attn / (1.0 - self.attn_drop_rate), 0.0)
+        attn = attn.reshape(B, self.num_heads, 2, N, N)
+        attn = attn[:, :, 0] - lam * attn[:, :, 1]
+        x = jnp.einsum('bhqk,bhkd->bhqd', attn.astype(v.dtype), v)
+
+        x = self.sub_norm(x)
+        x = x * (1.0 - self.lambda_init)
+        x = x.transpose(0, 2, 1, 3).reshape(B, N, C)
+        if self.norm is not None:
+            x = self.norm(x)
+        x = self.proj(x)
+        return self.proj_drop(x)

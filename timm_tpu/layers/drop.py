@@ -48,6 +48,17 @@ class Dropout(nnx.Dropout):
         super().__init__(rate=rate, rngs=rngs if rate > 0.0 else None)
 
 
+def dropout_rng_key(drop) -> Optional[jax.Array]:
+    """Draw a key from a Dropout module's stream (nnx stores an RngStream or
+    an Rngs depending on construction), or None if it has no stream."""
+    r = getattr(drop, 'rngs', None)
+    if r is None:
+        return None
+    if hasattr(r, 'dropout'):
+        return r.dropout()
+    return r()
+
+
 def calculate_drop_path_rates(
         drop_path_rate: float,
         depths: Union[int, List[int]],

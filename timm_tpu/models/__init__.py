@@ -14,8 +14,10 @@ from ._registry import (
 )
 
 from .convnext import ConvNeXt
+from .deit import VisionTransformerDistilled
 from .efficientnet import EfficientNet
 from .mlp_mixer import MlpMixer
 from .naflexvit import NaFlexVit
 from .resnet import ResNet
+from .swin_transformer import SwinTransformer
 from .vision_transformer import VisionTransformer

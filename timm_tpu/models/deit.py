@@ -1,0 +1,166 @@
+"""DeiT — data-efficient ViT w/ distillation token
+(reference: timm/models/deit.py:1-423).
+
+VisionTransformerDistilled adds a dist_token + separate head; eval-mode
+forward averages the two heads (reference deit.py forward_head).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import trunc_normal_, zeros_
+from ._builder import build_model_with_cfg
+from ._registry import generate_default_cfgs, register_model
+from .vision_transformer import VisionTransformer
+
+__all__ = ['VisionTransformerDistilled']
+
+
+class VisionTransformerDistilled(VisionTransformer):
+    """ViT + distillation token (reference deit.py VisionTransformerDistilled)."""
+
+    def __init__(self, *args, rngs: nnx.Rngs, **kwargs):
+        # the distillation-token design requires a class token + token pooling
+        caller_pool = kwargs.pop('global_pool', 'token')
+        assert caller_pool in ('token',), 'VisionTransformerDistilled requires token pooling'
+        kwargs.pop('class_token', None)
+        super().__init__(*args, rngs=rngs, class_token=True, global_pool='token', **kwargs)
+        assert self.global_pool == 'token'
+
+        self.num_prefix_tokens += 1
+        self.dist_token = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (1, 1, self.embed_dim), self._param_dtype or jnp.float32))
+        # pos embed needs the extra token slot: rebuild
+        num_patches = self.patch_embed.num_patches
+        embed_len = num_patches if self.no_embed_class else num_patches + self.num_prefix_tokens
+        self.pos_embed = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (1, embed_len, self.embed_dim),
+                                    self._param_dtype or jnp.float32))
+        self.head_dist = nnx.Linear(
+            self.embed_dim, self.num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=self._dtype, param_dtype=self._param_dtype or jnp.float32, rngs=rngs,
+        ) if self.num_classes > 0 else None
+        self.distilled_training = False  # toggled by the distillation task
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^cls_token|pos_embed|patch_embed|dist_token',
+            blocks=[(r'^blocks\.(\d+)', None), (r'^norm', (99999,))],
+        )
+
+    def no_weight_decay(self) -> set:
+        return super().no_weight_decay() | {'dist_token'}
+
+    def get_classifier(self):
+        return self.head, self.head_dist
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        super().reset_classifier(num_classes, global_pool, rngs=rngs)
+        self.head_dist = nnx.Linear(
+            self.embed_dim, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype or jnp.float32, rngs=rngs,
+        ) if num_classes > 0 else None
+
+    def set_distilled_training(self, enable: bool = True):
+        self.distilled_training = enable
+
+    def _pos_embed(self, x, grid_size=None):
+        B = x.shape[0]
+        pos_embed = self.pos_embed[...].astype(x.dtype) if self.pos_embed is not None else None
+        to_cat = [
+            jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (B, 1, x.shape[-1])),
+            jnp.broadcast_to(self.dist_token[...].astype(x.dtype), (B, 1, x.shape[-1])),
+        ]
+        if self.no_embed_class:
+            if pos_embed is not None:
+                x = x + pos_embed
+            x = jnp.concatenate(to_cat + [x], axis=1)
+        else:
+            x = jnp.concatenate(to_cat + [x], axis=1)
+            if pos_embed is not None:
+                x = x + pos_embed
+        return self.pos_drop(x)
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x_cls, x_dist = x[:, 0], x[:, 1]
+        if pre_logits or self.head is None or self.head_dist is None:
+            return (x_cls + x_dist) / 2
+        x_cls = self.head(x_cls)
+        x_dist = self.head_dist(x_dist)
+        if self.distilled_training:
+            return x_cls, x_dist  # distillation task consumes both
+        return (x_cls + x_dist) / 2
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': None,
+        'crop_pct': 0.875,
+        'interpolation': 'bicubic',
+        'fixed_input_size': True,
+        'mean': (0.485, 0.456, 0.406),
+        'std': (0.229, 0.224, 0.225),
+        'first_conv': 'patch_embed.proj',
+        'classifier': ('head', 'head_dist'),
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'deit_tiny_distilled_patch16_224.fb_in1k': _cfg(hf_hub_id='timm/'),
+    'deit_small_distilled_patch16_224.fb_in1k': _cfg(hf_hub_id='timm/'),
+    'deit_base_distilled_patch16_224.fb_in1k': _cfg(hf_hub_id='timm/'),
+    'deit3_small_patch16_224.fb_in22k_ft_in1k': _cfg(hf_hub_id='timm/', classifier='head'),
+    'deit3_base_patch16_224.fb_in22k_ft_in1k': _cfg(hf_hub_id='timm/', classifier='head'),
+})
+
+
+def _create_deit(variant: str, pretrained: bool = False, distilled: bool = False, **kwargs):
+    from ._torch_convert import convert_torch_state_dict
+    model_cls = VisionTransformerDistilled if distilled else VisionTransformer
+    return build_model_with_cfg(
+        model_cls, variant, pretrained,
+        pretrained_filter_fn=convert_torch_state_dict,
+        **kwargs,
+    )
+
+
+@register_model
+def deit_tiny_distilled_patch16_224(pretrained=False, **kwargs):
+    model_args = dict(patch_size=16, embed_dim=192, depth=12, num_heads=3)
+    return _create_deit('deit_tiny_distilled_patch16_224', pretrained, distilled=True, **dict(model_args, **kwargs))
+
+
+@register_model
+def deit_small_distilled_patch16_224(pretrained=False, **kwargs):
+    model_args = dict(patch_size=16, embed_dim=384, depth=12, num_heads=6)
+    return _create_deit('deit_small_distilled_patch16_224', pretrained, distilled=True, **dict(model_args, **kwargs))
+
+
+@register_model
+def deit_base_distilled_patch16_224(pretrained=False, **kwargs):
+    model_args = dict(patch_size=16, embed_dim=768, depth=12, num_heads=12)
+    return _create_deit('deit_base_distilled_patch16_224', pretrained, distilled=True, **dict(model_args, **kwargs))
+
+
+@register_model
+def deit3_small_patch16_224(pretrained=False, **kwargs):
+    """DeiT-III: no dist token, LayerScale + no pos-embed class token."""
+    model_args = dict(
+        patch_size=16, embed_dim=384, depth=12, num_heads=6, no_embed_class=True, init_values=1e-6)
+    return _create_deit('deit3_small_patch16_224', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def deit3_base_patch16_224(pretrained=False, **kwargs):
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, no_embed_class=True, init_values=1e-6)
+    return _create_deit('deit3_base_patch16_224', pretrained, **dict(model_args, **kwargs))

@@ -306,6 +306,60 @@ class NaFlexVit(nnx.Module):
         x = self.forward_features(patches, patch_coord, patch_valid)
         return self.forward_head(x, patch_valid)
 
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        """Collect per-block token outputs; NHWC reshape only possible for
+        image-tensor inputs (dict/pre-patchified callers get NLC)."""
+        from ._features import feature_take_indices
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        grid = None
+        if not isinstance(x, dict) and x.ndim == 4:
+            B, H, W, _ = x.shape
+            P = self.embeds.patch_size
+            grid = (H // P, W // P)
+            patches, patch_coord, patch_valid = patchify_image(x, P)
+        elif isinstance(x, dict):
+            patches, patch_coord, patch_valid = x['patches'], x['patch_coord'], x.get('patch_valid')
+        else:
+            raise ValueError('forward_intermediates expects an NHWC image or a NaFlex dict')
+        if output_fmt == 'NHWC' and grid is None:
+            output_fmt = 'NLC'
+
+        tokens = self.embeds(patches, patch_coord)
+        attn_mask = None
+        if patch_valid is not None:
+            attn_mask = create_attention_mask(
+                patch_valid, num_prefix_tokens=self.num_prefix_tokens,
+                symmetric=self.mask_mode == 'symmetric')
+        intermediates = []
+        blocks = self.blocks if not stop_early else list(self.blocks)[:max_index + 1]
+        for i, blk in enumerate(blocks):
+            tokens = blk(tokens, attn_mask=attn_mask)
+            if i in take_indices:
+                y = self.norm(tokens) if (norm and self.norm is not None) else tokens
+                y = y[:, self.num_prefix_tokens:]
+                if output_fmt == 'NHWC':
+                    y = y.reshape(y.shape[0], grid[0], grid[1], -1)
+                intermediates.append(y)
+        if intermediates_only:
+            return intermediates
+        if self.norm is not None:
+            tokens = self.norm(tokens)
+        return tokens, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        from ._features import feature_take_indices
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.fc_norm = None
+            self.reset_classifier(0)
+        return take_indices
+
 
 def patchify_image(x, patch_size: int):
     """NHWC image → (patches, coords, valid) (reference naflex_transforms.py:751)."""

@@ -51,13 +51,15 @@ class Block(nnx.Module):
             act_layer: Union[str, Callable] = 'gelu',
             norm_layer: Callable = LayerNorm,
             mlp_layer: Callable = Mlp,
+            attn_layer: Optional[Callable] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
             rngs: nnx.Rngs,
     ):
+        attn_layer = attn_layer or Attention
         self.norm1 = norm_layer(dim, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
-        self.attn = Attention(
+        self.attn = attn_layer(
             dim,
             num_heads=num_heads,
             qkv_bias=qkv_bias,
@@ -116,13 +118,15 @@ class ResPostBlock(nnx.Module):
             act_layer: Union[str, Callable] = 'gelu',
             norm_layer: Callable = LayerNorm,
             mlp_layer: Callable = Mlp,
+            attn_layer: Optional[Callable] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
             rngs: nnx.Rngs,
     ):
         self.init_values = init_values
-        self.attn = Attention(
+        attn_cls = attn_layer or Attention
+        self.attn = attn_cls(
             dim, num_heads=num_heads, qkv_bias=qkv_bias, qk_norm=qk_norm, proj_bias=proj_bias,
             attn_drop=attn_drop, proj_drop=proj_drop, norm_layer=norm_layer,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs,
@@ -188,6 +192,7 @@ class VisionTransformer(nnx.Module):
             act_layer: Optional[Union[str, Callable]] = None,
             block_fn: Callable = Block,
             mlp_layer: Callable = Mlp,
+            attn_layer: Optional[Union[str, Callable]] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -247,6 +252,14 @@ class VisionTransformer(nnx.Module):
             self.patch_drop = None
         self.norm_pre = norm_layer(embed_dim, rngs=rngs) if pre_norm else None
 
+        def _resolve_attn_layer(i: int):
+            if attn_layer is None:
+                return None
+            if attn_layer == 'diff':
+                from ..layers.diff_attention import DiffAttention
+                return partial(DiffAttention, depth=i)  # depth-dependent lambda_init
+            return attn_layer
+
         dpr = calculate_drop_path_rates(drop_path_rate, depth)
         self.blocks = nnx.List([
             block_fn(
@@ -263,6 +276,7 @@ class VisionTransformer(nnx.Module):
                 norm_layer=norm_layer,
                 act_layer=act_layer,
                 mlp_layer=mlp_layer,
+                attn_layer=_resolve_attn_layer(i),
                 dtype=dtype,
                 param_dtype=param_dtype,
                 rngs=rngs,
@@ -535,6 +549,12 @@ default_cfgs = generate_default_cfgs({
     'vit_base_patch16_384.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
     'vit_base_patch8_224.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/'),
     'vit_large_patch16_224.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'vit_dlittle_patch16_reg1_gap_256.sbb_nadamuon_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_little_patch16_reg4_gap_256.sbb_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_medium_patch16_reg4_gap_256.sbb_in12k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
     'vit_large_patch14_224.untrained': _cfg(url=''),
     'vit_huge_patch14_224.untrained': _cfg(url=''),
     'vit_so400m_patch14_siglip_224.untrained': _cfg(url=''),
@@ -611,6 +631,39 @@ def vit_base_patch16_384(pretrained: bool = False, **kwargs) -> VisionTransforme
 def vit_base_patch8_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
     model_args = dict(patch_size=8, embed_dim=768, depth=12, num_heads=12)
     return _create_vision_transformer('vit_base_patch8_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_dlittle_patch16_reg1_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """Differential-attention 'little' ViT (sbb recipe, reference
+    vision_transformer.py:4440)."""
+    model_args = dict(
+        patch_size=16, embed_dim=320, depth=14, num_heads=5, init_values=1e-5, mlp_ratio=5.6,
+        class_token=False, no_embed_class=True, reg_tokens=1, global_pool='avg', attn_layer='diff',
+        img_size=256,
+    )
+    return _create_vision_transformer(
+        'vit_dlittle_patch16_reg1_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_little_patch16_reg4_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=320, depth=14, num_heads=5, init_values=1e-5, mlp_ratio=5.6,
+        class_token=False, no_embed_class=True, reg_tokens=4, global_pool='avg', img_size=256,
+    )
+    return _create_vision_transformer(
+        'vit_little_patch16_reg4_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_medium_patch16_reg4_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=512, depth=12, num_heads=8, init_values=1e-5,
+        class_token=False, no_embed_class=True, reg_tokens=4, global_pool='avg', img_size=256,
+    )
+    return _create_vision_transformer(
+        'vit_medium_patch16_reg4_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
 
 
 @register_model

@@ -1,3 +1,4 @@
 from .classification import ClassificationTask
 from .distillation import FeatureDistillationTask, LogitDistillationTask
+from .token_distillation import TokenDistillationTask
 from .task import TrainingTask

@@ -1,0 +1,69 @@
+"""DeiT-style token distillation (reference: timm/task/token_distillation.py).
+
+Student must expose `set_distilled_training(True)` and return
+(cls_logits, dist_logits) in distilled-training mode.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..loss import LabelSmoothingCrossEntropy, cross_entropy
+from .task import TrainingTask
+
+__all__ = ['TokenDistillationTask']
+
+
+class TokenDistillationTask(TrainingTask):
+    def __init__(
+            self,
+            model: nnx.Module,
+            teacher: nnx.Module,
+            optimizer=None,
+            train_loss_fn: Optional[Callable] = None,
+            distill_type: str = 'hard',
+            distill_alpha: float = 0.5,
+            distill_temperature: float = 1.0,
+            **kwargs,
+    ):
+        assert distill_type in ('soft', 'hard')
+        assert hasattr(model, 'set_distilled_training'), 'model must support the distilled-training contract'
+        model.set_distilled_training(True)
+        super().__init__(model, optimizer=optimizer, **kwargs)
+        teacher.eval()
+        self._teacher_graphdef, self._teacher_state = nnx.split(teacher)
+        self.train_loss_fn = train_loss_fn or LabelSmoothingCrossEntropy(0.0)
+        self.distill_type = distill_type
+        self.alpha = distill_alpha
+        self.temperature = distill_temperature
+
+    def loss_forward(self, model: nnx.Module, batch: Dict[str, Any]):
+        x = batch['input']
+        out = model(x)
+        assert isinstance(out, tuple), 'distilled model must return (cls, dist) logits in training'
+        cls_logits, dist_logits = out
+        teacher = nnx.merge(self._teacher_graphdef, self._teacher_state)
+        teacher_logits = jax.lax.stop_gradient(teacher(x))
+
+        base_loss = self.train_loss_fn(cls_logits, batch['target'])
+        if self.distill_type == 'hard':
+            kd = cross_entropy(dist_logits, jnp.argmax(teacher_logits, axis=-1))
+        else:
+            T = self.temperature
+            s = jax.nn.log_softmax(dist_logits.astype(jnp.float32) / T, axis=-1)
+            t = jax.nn.softmax(teacher_logits.astype(jnp.float32) / T, axis=-1)
+            kd = (t * (jnp.log(jnp.clip(t, 1e-9)) - s)).sum(axis=-1).mean() * (T * T)
+        loss = (1.0 - self.alpha) * base_loss + self.alpha * kd
+        return loss, cls_logits
+
+    def eval_forward(self, model: nnx.Module, batch: Dict[str, Any]):
+        # averaged-head eval WITHOUT flipping distilled_training — attribute
+        # mutation inside the jitted step would leak to the shared model and
+        # break subsequent train steps (flags are trace-time structure)
+        feats = model.forward_features(batch['input'])
+        x_cls = model.head(feats[:, 0])
+        x_dist = model.head_dist(feats[:, 1])
+        return (x_cls + x_dist) / 2

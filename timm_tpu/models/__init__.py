@@ -15,9 +15,11 @@ from ._registry import (
 
 from .convnext import ConvNeXt
 from .deit import VisionTransformerDistilled
+from .densenet import DenseNet
 from .efficientnet import EfficientNet
 from .mlp_mixer import MlpMixer
 from .naflexvit import NaFlexVit
 from .resnet import ResNet
 from .swin_transformer import SwinTransformer
+from .vgg import VGG
 from .vision_transformer import VisionTransformer

@@ -1,0 +1,213 @@
+"""DenseNet (reference: timm/models/densenet.py:1-563), TPU-native NHWC."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNormAct2d, ClassifierHead, create_conv2d
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+from .resnet import avg_pool2d, max_pool2d
+
+__all__ = ['DenseNet']
+
+
+class DenseLayer(nnx.Module):
+    def __init__(self, in_chs: int, growth_rate: int, bn_size: int = 4,
+                 norm_layer: Callable = BatchNormAct2d, drop_rate: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.norm1 = norm_layer(in_chs, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv1 = create_conv2d(in_chs, bn_size * growth_rate, 1,
+                                   dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm2 = norm_layer(bn_size * growth_rate, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv2 = create_conv2d(bn_size * growth_rate, growth_rate, 3, padding='same',
+                                   dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        out = self.conv1(self.norm1(x))
+        out = self.conv2(self.norm2(out))
+        return jnp.concatenate([x, out], axis=-1)
+
+
+class DenseTransition(nnx.Module):
+    def __init__(self, in_chs: int, out_chs: int, norm_layer: Callable = BatchNormAct2d,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.norm = norm_layer(in_chs, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv = create_conv2d(in_chs, out_chs, 1, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        x = self.conv(self.norm(x))
+        return avg_pool2d(x, 2, 2)
+
+
+class DenseNet(nnx.Module):
+    def __init__(
+            self,
+            growth_rate: int = 32,
+            block_config: Tuple[int, ...] = (6, 12, 24, 16),
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            global_pool: str = 'avg',
+            bn_size: int = 4,
+            stem_type: str = '',
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            drop_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.num_classes = num_classes
+        num_init_features = growth_rate * 2
+
+        self.stem_conv = create_conv2d(in_chans, num_init_features, 7, stride=2, padding='same',
+                                       dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.stem_norm = norm_layer(num_init_features, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.feature_info = [dict(num_chs=num_init_features, reduction=2, module='stem_norm')]
+
+        blocks = []
+        transitions = []
+        num_features = num_init_features
+        curr_stride = 4
+        for i, num_layers in enumerate(block_config):
+            layers = []
+            for j in range(num_layers):
+                layers.append(DenseLayer(
+                    num_features + j * growth_rate, growth_rate, bn_size=bn_size,
+                    norm_layer=norm_layer, drop_rate=drop_rate,
+                    dtype=dtype, param_dtype=param_dtype, rngs=rngs))
+            blocks.append(nnx.List(layers))
+            num_features = num_features + num_layers * growth_rate
+            self.feature_info.append(dict(
+                num_chs=num_features, reduction=curr_stride, module=f'denseblock{i + 1}'))
+            if i != len(block_config) - 1:
+                transitions.append(DenseTransition(
+                    num_features, num_features // 2, norm_layer=norm_layer,
+                    dtype=dtype, param_dtype=param_dtype, rngs=rngs))
+                num_features = num_features // 2
+                curr_stride *= 2
+        self.blocks = nnx.List(blocks)
+        self.transitions = nnx.List(transitions)
+        self.final_norm = norm_layer(num_features, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        self.num_features = self.head_hidden_size = num_features
+        self.head = ClassifierHead(
+            num_features, num_classes, pool_type=global_pool, drop_rate=drop_rate,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.grad_checkpointing = False
+
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(stem=r'^stem_', blocks=r'^blocks\.(\d+)')
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        self.head.reset(num_classes, pool_type=global_pool, rngs=rngs)
+
+    def _stem(self, x):
+        x = self.stem_norm(self.stem_conv(x))
+        return max_pool2d(x, 3, 2)
+
+    def forward_features(self, x):
+        x = self._stem(x)
+        for i, block in enumerate(self.blocks):
+            for layer in block:
+                x = layer(x)
+            if i < len(self.transitions):
+                x = self.transitions[i](x)
+        return self.final_norm(x)
+
+    def forward_head(self, x, pre_logits: bool = False):
+        return self.head(x, pre_logits=pre_logits)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        num_stages = len(self.blocks) + 1
+        take_indices, max_index = feature_take_indices(num_stages, indices)
+        x = self.stem_norm(self.stem_conv(x))
+        intermediates = []
+        if 0 in take_indices:
+            intermediates.append(x)
+        x = max_pool2d(x, 3, 2)
+        for i, block in enumerate(self.blocks):
+            if stop_early and i > max_index - 1:
+                break
+            for layer in block:
+                x = layer(x)
+            if (i + 1) in take_indices:
+                intermediates.append(x)
+            if i < len(self.transitions):
+                x = self.transitions[i](x)
+        if intermediates_only:
+            return intermediates
+        x = self.final_norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, _ = feature_take_indices(len(self.blocks) + 1, indices)
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url, 'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'stem_conv', 'classifier': 'head.fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'densenet121.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'densenet169.tv_in1k': _cfg(hf_hub_id='timm/'),
+    'densenet201.tv_in1k': _cfg(hf_hub_id='timm/'),
+})
+
+
+def _create_densenet(variant: str, pretrained: bool = False, **kwargs) -> DenseNet:
+    from ._torch_convert import convert_torch_state_dict
+    return build_model_with_cfg(
+        DenseNet, variant, pretrained,
+        pretrained_filter_fn=convert_torch_state_dict,
+        feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
+        **kwargs,
+    )
+
+
+@register_model
+def densenet121(pretrained=False, **kwargs) -> DenseNet:
+    model_args = dict(growth_rate=32, block_config=(6, 12, 24, 16))
+    return _create_densenet('densenet121', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def densenet169(pretrained=False, **kwargs) -> DenseNet:
+    model_args = dict(growth_rate=32, block_config=(6, 12, 32, 32))
+    return _create_densenet('densenet169', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def densenet201(pretrained=False, **kwargs) -> DenseNet:
+    model_args = dict(growth_rate=32, block_config=(6, 12, 48, 32))
+    return _create_densenet('densenet201', pretrained, **dict(model_args, **kwargs))

@@ -1,0 +1,259 @@
+"""VGG (reference: timm/models/vgg.py:1-426), TPU-native NHWC."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union, cast
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNormAct2d, ClassifierHead, create_conv2d, get_act_fn
+from ..layers.drop import Dropout
+from ..layers.weight_init import trunc_normal_, zeros_
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+from .resnet import max_pool2d
+
+__all__ = ['VGG']
+
+_cfgs: Dict[str, List[Any]] = {
+    'vgg11': [64, 'M', 128, 'M', 256, 256, 'M', 512, 512, 'M', 512, 512, 'M'],
+    'vgg13': [64, 64, 'M', 128, 128, 'M', 256, 256, 'M', 512, 512, 'M', 512, 512, 'M'],
+    'vgg16': [64, 64, 'M', 128, 128, 'M', 256, 256, 256, 'M', 512, 512, 512, 'M', 512, 512, 512, 'M'],
+    'vgg19': [64, 64, 'M', 128, 128, 'M', 256, 256, 256, 256, 'M', 512, 512, 512, 512, 'M', 512, 512, 512, 512, 'M'],
+}
+
+
+class ConvMlpHead(nnx.Module):
+    """VGG's fc6/fc7 conv head (reference vgg.py ConvMlp)."""
+
+    def __init__(self, in_features=512, out_features=4096, kernel_size=7, mlp_ratio=1.0,
+                 drop_rate: float = 0.2, act_layer='relu', *, dtype=None, param_dtype=jnp.float32, rngs):
+        self.input_kernel_size = kernel_size
+        mid_features = int(out_features * mlp_ratio)
+        self.fc1 = create_conv2d(in_features, mid_features, kernel_size, bias=True, padding='valid',
+                                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.act1 = get_act_fn(act_layer)
+        self.drop = Dropout(drop_rate, rngs=rngs)
+        self.fc2 = create_conv2d(mid_features, out_features, 1, bias=True,
+                                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.act2 = get_act_fn(act_layer)
+
+    def __call__(self, x):
+        x = self.act1(self.fc1(x))
+        x = self.drop(x)
+        return self.act2(self.fc2(x))
+
+
+class VGG(nnx.Module):
+    def __init__(
+            self,
+            cfg: List[Any],
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            output_stride: int = 32,
+            mlp_ratio: float = 1.0,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Optional[Callable] = None,
+            global_pool: str = 'avg',
+            drop_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert output_stride == 32
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        self.use_norm = norm_layer is not None
+        self.feature_info = []
+
+        prev_chs = in_chans
+        net_stride = 1
+        layers = []  # list of ('conv', conv, norm|None) / ('pool',)
+        convs = []
+        norms = []
+        plan = []
+        for v in cfg:
+            if v == 'M':
+                plan.append(('pool', None))
+                net_stride *= 2
+            else:
+                v = cast(int, v)
+                conv = create_conv2d(prev_chs, v, 3, padding='same', bias=not self.use_norm,
+                                     dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+                norm = norm_layer(v, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
+                    if self.use_norm else None
+                convs.append(conv)
+                norms.append(norm)
+                plan.append(('conv', len(convs) - 1))
+                prev_chs = v
+        # feature info per pre-pool stage
+        stage_chs = [c for c in cfg if c != 'M']
+        red = 1
+        for v in cfg:
+            if v == 'M':
+                red *= 2
+        self.plan = plan
+        self.convs = nnx.List(convs)
+        self.norms = nnx.List([n for n in norms if n is not None]) if self.use_norm else None
+        self._norm_map = {i: j for j, i in enumerate([k for k, n in enumerate(norms) if n is not None])}
+        self.act = get_act_fn(act_layer)
+
+        # feature_info: record after each pool
+        chs = in_chans
+        red = 1
+        for v in cfg:
+            if v == 'M':
+                red *= 2
+                self.feature_info.append(dict(num_chs=chs, reduction=red, module=f'features.{len(self.feature_info)}'))
+            else:
+                chs = cast(int, v)
+
+        self.num_features = prev_chs
+        self.head_hidden_size = 4096
+        self.pre_logits = ConvMlpHead(
+            prev_chs, 4096, 7, mlp_ratio=mlp_ratio, drop_rate=drop_rate, act_layer=act_layer,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.head = ClassifierHead(
+            4096, num_classes, pool_type=global_pool, drop_rate=drop_rate,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.grad_checkpointing = False
+
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(stem=r'^convs\.0', blocks=r'^convs\.(\d+)')
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        self.head.reset(num_classes, pool_type=global_pool, rngs=rngs)
+
+    def forward_features(self, x):
+        for kind, idx in self.plan:
+            if kind == 'pool':
+                x = max_pool2d(x, 2, 2)
+            else:
+                x = self.convs[idx](x)
+                if self.use_norm:
+                    x = self.norms[self._norm_map[idx]](x)
+                else:
+                    x = self.act(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        # pad spatial to the fc6 kernel if needed (small inputs)
+        k = self.pre_logits.input_kernel_size
+        if x.shape[1] < k or x.shape[2] < k:
+            pad_h = max(0, k - x.shape[1])
+            pad_w = max(0, k - x.shape[2])
+            x = jnp.pad(x, ((0, 0), (0, pad_h), (0, 0 if not pad_w else pad_w), (0, 0)))
+        x = self.pre_logits(x)
+        return self.head(x, pre_logits=pre_logits)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        num_stages = len(self.feature_info)
+        take_indices, max_index = feature_take_indices(num_stages, indices)
+        intermediates = []
+        stage = 0
+        for kind, idx in self.plan:
+            if kind == 'pool':
+                if stage in take_indices:
+                    intermediates.append(x)
+                x = max_pool2d(x, 2, 2)
+                stage += 1
+                if stop_early and stage > max_index:
+                    break
+            else:
+                x = self.convs[idx](x)
+                if self.use_norm:
+                    x = self.norms[self._norm_map[idx]](x)
+                else:
+                    x = self.act(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, _ = feature_take_indices(len(self.feature_info), indices)
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url, 'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bilinear',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'convs.0', 'classifier': 'head.fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'vgg11.tv_in1k': _cfg(hf_hub_id='timm/'),
+    'vgg13.tv_in1k': _cfg(hf_hub_id='timm/'),
+    'vgg16.tv_in1k': _cfg(hf_hub_id='timm/'),
+    'vgg19.tv_in1k': _cfg(hf_hub_id='timm/'),
+    'vgg11_bn.tv_in1k': _cfg(hf_hub_id='timm/'),
+    'vgg16_bn.tv_in1k': _cfg(hf_hub_id='timm/'),
+})
+
+
+def _create_vgg(variant: str, pretrained: bool = False, **kwargs) -> VGG:
+    from ._torch_convert import convert_torch_state_dict
+    arch = variant.split('_')[0]
+    if variant.endswith('_bn'):
+        kwargs.setdefault('norm_layer', BatchNormAct2d)
+    return build_model_with_cfg(
+        VGG, variant, pretrained,
+        model_cfg=_cfgs[arch],
+        pretrained_filter_fn=convert_torch_state_dict,
+        feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
+        **kwargs,
+    )
+
+
+@register_model
+def vgg11(pretrained=False, **kwargs) -> VGG:
+    return _create_vgg('vgg11', pretrained, **kwargs)
+
+
+@register_model
+def vgg13(pretrained=False, **kwargs) -> VGG:
+    return _create_vgg('vgg13', pretrained, **kwargs)
+
+
+@register_model
+def vgg16(pretrained=False, **kwargs) -> VGG:
+    return _create_vgg('vgg16', pretrained, **kwargs)
+
+
+@register_model
+def vgg19(pretrained=False, **kwargs) -> VGG:
+    return _create_vgg('vgg19', pretrained, **kwargs)
+
+
+@register_model
+def vgg11_bn(pretrained=False, **kwargs) -> VGG:
+    return _create_vgg('vgg11_bn', pretrained, **kwargs)
+
+
+@register_model
+def vgg16_bn(pretrained=False, **kwargs) -> VGG:
+    return _create_vgg('vgg16_bn', pretrained, **kwargs)

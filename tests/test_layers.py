@@ -153,3 +153,57 @@ def test_clip_grads():
     params = {'a': jnp.ones((4, 4)), 'b': jnp.ones((4,))}
     agc = adaptive_clip_grad(params, grads, clip_factor=0.01)
     assert float(jnp.abs(jax.tree.leaves(agc)[0]).max()) < 10.0
+
+
+def test_attn_modules():
+    from timm_tpu.layers import CbamModule, EcaModule, create_attn
+    rngs = nnx.Rngs(0)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 64), jnp.float32)
+    for name in ('se', 'ese', 'eca', 'cbam'):
+        mod = create_attn(name, 64, rngs=rngs)
+        assert mod(x).shape == x.shape
+    assert create_attn(None, 64, rngs=rngs) is None
+    with pytest.raises(ValueError):
+        create_attn('bogus', 64, rngs=rngs)
+
+
+def test_blur_pool():
+    from timm_tpu.layers import BlurPool2d
+    x = jnp.ones((1, 8, 8, 4))
+    out = BlurPool2d(4)(x)
+    assert out.shape == (1, 4, 4, 4)
+    assert bool(jnp.allclose(out, 1.0, atol=1e-5))  # low-pass of constant = constant
+
+
+def test_scaled_std_conv():
+    from timm_tpu.layers import ScaledStdConv2d
+    rngs = nnx.Rngs(0)
+    conv = ScaledStdConv2d(8, 16, 3, rngs=rngs)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 8), jnp.float32)
+    assert conv(x).shape == (2, 8, 8, 16)
+    # kernel itself must stay unstandardized (standardization is call-time)
+    w = conv.conv.kernel[...]
+    assert float(jnp.abs(w.mean(axis=(0, 1, 2))).max()) > 1e-4
+
+
+def test_evo_norms():
+    from timm_tpu.layers import EvoNorm2dB0, EvoNorm2dS0
+    rngs = nnx.Rngs(0)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 64), jnp.float32)
+    b0 = EvoNorm2dB0(64, rngs=rngs)
+    assert b0(x).shape == x.shape
+    rv_before = b0.running_var[...].copy()
+    b0(x)
+    assert not bool(jnp.allclose(rv_before, b0.running_var[...]))  # stats update
+    s0 = EvoNorm2dS0(64, rngs=rngs)
+    assert s0(x).shape == x.shape
+
+
+def test_diff_attention_layer():
+    from timm_tpu.layers import DiffAttention
+    rngs = nnx.Rngs(0)
+    attn = DiffAttention(64, num_heads=4, depth=3, rngs=rngs)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 10, 64), jnp.float32)
+    out = attn(x)
+    assert out.shape == (2, 10, 64)
+    assert 0.2 < attn.lambda_init < 0.8

@@ -5,7 +5,14 @@ from .config import (
     is_exportable, is_scriptable, set_exportable, set_scriptable,
     set_fused_attn, use_fused_attn,
 )
+from .blur_pool import BlurPool2d
+from .cbam import CbamModule, LightCbamModule
 from .create_act import create_act_layer, get_act_fn, get_act_layer
+from .create_attn import create_attn, get_attn
+from .diff_attention import DiffAttention
+from .eca import CecaModule, EcaModule
+from .evo_norm import EvoNorm2dB0, EvoNorm2dS0
+from .std_conv import ScaledStdConv2d, StdConv2d
 from .create_conv2d import ConvNormAct, create_conv2d, get_padding
 from .create_norm import create_norm_layer, get_norm_layer
 from .drop import DropPath, Dropout, calculate_drop_path_rates, drop_path

@@ -1,0 +1,36 @@
+"""Anti-aliased downsampling (reference: timm/layers/blur_pool.py:1-155)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+__all__ = ['BlurPool2d']
+
+
+class BlurPool2d(nnx.Module):
+    """Fixed binomial low-pass filter + stride (Zhang 2019), NHWC depthwise."""
+
+    def __init__(self, channels: int, filt_size: int = 3, stride: int = 2, *, rngs=None):
+        assert filt_size > 1
+        self.channels = channels
+        self.stride = stride
+        coeffs = np.poly1d((0.5, 0.5)) ** (filt_size - 1)
+        blur_1d = np.asarray(coeffs.coeffs, np.float32)
+        blur_2d = blur_1d[:, None] * blur_1d[None, :]
+        # HWIO depthwise kernel: (H, W, 1, C) with feature_group_count=C
+        self._kernel = jnp.asarray(np.tile(blur_2d[:, :, None, None], (1, 1, 1, channels)))
+        self.filt_size = filt_size
+
+    def __call__(self, x):
+        pad = (self.filt_size - 1) // 2
+        pad_cfg = [(0, 0), (pad, self.filt_size - 1 - pad), (pad, self.filt_size - 1 - pad), (0, 0)]
+        x = jnp.pad(x, pad_cfg, mode='reflect')
+        return jax.lax.conv_general_dilated(
+            x, self._kernel.astype(x.dtype),
+            window_strides=(self.stride, self.stride),
+            padding='VALID',
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
+            feature_group_count=self.channels,
+        )

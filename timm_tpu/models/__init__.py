@@ -18,6 +18,7 @@ from .deit import VisionTransformerDistilled
 from .densenet import DenseNet
 from .efficientnet import EfficientNet
 from .mlp_mixer import MlpMixer
+from .mobilenetv3 import MobileNetV3
 from .naflexvit import NaFlexVit
 from .resnet import ResNet
 from .swin_transformer import SwinTransformer

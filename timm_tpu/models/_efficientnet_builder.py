@@ -79,7 +79,12 @@ def _decode_block_str(block_str: str) -> Dict[str, Any]:
                 key, value = splits[:2]
                 options[key] = value
 
+    # act-fn abbreviations used in block strings (reference _decode_block_str)
+    _ACT_ABBREV = {'re': 'relu', 'r6': 'relu6', 'hs': 'hard_swish', 'sw': 'swish',
+                   'mi': 'mish', 'ge': 'gelu', 'si': 'silu'}
     act_layer = options.get('n', None)
+    if act_layer is not None:
+        act_layer = _ACT_ABBREV.get(act_layer, act_layer)
     start_kwargs = dict(
         block_type=block_type,
         out_chs=int(options['c']),

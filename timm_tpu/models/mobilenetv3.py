@@ -1,0 +1,247 @@
+"""MobileNetV3 (reference: timm/models/mobilenetv3.py:1-1526), TPU-native NHWC.
+
+Reuses the EfficientNet arch-string builder; differs in the efficient head
+(pool → 1x1 conv → act → classifier) and hard-swish/hard-sigmoid gates.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNormAct2d, SelectAdaptivePool2d, SqueezeExcite, create_conv2d, get_act_fn
+from ..layers.drop import Dropout
+from ..layers.weight_init import trunc_normal_, zeros_
+from ._builder import build_model_with_cfg
+from ._efficientnet_builder import (
+    EfficientNetBuilder, decode_arch_def, resolve_act_layer, resolve_bn_args, round_channels,
+)
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['MobileNetV3']
+
+
+class MobileNetV3(nnx.Module):
+    def __init__(
+            self,
+            block_args: List[List[Dict]],
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            stem_size: int = 16,
+            fix_stem: bool = False,
+            num_features: int = 1280,
+            head_bias: bool = True,
+            head_norm: bool = False,
+            pad_type: str = '',
+            act_layer: Union[str, Callable] = 'hard_swish',
+            norm_layer: Callable = BatchNormAct2d,
+            se_layer: Callable = None,
+            se_from_exp: bool = True,
+            round_chs_fn: Callable = round_channels,
+            drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            global_pool: str = 'avg',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        se_layer = se_layer or partial(
+            SqueezeExcite, gate_layer='hard_sigmoid', force_act_layer='relu',
+            rd_round_fn=round_channels)
+
+        if not fix_stem:
+            stem_size = round_chs_fn(stem_size)
+        self.conv_stem = create_conv2d(
+            in_chans, stem_size, 3, stride=2, padding=pad_type or 'same',
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn1 = norm_layer(stem_size, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        builder = EfficientNetBuilder(
+            output_stride=32,
+            pad_type=pad_type,
+            round_chs_fn=round_chs_fn,
+            se_from_exp=se_from_exp,
+            act_layer=act_layer,
+            norm_layer=norm_layer,
+            se_layer=se_layer,
+            drop_path_rate=drop_path_rate,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            rngs=rngs,
+        )
+        self.blocks = nnx.List(builder(stem_size, block_args))
+        self.feature_info = builder.features
+        head_chs = builder.in_chs
+
+        # efficient head: pool first, then 1x1 conv expansion on (B,1,1,C)
+        self.num_features = head_chs
+        self.head_hidden_size = num_features
+        self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=False)
+        self.conv_head = create_conv2d(
+            head_chs, num_features, 1, bias=head_bias, padding=pad_type or 'same',
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm_head = norm_layer(num_features, act_layer=act_layer, dtype=dtype,
+                                    param_dtype=param_dtype, rngs=rngs) if head_norm else None
+        self.act2 = get_act_fn(act_layer) if not head_norm else None
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.classifier = nnx.Linear(
+            num_features, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self.grad_checkpointing = False
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^conv_stem|bn1',
+            blocks=[
+                (r'^blocks\.(\d+)' if coarse else r'^blocks\.(\d+)\.(\d+)', None),
+                (r'conv_head|norm_head', (99999,)),
+            ],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.classifier
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=False)
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.classifier = nnx.Linear(
+            self.head_hidden_size, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    def forward_features(self, x):
+        x = self.bn1(self.conv_stem(x))
+        for stage in self.blocks:
+            if self.grad_checkpointing:
+                x = checkpoint_seq(stage, x)
+            else:
+                for b in stage:
+                    x = b(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = self.global_pool(x)
+        if x.ndim == 2:
+            x = x[:, None, None, :]
+        x = self.conv_head(x)
+        if self.norm_head is not None:
+            x = self.norm_head(x)
+        if self.act2 is not None:
+            x = self.act2(x)
+        x = x.reshape(x.shape[0], -1)
+        x = self.head_drop(x)
+        if pre_logits or self.classifier is None:
+            return x
+        return self.classifier(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        x = self.bn1(self.conv_stem(x))
+        intermediates = []
+        stages = self.blocks if not stop_early else list(self.blocks)[:max_index + 1]
+        for i, stage in enumerate(stages):
+            for b in stage:
+                x = b(x)
+            if i in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def _gen_mobilenet_v3(variant: str, channel_multiplier: float = 1.0, pretrained: bool = False, **kwargs):
+    if 'small' in variant:
+        num_features = 1024
+        arch_def = [
+            ['ds_r1_k3_s2_e1_c16_se0.25_nre'],
+            ['ir_r1_k3_s2_e4.5_c24_nre', 'ir_r1_k3_s1_e3.67_c24_nre'],
+            ['ir_r1_k5_s2_e4_c40_se0.25', 'ir_r2_k5_s1_e6_c40_se0.25'],
+            ['ir_r2_k5_s1_e3_c48_se0.25'],
+            ['ir_r3_k5_s2_e6_c96_se0.25'],
+            ['cn_r1_k1_s1_c576'],
+        ]
+    else:
+        num_features = 1280
+        arch_def = [
+            ['ds_r1_k3_s1_e1_c16_nre'],
+            ['ir_r1_k3_s2_e4_c24_nre', 'ir_r1_k3_s1_e3_c24_nre'],
+            ['ir_r3_k5_s2_e3_c40_se0.25_nre'],
+            ['ir_r1_k3_s2_e6_c80', 'ir_r1_k3_s1_e2.5_c80', 'ir_r2_k3_s1_e2.3_c80'],
+            ['ir_r2_k3_s1_e6_c112_se0.25'],
+            ['ir_r3_k5_s2_e6_c160_se0.25'],
+            ['cn_r1_k1_s1_c960'],
+        ]
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier)
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        num_features=num_features,
+        stem_size=16,
+        fix_stem=channel_multiplier < 0.75,
+        round_chs_fn=round_chs_fn,
+        norm_layer=partial(BatchNormAct2d, **resolve_bn_args(kwargs)),
+        act_layer=resolve_act_layer(kwargs, 'hard_swish'),
+        **kwargs,
+    )
+    from ._torch_convert import convert_torch_state_dict
+    return build_model_with_cfg(
+        MobileNetV3, variant, pretrained,
+        pretrained_filter_fn=convert_torch_state_dict,
+        feature_cfg=dict(out_indices=tuple(range(len(arch_def)))),
+        **model_kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url, 'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'conv_stem', 'classifier': 'classifier',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'mobilenetv3_large_100.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'mobilenetv3_small_100.lamb_in1k': _cfg(hf_hub_id='timm/'),
+})
+
+
+@register_model
+def mobilenetv3_large_100(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_mobilenet_v3('mobilenetv3_large_100', 1.0, pretrained, **kwargs)
+
+
+@register_model
+def mobilenetv3_small_100(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_mobilenet_v3('mobilenetv3_small_100', 1.0, pretrained, **kwargs)

@@ -67,7 +67,7 @@ def main():
             payload = _extract_json(proc.stdout.strip())
             results.append({'model': name, 'ok': ok, 'seconds': round(time.time() - t0, 1),
                             'result': payload,
-                            'error': proc.stderr.strip().splitlines()[-1] if (not ok and proc.stderr) else None})
+                            'error': proc.stderr.strip().splitlines()[-1] if (not ok and proc.stderr.strip()) else None})
         except subprocess.TimeoutExpired:
             results.append({'model': name, 'ok': False, 'seconds': args.timeout, 'error': 'timeout'})
         print(f'[{i + 1}/{len(model_names)}] {name}: {"OK" if results[-1]["ok"] else "FAIL"}')

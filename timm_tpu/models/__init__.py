@@ -17,6 +17,7 @@ from .convnext import ConvNeXt
 from .deit import VisionTransformerDistilled
 from .densenet import DenseNet
 from .efficientnet import EfficientNet
+from .eva import Eva
 from .mlp_mixer import MlpMixer
 from .mobilenetv3 import MobileNetV3
 from .naflexvit import NaFlexVit

@@ -1,0 +1,390 @@
+"""EVA / EVA02 (reference: timm/models/eva.py:1-3096), TPU-native.
+
+ViT with rotary position embeddings (shared per-model ROPE table, applied to
+non-prefix tokens), optional SwiGLU MLP with inner norm, and pre/post-norm
+block options. Covers the eva02 family (the reference zoo's top-1 leader).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    AttentionRope, Dropout, DropPath, GluMlp, LayerNorm, LayerScale, Mlp,
+    PatchEmbed, RotaryEmbeddingCat, SwiGLU, calculate_drop_path_rates,
+    get_norm_layer, global_pool_nlc, trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['Eva', 'EvaBlock']
+
+
+class EvaBlock(nnx.Module):
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int,
+            qkv_bias: bool = True,
+            qk_norm: bool = False,
+            mlp_ratio: float = 4.0,
+            swiglu_mlp: bool = False,
+            scale_mlp: bool = False,
+            scale_attn_inner: bool = False,
+            proj_drop: float = 0.0,
+            attn_drop: float = 0.0,
+            drop_path: float = 0.0,
+            init_values: Optional[float] = None,
+            act_layer: Union[str, Callable] = 'gelu',
+            norm_layer: Callable = LayerNorm,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.attn = AttentionRope(
+            dim,
+            num_heads=num_heads,
+            qkv_bias=qkv_bias,
+            qk_norm=qk_norm,
+            attn_drop=attn_drop,
+            proj_drop=proj_drop,
+            norm_layer=norm_layer,
+            scale_norm=scale_attn_inner,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            rngs=rngs,
+        )
+        self.ls1 = LayerScale(dim, init_values, param_dtype=param_dtype, rngs=rngs) if init_values else None
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        hidden = int(dim * mlp_ratio)
+        if swiglu_mlp:
+            if scale_mlp:
+                # norm requires the un-packed variant (reference eva.py block init)
+                self.mlp = SwiGLU(
+                    dim, hidden, norm_layer=norm_layer,
+                    drop=proj_drop, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            else:
+                # packed weights (one fc1) to match eva02 tiny/small checkpoints
+                self.mlp = GluMlp(
+                    dim, hidden * 2, act_layer='silu', gate_last=False,
+                    drop=proj_drop, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        else:
+            self.mlp = Mlp(
+                dim, hidden, act_layer=act_layer,
+                norm_layer=norm_layer if scale_mlp else None,
+                drop=proj_drop, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.ls2 = LayerScale(dim, init_values, param_dtype=param_dtype, rngs=rngs) if init_values else None
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x, rope=None, attn_mask=None):
+        y = self.attn(self.norm1(x), rope=rope, attn_mask=attn_mask)
+        if self.ls1 is not None:
+            y = self.ls1(y)
+        x = x + self.drop_path1(y)
+        y = self.mlp(self.norm2(x))
+        if self.ls2 is not None:
+            y = self.ls2(y)
+        x = x + self.drop_path2(y)
+        return x
+
+
+class Eva(nnx.Module):
+    def __init__(
+            self,
+            img_size: Union[int, Tuple[int, int]] = 224,
+            patch_size: int = 16,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            embed_dim: int = 768,
+            depth: int = 12,
+            num_heads: int = 12,
+            qkv_bias: bool = True,
+            qk_norm: bool = False,
+            mlp_ratio: float = 4.0,
+            swiglu_mlp: bool = False,
+            scale_mlp: bool = False,
+            scale_attn_inner: bool = False,
+            drop_rate: float = 0.0,
+            pos_drop_rate: float = 0.0,
+            proj_drop_rate: float = 0.0,
+            attn_drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            init_values: Optional[float] = None,
+            class_token: bool = True,
+            num_reg_tokens: int = 0,
+            use_abs_pos_emb: bool = True,
+            use_rot_pos_emb: bool = False,
+            rope_grid_offset: float = 0.0,
+            rope_grid_indexing: str = 'ij',
+            use_post_norm: bool = False,
+            norm_layer: Optional[Union[str, Callable]] = None,
+            act_layer: Union[str, Callable] = 'gelu',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        if use_post_norm:
+            raise NotImplementedError('post-norm EVA blocks are not implemented yet')
+        norm_layer = get_norm_layer(norm_layer) or LayerNorm
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.num_features = self.head_hidden_size = self.embed_dim = embed_dim
+        self.num_prefix_tokens = (1 if class_token else 0) + num_reg_tokens
+        self.num_reg_tokens = num_reg_tokens
+        self.grad_checkpointing = False
+
+        self.patch_embed = PatchEmbed(
+            img_size=img_size, patch_size=patch_size, in_chans=in_chans, embed_dim=embed_dim,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        num_patches = self.patch_embed.num_patches
+
+        self.cls_token = nnx.Param(jnp.zeros((1, 1, embed_dim), param_dtype)) if class_token else None
+        self.reg_token = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (1, num_reg_tokens, embed_dim), param_dtype)) \
+            if num_reg_tokens else None
+
+        if use_abs_pos_emb:
+            self.pos_embed = nnx.Param(trunc_normal_(std=0.02)(
+                rngs.params(), (1, num_patches + self.num_prefix_tokens, embed_dim), param_dtype))
+        else:
+            self.pos_embed = None
+        self.pos_drop = Dropout(pos_drop_rate, rngs=rngs)
+
+        if use_rot_pos_emb:
+            self.rope = RotaryEmbeddingCat(
+                embed_dim // num_heads,
+                in_pixels=False,
+                feat_shape=self.patch_embed.grid_size,
+                ref_feat_shape=None,
+                grid_offset=rope_grid_offset,
+                grid_indexing=rope_grid_indexing,
+            )
+        else:
+            self.rope = None
+
+        dpr = calculate_drop_path_rates(drop_path_rate, depth)
+        self.blocks = nnx.List([
+            EvaBlock(
+                dim=embed_dim,
+                num_heads=num_heads,
+                qkv_bias=qkv_bias,
+                qk_norm=qk_norm,
+                mlp_ratio=mlp_ratio,
+                swiglu_mlp=swiglu_mlp,
+                scale_mlp=scale_mlp,
+                scale_attn_inner=scale_attn_inner,
+                proj_drop=proj_drop_rate,
+                attn_drop=attn_drop_rate,
+                drop_path=dpr[i],
+                init_values=init_values,
+                act_layer=act_layer,
+                norm_layer=norm_layer,
+                dtype=dtype,
+                param_dtype=param_dtype,
+                rngs=rngs,
+            )
+            for i in range(depth)
+        ])
+        reduction = self.patch_embed.patch_size[0]
+        self.feature_info = [
+            dict(module=f'blocks.{i}', num_chs=embed_dim, reduction=reduction) for i in range(depth)]
+
+        use_fc_norm = global_pool == 'avg'
+        self.norm = norm_layer(embed_dim, rngs=rngs) if not use_fc_norm else None
+        self.fc_norm = norm_layer(embed_dim, rngs=rngs) if use_fc_norm else None
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.head = nnx.Linear(
+            embed_dim, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'pos_embed', 'cls_token', 'reg_token'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^cls_token|pos_embed|patch_embed|reg_token',
+            blocks=[(r'^blocks\.(\d+)', None), (r'^norm|^fc_norm', (99999,))],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = nnx.Linear(
+            self.embed_dim, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def _pos_embed(self, x):
+        B = x.shape[0]
+        to_cat = []
+        if self.cls_token is not None:
+            to_cat.append(jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (B, 1, x.shape[-1])))
+        if self.reg_token is not None:
+            to_cat.append(jnp.broadcast_to(self.reg_token[...].astype(x.dtype), (B, self.num_reg_tokens, x.shape[-1])))
+        if to_cat:
+            x = jnp.concatenate(to_cat + [x], axis=1)
+        if self.pos_embed is not None:
+            x = x + self.pos_embed[...].astype(x.dtype)
+        return self.pos_drop(x)
+
+    def forward_features(self, x, attn_mask=None):
+        x = self.patch_embed(x)
+        x = self._pos_embed(x)
+        rope = self.rope.get_embed() if self.rope is not None else None
+        if self.grad_checkpointing:
+            def run_block(blk, x_, rope_, mask_):
+                return blk(x_, rope=rope_, attn_mask=mask_)
+            remat_block = nnx.remat(run_block)
+            for blk in self.blocks:
+                x = remat_block(blk, x, rope, attn_mask)
+        else:
+            for blk in self.blocks:
+                x = blk(x, rope=rope, attn_mask=attn_mask)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = global_pool_nlc(x, pool_type=self.global_pool, num_prefix_tokens=self.num_prefix_tokens)
+        if self.fc_norm is not None:
+            x = self.fc_norm(x)
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, return_prefix_tokens: bool = False, norm: bool = False,
+            stop_early: bool = False, output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt in ('NHWC', 'NLC')
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        B, H, W, _ = x.shape
+        grid = self.patch_embed.grid_size
+        x = self.patch_embed(x)
+        x = self._pos_embed(x)
+        rope = self.rope.get_embed() if self.rope is not None else None
+        intermediates = []
+        blocks = self.blocks if not stop_early else list(self.blocks)[:max_index + 1]
+        for i, blk in enumerate(blocks):
+            x = blk(x, rope=rope)
+            if i in take_indices:
+                y = self.norm(x) if (norm and self.norm is not None) else x
+                prefix = y[:, :self.num_prefix_tokens] if self.num_prefix_tokens else None
+                y = y[:, self.num_prefix_tokens:]
+                if output_fmt == 'NHWC':
+                    y = y.reshape(B, grid[0], grid[1], -1)
+                intermediates.append((y, prefix) if return_prefix_tokens and prefix is not None else y)
+        if intermediates_only:
+            return intermediates
+        if self.norm is not None:
+            x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.fc_norm = None
+            self.reset_classifier(0)
+        return take_indices
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url, 'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': None,
+        'crop_pct': 0.9, 'interpolation': 'bicubic', 'fixed_input_size': True,
+        'mean': (0.48145466, 0.4578275, 0.40821073), 'std': (0.26862954, 0.26130258, 0.27577711),
+        'first_conv': 'patch_embed.proj', 'classifier': 'head',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'eva02_tiny_patch14_336.mim_in22k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 336, 336), crop_pct=1.0),
+    'eva02_small_patch14_336.mim_in22k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 336, 336), crop_pct=1.0),
+    'eva02_base_patch14_448.mim_in22k_ft_in22k_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 448, 448), crop_pct=1.0),
+    'eva02_large_patch14_448.mim_m38m_ft_in22k_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 448, 448), crop_pct=1.0),
+    'test_eva.untrained': _cfg(input_size=(3, 160, 160)),
+})
+
+
+def _create_eva(variant: str, pretrained: bool = False, **kwargs) -> Eva:
+    from ._torch_convert import convert_torch_state_dict
+    out_indices = kwargs.pop('out_indices', 3)
+    return build_model_with_cfg(
+        Eva, variant, pretrained,
+        pretrained_filter_fn=convert_torch_state_dict,
+        feature_cfg=dict(out_indices=out_indices),
+        **kwargs,
+    )
+
+
+@register_model
+def eva02_tiny_patch14_336(pretrained=False, **kwargs) -> Eva:
+    model_args = dict(
+        img_size=336, patch_size=14, embed_dim=192, depth=12, num_heads=3,
+        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, use_rot_pos_emb=True)
+    return _create_eva('eva02_tiny_patch14_336', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def eva02_small_patch14_336(pretrained=False, **kwargs) -> Eva:
+    model_args = dict(
+        img_size=336, patch_size=14, embed_dim=384, depth=12, num_heads=6,
+        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, use_rot_pos_emb=True)
+    return _create_eva('eva02_small_patch14_336', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def eva02_base_patch14_448(pretrained=False, **kwargs) -> Eva:
+    model_args = dict(
+        img_size=448, patch_size=14, embed_dim=768, depth=12, num_heads=12,
+        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, scale_mlp=True, use_rot_pos_emb=True)
+    return _create_eva('eva02_base_patch14_448', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def eva02_large_patch14_448(pretrained=False, **kwargs) -> Eva:
+    model_args = dict(
+        img_size=448, patch_size=14, embed_dim=1024, depth=24, num_heads=16,
+        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, scale_mlp=True, use_rot_pos_emb=True)
+    return _create_eva('eva02_large_patch14_448', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_eva(pretrained=False, **kwargs) -> Eva:
+    model_args = dict(
+        img_size=160, patch_size=16, embed_dim=64, depth=2, num_heads=2,
+        mlp_ratio=8 / 3, swiglu_mlp=True, scale_mlp=True, use_rot_pos_emb=True, init_values=1e-5)
+    return _create_eva('test_eva', pretrained, **dict(model_args, **kwargs))

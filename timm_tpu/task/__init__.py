@@ -1,4 +1,4 @@
-from .classification import ClassificationTask
+from .classification import ClassificationTask, NaFlexClassificationTask
 from .distillation import FeatureDistillationTask, LogitDistillationTask
 from .token_distillation import TokenDistillationTask
 from .task import TrainingTask

@@ -28,3 +28,24 @@ class ClassificationTask(TrainingTask):
         output = model(batch['input'])
         loss = self.train_loss_fn(output, batch['target'])
         return loss, output
+
+
+class NaFlexClassificationTask(ClassificationTask):
+    """Classification over NaFlex dict batches ({patches, patch_coord,
+    patch_valid, target}); each seq-len bucket traces once."""
+
+    def loss_forward(self, model: nnx.Module, batch: Dict[str, Any]):
+        output = model({
+            'patches': batch['patches'],
+            'patch_coord': batch['patch_coord'],
+            'patch_valid': batch['patch_valid'],
+        })
+        loss = self.train_loss_fn(output, batch['target'])
+        return loss, output
+
+    def eval_forward(self, model: nnx.Module, batch: Dict[str, Any]):
+        return model({
+            'patches': batch['patches'],
+            'patch_coord': batch['patch_coord'],
+            'patch_valid': batch['patch_valid'],
+        })

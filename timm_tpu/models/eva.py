@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from flax import nnx
 
 from ..layers import (
-    AttentionRope, Dropout, DropPath, GluMlp, LayerNorm, LayerScale, Mlp,
+    Dropout, DropPath, GluMlp, LayerNorm, LayerScale, Mlp,
     PatchEmbed, RotaryEmbeddingCat, SwiGLU, calculate_drop_path_rates,
     get_norm_layer, global_pool_nlc, trunc_normal_, zeros_,
 )
@@ -22,7 +22,92 @@ from ._features import feature_take_indices
 from ._manipulate import checkpoint_seq
 from ._registry import generate_default_cfgs, register_model
 
-__all__ = ['Eva', 'EvaBlock']
+__all__ = ['Eva', 'EvaBlock', 'EvaAttention']
+
+
+class EvaAttention(nnx.Module):
+    """ROPE attention with optional unfused q/k/v projections — eva02
+    base/large checkpoints store separate q/k/v with no k bias
+    (reference eva.py EvaAttention)."""
+
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int = 8,
+            qkv_bias: bool = True,
+            qkv_fused: bool = True,
+            qk_norm: bool = False,
+            attn_drop: float = 0.0,
+            proj_drop: float = 0.0,
+            norm_layer: Optional[Callable] = None,
+            scale_norm: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        from functools import partial as _partial
+        from ..layers.attention import scaled_dot_product_attention, apply_rot_embed_cat
+        from ..layers.drop import Dropout as _Dropout, dropout_rng_key as _drk
+        assert dim % num_heads == 0
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.scale = self.head_dim ** -0.5
+        self.attn_drop_rate = attn_drop
+        self.qkv_fused = qkv_fused
+        self._sdpa = scaled_dot_product_attention
+        self._rot = apply_rot_embed_cat
+        self._drk = _drk
+
+        linear = _partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        if qkv_fused:
+            self.qkv = linear(dim, dim * 3, use_bias=qkv_bias)
+            self.q_proj = self.k_proj = self.v_proj = None
+        else:
+            self.qkv = None
+            self.q_proj = linear(dim, dim, use_bias=qkv_bias)
+            self.k_proj = linear(dim, dim, use_bias=False)
+            self.v_proj = linear(dim, dim, use_bias=qkv_bias)
+        self.q_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.k_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.attn_drop = _Dropout(attn_drop, rngs=rngs)
+        self.norm = norm_layer(dim, rngs=rngs) if scale_norm else None
+        self.proj = linear(dim, dim)
+        self.proj_drop = _Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x, rope=None, attn_mask=None):
+        B, N, C = x.shape
+        if self.qkv_fused:
+            qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, self.head_dim).transpose(2, 0, 3, 1, 4)
+            q, k, v = qkv[0], qkv[1], qkv[2]
+        else:
+            q = self.q_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+            k = self.k_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+            v = self.v_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+        if self.k_norm is not None:
+            k = self.k_norm(k)
+        if rope is not None:
+            num_prefix = N - rope.shape[-2]
+            if num_prefix > 0:
+                q = jnp.concatenate([q[..., :num_prefix, :], self._rot(q[..., num_prefix:, :], rope)], axis=-2)
+                k = jnp.concatenate([k[..., :num_prefix, :], self._rot(k[..., num_prefix:, :], rope)], axis=-2)
+            else:
+                q, k = self._rot(q, rope), self._rot(k, rope)
+            q = q.astype(v.dtype)
+            k = k.astype(v.dtype)
+        dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop_rate
+        dropout_key = self._drk(self.attn_drop) if dropout_p > 0.0 else None
+        x = self._sdpa(q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
+                       dropout_key=dropout_key, scale=self.scale)
+        x = x.transpose(0, 2, 1, 3).reshape(B, N, C)
+        if self.norm is not None:
+            x = self.norm(x)
+        x = self.proj(x)
+        return self.proj_drop(x)
 
 
 class EvaBlock(nnx.Module):
@@ -31,6 +116,7 @@ class EvaBlock(nnx.Module):
             dim: int,
             num_heads: int,
             qkv_bias: bool = True,
+            qkv_fused: bool = True,
             qk_norm: bool = False,
             mlp_ratio: float = 4.0,
             swiglu_mlp: bool = False,
@@ -48,10 +134,11 @@ class EvaBlock(nnx.Module):
             rngs: nnx.Rngs,
     ):
         self.norm1 = norm_layer(dim, rngs=rngs)
-        self.attn = AttentionRope(
+        self.attn = EvaAttention(
             dim,
             num_heads=num_heads,
             qkv_bias=qkv_bias,
+            qkv_fused=qkv_fused,
             qk_norm=qk_norm,
             attn_drop=attn_drop,
             proj_drop=proj_drop,
@@ -108,6 +195,7 @@ class Eva(nnx.Module):
             depth: int = 12,
             num_heads: int = 12,
             qkv_bias: bool = True,
+            qkv_fused: bool = True,
             qk_norm: bool = False,
             mlp_ratio: float = 4.0,
             swiglu_mlp: bool = False,
@@ -123,6 +211,7 @@ class Eva(nnx.Module):
             num_reg_tokens: int = 0,
             use_abs_pos_emb: bool = True,
             use_rot_pos_emb: bool = False,
+            ref_feat_shape: Optional[Tuple[int, int]] = None,
             rope_grid_offset: float = 0.0,
             rope_grid_indexing: str = 'ij',
             use_post_norm: bool = False,
@@ -165,7 +254,7 @@ class Eva(nnx.Module):
                 embed_dim // num_heads,
                 in_pixels=False,
                 feat_shape=self.patch_embed.grid_size,
-                ref_feat_shape=None,
+                ref_feat_shape=ref_feat_shape,
                 grid_offset=rope_grid_offset,
                 grid_indexing=rope_grid_indexing,
             )
@@ -178,6 +267,7 @@ class Eva(nnx.Module):
                 dim=embed_dim,
                 num_heads=num_heads,
                 qkv_bias=qkv_bias,
+                qkv_fused=qkv_fused,
                 qk_norm=qk_norm,
                 mlp_ratio=mlp_ratio,
                 swiglu_mlp=swiglu_mlp,
@@ -354,7 +444,7 @@ def _create_eva(variant: str, pretrained: bool = False, **kwargs) -> Eva:
 def eva02_tiny_patch14_336(pretrained=False, **kwargs) -> Eva:
     model_args = dict(
         img_size=336, patch_size=14, embed_dim=192, depth=12, num_heads=3,
-        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, use_rot_pos_emb=True)
+        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, use_rot_pos_emb=True, ref_feat_shape=(16, 16))
     return _create_eva('eva02_tiny_patch14_336', pretrained, **dict(model_args, **kwargs))
 
 
@@ -362,7 +452,7 @@ def eva02_tiny_patch14_336(pretrained=False, **kwargs) -> Eva:
 def eva02_small_patch14_336(pretrained=False, **kwargs) -> Eva:
     model_args = dict(
         img_size=336, patch_size=14, embed_dim=384, depth=12, num_heads=6,
-        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, use_rot_pos_emb=True)
+        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, use_rot_pos_emb=True, ref_feat_shape=(16, 16))
     return _create_eva('eva02_small_patch14_336', pretrained, **dict(model_args, **kwargs))
 
 
@@ -370,7 +460,8 @@ def eva02_small_patch14_336(pretrained=False, **kwargs) -> Eva:
 def eva02_base_patch14_448(pretrained=False, **kwargs) -> Eva:
     model_args = dict(
         img_size=448, patch_size=14, embed_dim=768, depth=12, num_heads=12,
-        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, scale_mlp=True, use_rot_pos_emb=True)
+        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, scale_mlp=True, use_rot_pos_emb=True,
+        qkv_fused=False, ref_feat_shape=(16, 16))
     return _create_eva('eva02_base_patch14_448', pretrained, **dict(model_args, **kwargs))
 
 
@@ -378,7 +469,8 @@ def eva02_base_patch14_448(pretrained=False, **kwargs) -> Eva:
 def eva02_large_patch14_448(pretrained=False, **kwargs) -> Eva:
     model_args = dict(
         img_size=448, patch_size=14, embed_dim=1024, depth=24, num_heads=16,
-        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, scale_mlp=True, use_rot_pos_emb=True)
+        mlp_ratio=4 * 2 / 3, swiglu_mlp=True, scale_mlp=True, use_rot_pos_emb=True,
+        qkv_fused=False, ref_feat_shape=(16, 16))
     return _create_eva('eva02_large_patch14_448', pretrained, **dict(model_args, **kwargs))
 
 

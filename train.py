@@ -142,6 +142,11 @@ def make_parser():
     group.add_argument('--log-wandb', action='store_true', default=False)
     group.add_argument('--synthetic-len', type=int, default=1024,
                        help='samples per epoch for --synthetic-data')
+    # NaFlex variable-resolution training (reference train.py --naflex-loader)
+    group = parser.add_argument_group('NaFlex parameters')
+    group.add_argument('--naflex-loader', action='store_true', help='token-budget variable-res training')
+    group.add_argument('--naflex-train-seq-lens', type=int, nargs='+', default=[128, 256, 576, 784, 1024])
+    group.add_argument('--naflex-max-seq-len', type=int, default=576)
     return parser
 
 
@@ -256,7 +261,19 @@ def main():
     optimizer = create_optimizer_v2(model, **optimizer_kwargs(args))
     norm_mean = data_config['mean']
     norm_std = data_config['std']
-    task = ClassificationTask(
+    if args.naflex_loader:
+        if args.grad_accum_steps > 1:
+            raise ValueError('--naflex-loader does not support --grad-accum-steps > 1 '
+                             '(token-budget batch sizes are not accumulation-divisible)')
+        if args.mixup > 0 or args.cutmix > 0:
+            raise NotImplementedError('--naflex-loader does not support mixup/cutmix yet')
+        from timm_tpu.task import NaFlexClassificationTask
+        task_cls = NaFlexClassificationTask
+        # NaFlex batches are normalized host-side by the loader
+        norm_mean = norm_std = None
+    else:
+        task_cls = ClassificationTask
+    task = task_cls(
         model,
         optimizer=optimizer,
         mesh=mesh,
@@ -287,7 +304,32 @@ def main():
         task.setup_ema(decay=args.model_ema_decay, warmup=args.model_ema_warmup)
 
     # data
-    if args.synthetic_data or not args.data_dir:
+    if args.naflex_loader:
+        if not args.data_dir:
+            raise ValueError('--naflex-loader requires --data-dir')
+        from timm_tpu.data import create_dataset
+        from timm_tpu.data.naflex_loader import create_naflex_loader
+        patch_size = getattr(model.embeds, 'patch_size', 16) if hasattr(model, 'embeds') else 16
+        dataset_train = create_dataset(
+            args.dataset, root=args.data_dir, split=args.train_split, is_training=True,
+            class_map=args.class_map)
+        dataset_eval = create_dataset(
+            args.dataset, root=args.data_dir, split=args.val_split, class_map=args.class_map)
+        loader_train = create_naflex_loader(
+            dataset_train, patch_size=patch_size,
+            train_seq_lens=tuple(args.naflex_train_seq_lens),
+            max_seq_len=args.naflex_max_seq_len,
+            batch_size=args.batch_size, is_training=True,
+            mean=data_config['mean'], std=data_config['std'],
+            interpolation=data_config['interpolation'], hflip=args.hflip, seed=args.seed)
+        loader_eval = create_naflex_loader(
+            dataset_eval, patch_size=patch_size,
+            max_seq_len=args.naflex_max_seq_len,
+            batch_size=args.validation_batch_size or args.batch_size,
+            mean=data_config['mean'], std=data_config['std'],
+            interpolation=data_config['interpolation'], seed=args.seed)
+        mixup_fn = None
+    elif args.synthetic_data or not args.data_dir:
         _logger.info('Using synthetic data')
         loader_train = SyntheticLoader(args.synthetic_len, args.batch_size, img_size, args.num_classes, args.seed)
         loader_eval = SyntheticLoader(max(args.synthetic_len // 4, args.batch_size),
@@ -387,6 +429,8 @@ def main():
     best_epoch = None
     eval_metrics = {}
     for epoch in range(start_epoch, num_epochs):
+        if hasattr(loader_train, 'set_epoch'):
+            loader_train.set_epoch(epoch)  # fresh shuffle/schedule (ref train.py:478)
         train_metrics = train_one_epoch(
             epoch, task, loader_train, args, lr_scheduler, mesh, shard_batch,
             updates_per_epoch, saver=saver, mixup_fn=mixup_fn)
@@ -426,7 +470,31 @@ def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
     update_idx = 0
     samples_since_log = 0
     log_t0 = time.time()
-    for batch_idx, (input_np, target_np) in enumerate(loader):
+    for batch_idx, batch_data in enumerate(loader):
+        if isinstance(batch_data, dict):
+            # NaFlex dict batch: one update per batch, no accumulation/mixup
+            n = batch_data['patches'].shape[0]
+            batch = shard_batch(
+                {k: jnp.asarray(v) for k, v in batch_data.items() if k != 'seq_len'}, mesh)
+            metrics = task.train_step(batch, lr=lr, step=num_updates)
+            num_updates += 1
+            samples_since_log += n
+            if lr_scheduler is not None:
+                lr = lr_scheduler.step_update(num_updates)[0]
+            if update_idx % args.log_interval == 0:
+                loss_m.update(float(metrics['loss']), n=n)
+                elapsed = time.time() - log_t0
+                _logger.info(
+                    f'Train: {epoch} [{update_idx:>4d}/{updates_per_epoch}] '
+                    f'Loss: {loss_m.val:#.3g} ({loss_m.avg:#.3g}) LR: {lr:.3e} '
+                    f'seq: {batch_data["seq_len"]} {samples_since_log / max(elapsed, 1e-9):.1f} img/s')
+                samples_since_log = 0
+                log_t0 = time.time()
+            if saver is not None and args.recovery_interval and (update_idx + 1) % args.recovery_interval == 0:
+                saver.save_recovery(epoch, update_idx)
+            update_idx += 1
+            continue
+        input_np, target_np = batch_data
         if mixup_fn is not None:
             input_np, target_np = mixup_fn(input_np, target_np)
         micro_inputs.append(input_np)
@@ -484,10 +552,17 @@ def validate(task, loader, args, mesh, shard_batch, use_ema=False):
     loss_m = AverageMeter()
     top1_m = AverageMeter()
     top5_m = AverageMeter()
-    for input_np, target_np in loader:
-        batch = shard_batch({'input': jnp.asarray(input_np), 'target': jnp.asarray(target_np)}, mesh)
-        output = task.eval_step({'input': batch['input']}, use_ema=use_ema)
-        target = batch['target']
+    for batch_data in loader:
+        if isinstance(batch_data, dict):
+            batch = shard_batch(
+                {k: jnp.asarray(v) for k, v in batch_data.items() if k != 'seq_len'}, mesh)
+            output = task.eval_step({k: batch[k] for k in batch if k != 'target'}, use_ema=use_ema)
+            target = batch['target']
+        else:
+            input_np, target_np = batch_data
+            batch = shard_batch({'input': jnp.asarray(input_np), 'target': jnp.asarray(target_np)}, mesh)
+            output = task.eval_step({'input': batch['input']}, use_ema=use_ema)
+            target = batch['target']
         logprobs = jax.nn.log_softmax(output.astype(jnp.float32), axis=-1)
         loss = -jnp.take_along_axis(logprobs, target[:, None], axis=-1).mean()
         top_pred = jnp.argsort(output, axis=-1)[:, -5:]

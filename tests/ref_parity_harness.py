@@ -1,0 +1,130 @@
+"""Torch-reference numerical-parity harness.
+
+Stubs torchvision (absent in this image) well enough to import the reference
+timm from /root/reference as a TEST ORACLE, builds randomly-initialized torch
+models, converts their state dicts with timm_tpu's torch converter, and
+compares logits. Not run in the default suite (imports the reference repo);
+invoke directly: `python tests/ref_parity_harness.py [model ...]`.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+
+def install_torchvision_stub():
+    import torch
+
+    def make_mod(name, pkg=False):
+        m = types.ModuleType(name)
+        if pkg:
+            m.__path__ = []
+        sys.modules[name] = m
+        return m
+
+    class _Any:
+        def __init__(self, *a, **k):
+            pass
+
+        def __getattr__(self, item):
+            return _Any()
+
+    class InterpolationMode:
+        NEAREST = 'nearest'
+        BILINEAR = 'bilinear'
+        BICUBIC = 'bicubic'
+        LANCZOS = 'lanczos'
+        BOX = 'box'
+        HAMMING = 'hamming'
+
+    tv = make_mod('torchvision', pkg=True)
+    ops = make_mod('torchvision.ops', pkg=True)
+    misc = make_mod('torchvision.ops.misc')
+
+    class FrozenBatchNorm2d(torch.nn.Module):
+        def __init__(self, num_features, eps=1e-5):
+            super().__init__()
+
+    misc.FrozenBatchNorm2d = FrozenBatchNorm2d
+    ops.misc = misc
+    tv.ops = ops
+
+    tfm = make_mod('torchvision.transforms', pkg=True)
+    tfmf = make_mod('torchvision.transforms.functional')
+    tfmf.InterpolationMode = InterpolationMode
+    for n in ('resize', 'crop', 'center_crop', 'hflip', 'vflip', 'pad', 'to_tensor',
+              'normalize', 'resized_crop', 'get_image_size'):
+        setattr(tfmf, n, _Any())
+    tfm.functional = tfmf
+    tfm.InterpolationMode = InterpolationMode
+    for n in ('Compose', 'ToTensor', 'Normalize', 'Resize', 'CenterCrop', 'RandomResizedCrop',
+              'RandomHorizontalFlip', 'RandomVerticalFlip', 'ColorJitter', 'Grayscale',
+              'RandomApply', 'RandomChoice', 'RandomGrayscale', 'GaussianBlur', 'PILToTensor',
+              'RandomCrop', 'Lambda'):
+        setattr(tfm, n, _Any)
+    tv.transforms = tfm
+
+    ds = make_mod('torchvision.datasets')
+    for n in ('CIFAR100', 'CIFAR10', 'MNIST', 'KMNIST', 'FashionMNIST', 'ImageFolder',
+              'QMNIST', 'ImageNet', 'Places365'):
+        setattr(ds, n, _Any)
+    tv.datasets = ds
+
+
+def compare(model_name: str, img_size: int = 224, tol: float = 2e-3) -> float:
+    import numpy as np
+    import torch
+    import jax.numpy as jnp
+    import timm as ref_timm  # /root/reference on sys.path
+    import timm_tpu
+    from timm_tpu.models import load_state_dict_into_model
+    from timm_tpu.models._torch_convert import convert_torch_state_dict
+
+    tm = ref_timm.create_model(model_name, num_classes=10)
+    tm.eval()
+    sd = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+
+    m = timm_tpu.create_model(model_name, num_classes=10)
+    m.eval()
+    # use the family's checkpoint filter when it exists
+    import importlib
+    from timm_tpu.models._registry import _model_to_module, get_arch_name
+    mod_name = _model_to_module.get(get_arch_name(model_name))
+    filter_fn = convert_torch_state_dict
+    if mod_name:
+        mod = importlib.import_module(f'timm_tpu.models.{mod_name}')
+        filter_fn = getattr(mod, 'checkpoint_filter_fn', convert_torch_state_dict)
+    conv = filter_fn(sd, m)
+    load_state_dict_into_model(m, conv, strict=True)
+
+    x = np.random.RandomState(0).rand(2, 3, img_size, img_size).astype(np.float32)
+    with torch.no_grad():
+        ref_out = tm(torch.from_numpy(x)).numpy()
+    our_out = np.asarray(m(jnp.asarray(x.transpose(0, 2, 3, 1))))
+    return float(np.abs(ref_out - our_out).max())
+
+
+def main(models, tol: float = 2e-3):
+    import os
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    install_torchvision_stub()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+    sys.path.insert(0, '/root/reference')
+    results = {}
+    for name in models:
+        try:
+            d = compare(name, tol=tol)
+            results[name] = d
+            print(f'{name}: max|Δlogits| = {d:.2e}  {"PARITY OK" if d < tol else "MISMATCH"}')
+        except Exception as e:
+            results[name] = None
+            print(f'{name}: ERROR {str(e)[:200]}')
+    ok = all(d is not None and d < tol for d in results.values())
+    return results, ok
+
+
+if __name__ == '__main__':
+    names = sys.argv[1:] or ['vit_tiny_patch16_224', 'resnet18', 'convnext_atto']
+    _, ok = main(names)
+    sys.exit(0 if ok else 1)

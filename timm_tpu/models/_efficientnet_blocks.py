@@ -43,7 +43,7 @@ class ConvBnAct(nnx.Module):
         self.has_skip = skip and stride == 1 and in_chs == out_chs
         self.conv = create_conv2d(
             in_chs, out_chs, kernel_size, stride=stride, dilation=dilation, groups=groups,
-            padding=pad_type or 'same', dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            padding=pad_type or None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(out_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.drop_path = DropPath(drop_path_rate, rngs=rngs)
 
@@ -87,12 +87,12 @@ class DepthwiseSeparableConv(nnx.Module):
 
         self.conv_dw = create_conv2d(
             in_chs, in_chs, dw_kernel_size, stride=stride, dilation=dilation,
-            depthwise=True, padding=pad_type or 'same', dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            depthwise=True, padding=pad_type or None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(in_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.se = se_layer(in_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
             if se_layer else None
         self.conv_pw = create_conv2d(
-            in_chs, out_chs, pw_kernel_size, padding=pad_type or 'same',
+            in_chs, out_chs, pw_kernel_size, padding=pad_type or None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn2 = norm_layer(
             out_chs, apply_act=self.has_pw_act, act_layer=act_layer,
@@ -142,17 +142,17 @@ class InvertedResidual(nnx.Module):
         self.has_skip = (in_chs == out_chs and stride == 1) and not noskip
 
         self.conv_pw = create_conv2d(
-            in_chs, mid_chs, exp_kernel_size, padding=pad_type or 'same',
+            in_chs, mid_chs, exp_kernel_size, padding=pad_type or None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.conv_dw = create_conv2d(
             mid_chs, mid_chs, dw_kernel_size, stride=stride, dilation=dilation,
-            depthwise=True, padding=pad_type or 'same', dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            depthwise=True, padding=pad_type or None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn2 = norm_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.se = se_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
             if se_layer else None
         self.conv_pwl = create_conv2d(
-            mid_chs, out_chs, pw_kernel_size, padding=pad_type or 'same',
+            mid_chs, out_chs, pw_kernel_size, padding=pad_type or None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn3 = norm_layer(out_chs, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.drop_path = DropPath(drop_path_rate, rngs=rngs)
@@ -205,12 +205,12 @@ class EdgeResidual(nnx.Module):
 
         self.conv_exp = create_conv2d(
             in_chs, mid_chs, exp_kernel_size, stride=stride, dilation=dilation,
-            padding=pad_type or 'same', dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            padding=pad_type or None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.se = se_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
             if se_layer else None
         self.conv_pwl = create_conv2d(
-            mid_chs, out_chs, pw_kernel_size, padding=pad_type or 'same',
+            mid_chs, out_chs, pw_kernel_size, padding=pad_type or None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn2 = norm_layer(out_chs, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.drop_path = DropPath(drop_path_rate, rngs=rngs)

@@ -229,7 +229,13 @@ class EfficientNetBuilder:
         if se_ratio > 0.0 and self.se_layer is not None:
             if not self.se_from_exp:
                 se_ratio /= ba.get('exp_ratio', 1.0)
-            se_layer = partial(self.se_layer, rd_ratio=se_ratio)
+            bound = getattr(self.se_layer, 'keywords', {}) or {}
+            if 'rd_round_fn' in bound:
+                se_layer = partial(self.se_layer, rd_ratio=se_ratio)
+            else:
+                # EfficientNet-family SE uses plain rounding (reference
+                # _efficientnet_blocks.py: rd_round_fn or round)
+                se_layer = partial(self.se_layer, rd_ratio=se_ratio, rd_round_fn=round)
         common = dict(dtype=self.dtype, param_dtype=self.param_dtype, rngs=self.rngs)
 
         if bt == 'ir':

@@ -50,10 +50,13 @@ def convert_torch_state_dict(state_dict: Dict[str, np.ndarray], model=None) -> D
                 nk, nv = base + '.kernel', v.transpose(2, 3, 1, 0)
             elif v.ndim == 2:  # linear (O,I) → (I,O)
                 nk, nv = base + '.kernel', v.T
-            elif v.ndim == 1:  # norm scale
-                nk = base + '.scale'
-                if target is not None and nk not in target and base + '.kernel' in target:
-                    nk = base + '.kernel'
+            elif v.ndim == 1:
+                if target is not None and base + '.weight' in target:
+                    nk = base + '.weight'  # e.g. GRN keeps torch naming
+                else:
+                    nk = base + '.scale'  # norm affine
+                    if target is not None and nk not in target and base + '.kernel' in target:
+                        nk = base + '.kernel'
             else:
                 nk = base + '.kernel'
         # verify/auto-correct against target shapes when available

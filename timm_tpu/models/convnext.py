@@ -210,10 +210,10 @@ class ConvNeXt(nnx.Module):
         else:
             mid_chs = dims[0] // 2 if 'tiered' in stem_type else dims[0]
             self.stem_conv = create_conv2d(
-                in_chans, mid_chs, 3, stride=2, padding='same', bias=conv_bias,
+                in_chans, mid_chs, 3, stride=2, padding=None, bias=conv_bias,
                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
             self.stem_conv2 = create_conv2d(
-                mid_chs, dims[0], 3, stride=2, padding='same', bias=conv_bias,
+                mid_chs, dims[0], 3, stride=2, padding=None, bias=conv_bias,
                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
             self.stem_norm = norm_layer(dims[0], rngs=rngs)
             stem_stride = 4
@@ -394,8 +394,29 @@ default_cfgs = generate_default_cfgs({
 
 
 def checkpoint_filter_fn(state_dict, model):
+    """Map reference-timm convnext names → this module's layout
+    (stem/downsample Sequential indices, bare `gamma` LayerScale)."""
+    import re
     from ._torch_convert import convert_torch_state_dict
-    return convert_torch_state_dict(state_dict, model)
+    import numpy as np
+    # overlap stems: stem.0/stem.1 are convs (4D), stem.2 is the norm
+    overlap_stem = any(k.startswith('stem.2.') for k in state_dict)
+    out = {}
+    for k, v in state_dict.items():
+        if overlap_stem:
+            k = re.sub(r'^stem\.0\.', 'stem_conv.', k)
+            k = re.sub(r'^stem\.1\.', 'stem_conv2.', k)
+            k = re.sub(r'^stem\.2\.', 'stem_norm.', k)
+        else:
+            k = re.sub(r'^stem\.0\.', 'stem_conv.', k)
+            k = re.sub(r'^stem\.1\.', 'stem_norm.', k)
+        k = re.sub(r'(stages\.\d+)\.downsample\.0\.', r'\1.downsample_norm.', k)
+        k = re.sub(r'(stages\.\d+)\.downsample\.1\.', r'\1.downsample_conv.', k)
+        k = re.sub(r'(blocks\.\d+)\.gamma$', r'\1.ls.gamma', k)
+        if k.endswith(('.grn.weight', '.grn.bias')):
+            v = v.reshape(-1)  # reference stores (1,1,1,C)
+        out[k] = v
+    return convert_torch_state_dict(out, model)
 
 
 def _create_convnext(variant: str, pretrained: bool = False, **kwargs) -> ConvNeXt:
@@ -412,25 +433,25 @@ def _create_convnext(variant: str, pretrained: bool = False, **kwargs) -> ConvNe
 
 @register_model
 def convnext_atto(pretrained=False, **kwargs) -> ConvNeXt:
-    model_args = dict(depths=(2, 2, 6, 2), dims=(40, 80, 160, 320), conv_bias=False)
+    model_args = dict(depths=(2, 2, 6, 2), dims=(40, 80, 160, 320), )
     return _create_convnext('convnext_atto', pretrained=pretrained, **dict(model_args, **kwargs))
 
 
 @register_model
 def convnext_femto(pretrained=False, **kwargs) -> ConvNeXt:
-    model_args = dict(depths=(2, 2, 6, 2), dims=(48, 96, 192, 384), conv_bias=False)
+    model_args = dict(depths=(2, 2, 6, 2), dims=(48, 96, 192, 384), )
     return _create_convnext('convnext_femto', pretrained=pretrained, **dict(model_args, **kwargs))
 
 
 @register_model
 def convnext_pico(pretrained=False, **kwargs) -> ConvNeXt:
-    model_args = dict(depths=(2, 2, 6, 2), dims=(64, 128, 256, 512), conv_bias=False)
+    model_args = dict(depths=(2, 2, 6, 2), dims=(64, 128, 256, 512), )
     return _create_convnext('convnext_pico', pretrained=pretrained, **dict(model_args, **kwargs))
 
 
 @register_model
 def convnext_nano(pretrained=False, **kwargs) -> ConvNeXt:
-    model_args = dict(depths=(2, 2, 8, 2), dims=(80, 160, 320, 640), conv_bias=False)
+    model_args = dict(depths=(2, 2, 8, 2), dims=(80, 160, 320, 640), )
     return _create_convnext('convnext_nano', pretrained=pretrained, **dict(model_args, **kwargs))
 
 

@@ -23,7 +23,7 @@ class DenseLayer(nnx.Module):
         self.conv1 = create_conv2d(in_chs, bn_size * growth_rate, 1,
                                    dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.norm2 = norm_layer(bn_size * growth_rate, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
-        self.conv2 = create_conv2d(bn_size * growth_rate, growth_rate, 3, padding='same',
+        self.conv2 = create_conv2d(bn_size * growth_rate, growth_rate, 3, padding=None,
                                    dtype=dtype, param_dtype=param_dtype, rngs=rngs)
 
     def __call__(self, x):
@@ -64,7 +64,7 @@ class DenseNet(nnx.Module):
         self.num_classes = num_classes
         num_init_features = growth_rate * 2
 
-        self.stem_conv = create_conv2d(in_chans, num_init_features, 7, stride=2, padding='same',
+        self.stem_conv = create_conv2d(in_chans, num_init_features, 7, stride=2, padding=None,
                                        dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.stem_norm = norm_layer(num_init_features, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.feature_info = [dict(num_chs=num_init_features, reduction=2, module='stem_norm')]
@@ -185,11 +185,31 @@ default_cfgs = generate_default_cfgs({
 })
 
 
-def _create_densenet(variant: str, pretrained: bool = False, **kwargs) -> DenseNet:
+def checkpoint_filter_fn(state_dict, model):
+    """Map reference densenet names (features.denseblockN.denselayerM...)
+    onto this module's blocks/transitions layout."""
+    import re
     from ._torch_convert import convert_torch_state_dict
+    out = {}
+    for k, v in state_dict.items():
+        k = re.sub(r'^features\.conv0\.', 'stem_conv.', k)
+        k = re.sub(r'^features\.norm0\.', 'stem_norm.', k)
+        m = re.match(r'^features\.denseblock(\d+)\.denselayer(\d+)\.(.*)$', k)
+        if m:
+            k = f'blocks.{int(m.group(1)) - 1}.{int(m.group(2)) - 1}.{m.group(3)}'
+        m = re.match(r'^features\.transition(\d+)\.(.*)$', k)
+        if m:
+            k = f'transitions.{int(m.group(1)) - 1}.{m.group(2)}'
+        k = re.sub(r'^features\.norm5\.', 'final_norm.', k)
+        k = re.sub(r'^classifier\.', 'head.fc.', k)
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_densenet(variant: str, pretrained: bool = False, **kwargs) -> DenseNet:
     return build_model_with_cfg(
         DenseNet, variant, pretrained,
-        pretrained_filter_fn=convert_torch_state_dict,
+        pretrained_filter_fn=checkpoint_filter_fn,
         feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
         **kwargs,
     )

@@ -57,7 +57,7 @@ class EfficientNet(nnx.Module):
         if not fix_stem:
             stem_size = round_chs_fn(stem_size)
         self.conv_stem = create_conv2d(
-            in_chans, stem_size, stem_kernel_size, stride=2, padding=pad_type or 'same',
+            in_chans, stem_size, stem_kernel_size, stride=2, padding=pad_type or None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(stem_size, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
 
@@ -80,7 +80,7 @@ class EfficientNet(nnx.Module):
         # head
         self.num_features = num_features
         self.conv_head = create_conv2d(
-            head_chs, num_features, 1, padding=pad_type or 'same',
+            head_chs, num_features, 1, padding=pad_type or None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn2 = norm_layer(num_features, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.head_hidden_size = num_features
@@ -256,8 +256,16 @@ def _gen_efficientnetv2_m(variant, pretrained=False, **kwargs):
 
 
 def _filter_fn(state_dict, model):
+    """Reference SE layers name their convs conv_reduce/conv_expand."""
     from ._torch_convert import convert_torch_state_dict
-    return convert_torch_state_dict(state_dict, model)
+    out = {}
+    for k, v in state_dict.items():
+        k = k.replace('.se.conv_reduce.', '.se.fc1.').replace('.se.conv_expand.', '.se.fc2.')
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+checkpoint_filter_fn = _filter_fn
 
 
 def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:

@@ -59,7 +59,7 @@ class MobileNetV3(nnx.Module):
         if not fix_stem:
             stem_size = round_chs_fn(stem_size)
         self.conv_stem = create_conv2d(
-            in_chans, stem_size, 3, stride=2, padding=pad_type or 'same',
+            in_chans, stem_size, 3, stride=2, padding=pad_type or None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(stem_size, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
 
@@ -85,7 +85,7 @@ class MobileNetV3(nnx.Module):
         self.head_hidden_size = num_features
         self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=False)
         self.conv_head = create_conv2d(
-            head_chs, num_features, 1, bias=head_bias, padding=pad_type or 'same',
+            head_chs, num_features, 1, bias=head_bias, padding=pad_type or None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.norm_head = norm_layer(num_features, act_layer=act_layer, dtype=dtype,
                                     param_dtype=param_dtype, rngs=rngs) if head_norm else None
@@ -212,10 +212,10 @@ def _gen_mobilenet_v3(variant: str, channel_multiplier: float = 1.0, pretrained:
         act_layer=resolve_act_layer(kwargs, 'hard_swish'),
         **kwargs,
     )
-    from ._torch_convert import convert_torch_state_dict
+    from .efficientnet import checkpoint_filter_fn as _eff_filter
     return build_model_with_cfg(
         MobileNetV3, variant, pretrained,
-        pretrained_filter_fn=convert_torch_state_dict,
+        pretrained_filter_fn=_eff_filter,
         feature_cfg=dict(out_indices=tuple(range(len(arch_def)))),
         **model_kwargs,
     )
@@ -245,3 +245,6 @@ def mobilenetv3_large_100(pretrained=False, **kwargs) -> MobileNetV3:
 @register_model
 def mobilenetv3_small_100(pretrained=False, **kwargs) -> MobileNetV3:
     return _gen_mobilenet_v3('mobilenetv3_small_100', 1.0, pretrained, **kwargs)
+
+
+from .efficientnet import checkpoint_filter_fn  # noqa: E402,F401

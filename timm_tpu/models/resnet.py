@@ -41,11 +41,17 @@ def avg_pool2d(x, kernel: int = 2, stride: int = 2, pad_same: bool = False):
     return out / (kernel * kernel)
 
 
-def max_pool2d(x, kernel: int = 3, stride: int = 2):
+def max_pool2d(x, kernel: int = 3, stride: int = 2, padding=None):
+    """NHWC max pool; default symmetric pad (k-1)//2 on both sides (torch
+    semantics — SAME pads right-only for even inputs and shifts windows)."""
     import jax
     neg = -jnp.inf if x.dtype == jnp.float32 else jnp.finfo(x.dtype).min
+    if padding is None:
+        p = (kernel - 1) // 2
+        padding = ((0, 0), (p, p), (p, p), (0, 0))
+    x = jnp.pad(x, padding, constant_values=neg)
     return jax.lax.reduce_window(
-        x, neg, jax.lax.max, (1, kernel, kernel, 1), (1, stride, stride, 1), 'SAME')
+        x, neg, jax.lax.max, (1, kernel, kernel, 1), (1, stride, stride, 1), 'VALID')
 
 
 class DownsampleConv(nnx.Module):
@@ -103,11 +109,11 @@ class BasicBlock(nnx.Module):
         first_dilation = first_dilation or dilation
 
         self.conv1 = create_conv2d(
-            inplanes, first_planes, 3, stride=stride, dilation=first_dilation, padding='same',
+            inplanes, first_planes, 3, stride=stride, dilation=first_dilation, padding=None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(first_planes, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.conv2 = create_conv2d(
-            first_planes, outplanes, 3, dilation=dilation, padding='same',
+            first_planes, outplanes, 3, dilation=dilation, padding=None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn2 = norm_layer(outplanes, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.se = attn_layer(outplanes, dtype=dtype, param_dtype=param_dtype, rngs=rngs) if attn_layer else None
@@ -163,7 +169,7 @@ class Bottleneck(nnx.Module):
         self.bn1 = norm_layer(first_planes, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.conv2 = create_conv2d(
             first_planes, width, 3, stride=stride, dilation=first_dilation, groups=cardinality,
-            padding='same', dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            padding=None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn2 = norm_layer(width, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.conv3 = create_conv2d(width, outplanes, 1, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn3 = norm_layer(outplanes, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
@@ -230,11 +236,11 @@ class ResNet(nnx.Module):
             if 'tiered' in stem_type:
                 stem_chs = (3 * (stem_width // 4), stem_width)
             self.conv1 = nnx.List([
-                create_conv2d(in_chans, stem_chs[0], 3, stride=2, padding='same',
+                create_conv2d(in_chans, stem_chs[0], 3, stride=2, padding=None,
                               dtype=dtype, param_dtype=param_dtype, rngs=rngs),
-                create_conv2d(stem_chs[0], stem_chs[1], 3, padding='same',
+                create_conv2d(stem_chs[0], stem_chs[1], 3, padding=None,
                               dtype=dtype, param_dtype=param_dtype, rngs=rngs),
-                create_conv2d(stem_chs[1], inplanes, 3, padding='same',
+                create_conv2d(stem_chs[1], inplanes, 3, padding=None,
                               dtype=dtype, param_dtype=param_dtype, rngs=rngs),
             ])
             self.bn_stem = nnx.List([
@@ -243,7 +249,7 @@ class ResNet(nnx.Module):
             ])
         else:
             self.conv1 = create_conv2d(
-                in_chans, inplanes, 7, stride=2, padding='same',
+                in_chans, inplanes, 7, stride=2, padding=None,
                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
             self.bn_stem = None
         self.bn1 = norm_layer(inplanes, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
@@ -418,11 +424,36 @@ default_cfgs = generate_default_cfgs({
 })
 
 
-def _create_resnet(variant: str, pretrained: bool = False, **kwargs) -> ResNet:
+def checkpoint_filter_fn(state_dict, model):
+    """Map reference-timm resnet names → this module's layout, then apply the
+    generic torch→nnx conversion (reference resnet state dicts use Sequential
+    indices for downsample and a top-level `fc` head)."""
+    import re
     from ._torch_convert import convert_torch_state_dict
+    # avg-down models use Sequential(pool, conv, bn) → indices 1/2
+    has_avg_down = any('downsample.2.' in k for k in state_dict)
+    out = {}
+    for k, v in state_dict.items():
+        k = re.sub(r'^fc\.', 'head.fc.', k)
+        if has_avg_down:
+            k = re.sub(r'(layer\d+\.\d+\.downsample)\.1\.', r'\1.conv.', k)
+            k = re.sub(r'(layer\d+\.\d+\.downsample)\.2\.', r'\1.bn.', k)
+        else:
+            k = re.sub(r'(layer\d+\.\d+\.downsample)\.0\.', r'\1.conv.', k)
+            k = re.sub(r'(layer\d+\.\d+\.downsample)\.1\.', r'\1.bn.', k)
+        # deep stem Sequential(conv,bn,act,conv,bn,act,conv) → conv1.*/bn_stem.*
+        k = re.sub(r'^conv1\.1\.', 'bn_stem.0.', k)
+        k = re.sub(r'^conv1\.3\.', 'conv1.1.', k)
+        k = re.sub(r'^conv1\.4\.', 'bn_stem.1.', k)
+        k = re.sub(r'^conv1\.6\.', 'conv1.2.', k)
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_resnet(variant: str, pretrained: bool = False, **kwargs) -> ResNet:
     return build_model_with_cfg(
         ResNet, variant, pretrained,
-        pretrained_filter_fn=convert_torch_state_dict,
+        pretrained_filter_fn=checkpoint_filter_fn,
         feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
         **kwargs,
     )

@@ -80,7 +80,7 @@ class VGG(nnx.Module):
                 net_stride *= 2
             else:
                 v = cast(int, v)
-                conv = create_conv2d(prev_chs, v, 3, padding='same', bias=not self.use_norm,
+                conv = create_conv2d(prev_chs, v, 3, padding='same', bias=True,
                                      dtype=dtype, param_dtype=param_dtype, rngs=rngs)
                 norm = norm_layer(v, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
                     if self.use_norm else None
@@ -215,15 +215,41 @@ default_cfgs = generate_default_cfgs({
 })
 
 
-def _create_vgg(variant: str, pretrained: bool = False, **kwargs) -> VGG:
+def checkpoint_filter_fn(state_dict, model):
+    """Map reference vgg Sequential feature indices → convs/norms lists
+    (conv order == appearance order of 4D weights)."""
+    import re
     from ._torch_convert import convert_torch_state_dict
+    import numpy as np
+    feat_idx = sorted({int(m.group(1)) for k in state_dict
+                       for m in [re.match(r'^features\.(\d+)\.weight$', k)] if m
+                       and np.asarray(state_dict[k]).ndim == 4})
+    conv_map = {idx: i for i, idx in enumerate(feat_idx)}
+    bn_idx = sorted({int(m.group(1)) for k in state_dict
+                     for m in [re.match(r'^features\.(\d+)\.weight$', k)] if m
+                     and np.asarray(state_dict[k]).ndim == 1})
+    bn_map = {idx: i for i, idx in enumerate(bn_idx)}
+    out = {}
+    for k, v in state_dict.items():
+        m = re.match(r'^features\.(\d+)\.(.*)$', k)
+        if m:
+            idx, rest = int(m.group(1)), m.group(2)
+            if idx in conv_map and (np.asarray(v).ndim == 4 or rest == 'bias' and idx in conv_map):
+                k = f'convs.{conv_map[idx]}.{rest}'
+            if idx in bn_map and np.asarray(v).ndim == 1 and idx not in conv_map:
+                k = f'norms.{bn_map[idx]}.{rest}'
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_vgg(variant: str, pretrained: bool = False, **kwargs) -> VGG:
     arch = variant.split('_')[0]
     if variant.endswith('_bn'):
         kwargs.setdefault('norm_layer', BatchNormAct2d)
     return build_model_with_cfg(
         VGG, variant, pretrained,
         model_cfg=_cfgs[arch],
-        pretrained_filter_fn=convert_torch_state_dict,
+        pretrained_filter_fn=checkpoint_filter_fn,
         feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
         **kwargs,
     )

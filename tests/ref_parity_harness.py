@@ -71,7 +71,7 @@ def install_torchvision_stub():
     tv.datasets = ds
 
 
-def compare(model_name: str, img_size: int = 224, tol: float = 2e-3) -> float:
+def compare(model_name: str, img_size: int = None, tol: float = 2e-3) -> float:
     import numpy as np
     import torch
     import jax.numpy as jnp
@@ -79,6 +79,11 @@ def compare(model_name: str, img_size: int = 224, tol: float = 2e-3) -> float:
     import timm_tpu
     from timm_tpu.models import load_state_dict_into_model
     from timm_tpu.models._torch_convert import convert_torch_state_dict
+
+    if img_size is None:
+        from timm_tpu.models import get_pretrained_cfg
+        cfg = get_pretrained_cfg(model_name)
+        img_size = cfg.input_size[-1] if cfg is not None else 224
 
     tm = ref_timm.create_model(model_name, num_classes=10)
     tm.eval()

@@ -39,6 +39,12 @@ def _path_str(path) -> str:
     return '.'.join(str(getattr(p, 'key', p)) for p in path)
 
 
+def _is_buffer_key(key: str) -> bool:
+    """Underscore-prefixed components mark non-persistent buffers (e.g. swin's
+    _rel_index/_attn_mask constants) — excluded from the weight contract."""
+    return any(part.startswith('_') for part in key.split('.'))
+
+
 def model_state_dict(model: nnx.Module, include_stats: bool = True) -> Dict[str, np.ndarray]:
     """Flatten an nnx model's parameters (+ batch stats) to a flat dict."""
     state = nnx.state(model)
@@ -50,8 +56,8 @@ def model_state_dict(model: nnx.Module, include_stats: bool = True) -> Dict[str,
         if not include_stats and not isinstance(leaf, nnx.Param):
             continue  # drop batch stats / other non-param variables
         key = _path_str(path)
-        if 'rngs' in key:
-            continue  # rng stream state is not part of the weight contract
+        if 'rngs' in key or _is_buffer_key(key):
+            continue  # rng streams / private buffers aren't weight content
         out[key] = np.asarray(value)
     return out
 
@@ -69,7 +75,7 @@ def load_state_dict_into_model(
     missing = []
     for path, leaf in flat:
         key = _path_str(path)
-        if 'rngs' in key:
+        if 'rngs' in key or _is_buffer_key(key):
             continue
         if key in state_dict:
             new_val = jnp.asarray(state_dict[key])

@@ -63,10 +63,18 @@ class EvaAttention(nnx.Module):
             nnx.Linear, dtype=dtype, param_dtype=param_dtype,
             kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
         if qkv_fused:
-            self.qkv = linear(dim, dim * 3, use_bias=qkv_bias)
+            # reference layout: unbiased fused projection + separate q/v bias
+            # params (k bias fixed at zero) — BEiT-style (reference eva.py:161)
+            self.qkv = linear(dim, dim * 3, use_bias=False)
             self.q_proj = self.k_proj = self.v_proj = None
+            if qkv_bias:
+                self.q_bias = nnx.Param(jnp.zeros((dim,), param_dtype))
+                self.v_bias = nnx.Param(jnp.zeros((dim,), param_dtype))
+            else:
+                self.q_bias = self.v_bias = None
         else:
             self.qkv = None
+            self.q_bias = self.v_bias = None
             self.q_proj = linear(dim, dim, use_bias=qkv_bias)
             self.k_proj = linear(dim, dim, use_bias=False)
             self.v_proj = linear(dim, dim, use_bias=qkv_bias)
@@ -80,7 +88,12 @@ class EvaAttention(nnx.Module):
     def __call__(self, x, rope=None, attn_mask=None):
         B, N, C = x.shape
         if self.qkv_fused:
-            qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, self.head_dim).transpose(2, 0, 3, 1, 4)
+            qkv = self.qkv(x)
+            if self.q_bias is not None:
+                bias = jnp.concatenate([
+                    self.q_bias[...], jnp.zeros_like(self.q_bias[...]), self.v_bias[...]])
+                qkv = qkv + bias.astype(qkv.dtype)
+            qkv = qkv.reshape(B, N, 3, self.num_heads, self.head_dim).transpose(2, 0, 3, 1, 4)
             q, k, v = qkv[0], qkv[1], qkv[2]
         else:
             q = self.q_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)

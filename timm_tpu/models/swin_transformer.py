@@ -481,12 +481,18 @@ default_cfgs = generate_default_cfgs({
 })
 
 
-def _create_swin(variant: str, pretrained: bool = False, **kwargs) -> SwinTransformer:
+def checkpoint_filter_fn(state_dict, model):
     from ._torch_convert import convert_torch_state_dict
+    out = {k: v for k, v in state_dict.items()
+           if not k.endswith(('relative_position_index', 'attn_mask'))}
+    return convert_torch_state_dict(out, model)
+
+
+def _create_swin(variant: str, pretrained: bool = False, **kwargs) -> SwinTransformer:
     out_indices = kwargs.pop('out_indices', (0, 1, 2, 3))
     return build_model_with_cfg(
         SwinTransformer, variant, pretrained,
-        pretrained_filter_fn=convert_torch_state_dict,
+        pretrained_filter_fn=checkpoint_filter_fn,
         feature_cfg=dict(out_indices=out_indices),
         **kwargs,
     )

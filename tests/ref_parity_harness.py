@@ -71,7 +71,7 @@ def install_torchvision_stub():
     tv.datasets = ds
 
 
-def compare(model_name: str, img_size: int = None, tol: float = 2e-3) -> float:
+def compare(model_name: str, img_size: 'int | None' = None) -> float:
     import numpy as np
     import torch
     import jax.numpy as jnp
@@ -119,7 +119,7 @@ def main(models, tol: float = 2e-3):
     results = {}
     for name in models:
         try:
-            d = compare(name, tol=tol)
+            d = compare(name)
             results[name] = d
             print(f'{name}: max|Δlogits| = {d:.2e}  {"PARITY OK" if d < tol else "MISMATCH"}')
         except Exception as e:

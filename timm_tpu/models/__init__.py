@@ -21,6 +21,7 @@ from .eva import Eva
 from .mlp_mixer import MlpMixer
 from .mobilenetv3 import MobileNetV3
 from .naflexvit import NaFlexVit
+from .regnet import RegNet
 from .resnet import ResNet
 from .swin_transformer import SwinTransformer
 from .vgg import VGG

@@ -5,7 +5,7 @@ add SE. Bottleneck blocks with group conv reuse the conv/norm-act stack.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple, Union
+from typing import Any, Callable, Dict, Optional, Union
 
 import numpy as np
 import jax.numpy as jnp
@@ -16,7 +16,6 @@ from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
 from ._manipulate import checkpoint_seq
 from ._registry import generate_default_cfgs, register_model
-from .resnet import avg_pool2d
 
 __all__ = ['RegNet']
 
@@ -244,7 +243,13 @@ def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
 
 
 default_cfgs = generate_default_cfgs({
+    'test_regnet.untrained': _cfg(input_size=(3, 160, 160)),
     'regnetx_002.pycls_in1k': _cfg(hf_hub_id='timm/'),
+    'regnetx_004.pycls_in1k': _cfg(hf_hub_id='timm/'),
+    'regnetx_008.pycls_in1k': _cfg(hf_hub_id='timm/'),
+    'regnetx_032.pycls_in1k': _cfg(hf_hub_id='timm/'),
+    'regnety_004.pycls_in1k': _cfg(hf_hub_id='timm/'),
+    'regnety_008.pycls_in1k': _cfg(hf_hub_id='timm/'),
     'regnetx_016.pycls_in1k': _cfg(hf_hub_id='timm/'),
     'regnety_002.pycls_in1k': _cfg(hf_hub_id='timm/'),
     'regnety_016.tv2_in1k': _cfg(hf_hub_id='timm/'),
@@ -268,9 +273,7 @@ def checkpoint_filter_fn(state_dict, model):
             rest = rest.replace('downsample.bn.', 'downsample_bn.')
             rest = re.sub(r'^conv(\d)\.conv\.', r'conv\1.', rest)
             rest = re.sub(r'^conv(\d)\.bn\.', r'bn\1.', rest)
-            rest = rest.replace('attn.', 'se.')  # SE module
             k = f'stages.{int(m.group(1)) - 1}.{int(m.group(2)) - 1}.{rest}'
-        k = re.sub(r'^head\.fc\.', 'head.fc.', k)
         out[k] = v
     return convert_torch_state_dict(out, model)
 
@@ -291,8 +294,33 @@ def regnetx_002(pretrained=False, **kwargs) -> RegNet:
 
 
 @register_model
+def regnetx_004(pretrained=False, **kwargs) -> RegNet:
+    return _create_regnet('regnetx_004', pretrained, **kwargs)
+
+
+@register_model
+def regnetx_008(pretrained=False, **kwargs) -> RegNet:
+    return _create_regnet('regnetx_008', pretrained, **kwargs)
+
+
+@register_model
 def regnetx_016(pretrained=False, **kwargs) -> RegNet:
     return _create_regnet('regnetx_016', pretrained, **kwargs)
+
+
+@register_model
+def regnetx_032(pretrained=False, **kwargs) -> RegNet:
+    return _create_regnet('regnetx_032', pretrained, **kwargs)
+
+
+@register_model
+def regnety_004(pretrained=False, **kwargs) -> RegNet:
+    return _create_regnet('regnety_004', pretrained, **kwargs)
+
+
+@register_model
+def regnety_008(pretrained=False, **kwargs) -> RegNet:
+    return _create_regnet('regnety_008', pretrained, **kwargs)
 
 
 @register_model
@@ -308,3 +336,16 @@ def regnety_016(pretrained=False, **kwargs) -> RegNet:
 @register_model
 def regnety_032(pretrained=False, **kwargs) -> RegNet:
     return _create_regnet('regnety_032', pretrained, **kwargs)
+
+
+@register_model
+def test_regnet(pretrained=False, **kwargs) -> RegNet:
+    """Tiny fixture for the default test sweeps."""
+    cfg = dict(w0=24, wa=24.0, wm=2.5, group_size=8, depth=4, se_ratio=0.25, stem_width=16)
+    return build_model_with_cfg(
+        RegNet, 'test_regnet', pretrained,
+        model_cfg=cfg,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=(0, 1, 2)),
+        **kwargs,
+    )

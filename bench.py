@@ -32,6 +32,31 @@ BASELINES = {
 CHIP_PEAK = {'v5e': 197e12, 'v5litepod': 197e12, 'v4': 275e12, 'v5p': 459e12, 'v6e': 918e12}
 
 
+_WATCHDOG = None
+
+
+def _arm_watchdog(seconds: int = 540):
+    """Emit an error JSON line and exit instead of hanging forever if the TPU
+    relay is wedged (observed: a stale tile lease makes every device op block
+    inside PJRT C++, where signals can't preempt — so use a timer thread and
+    os._exit, which works regardless of where the main thread is stuck)."""
+    import os
+    import sys
+    import threading
+    global _WATCHDOG
+
+    def fire():
+        print(json.dumps({
+            'metric': 'benchmark watchdog: TPU unreachable (device ops hung)',
+            'value': 0.0, 'unit': 'img/s/chip', 'vs_baseline': None}), flush=True)
+        sys.stdout.flush()
+        os._exit(2)
+
+    _WATCHDOG = threading.Timer(seconds, fire)
+    _WATCHDOG.daemon = True
+    _WATCHDOG.start()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='vit_base_patch16_224')
@@ -45,6 +70,7 @@ def main():
         args.model = 'vit_tiny_patch16_224'
         args.steps = 5
 
+    _arm_watchdog()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -135,6 +161,8 @@ def main():
     except Exception:
         pass
 
+    if _WATCHDOG is not None:
+        _WATCHDOG.cancel()  # measurement done; disarm watchdog
     baseline = BASELINES.get((args.model, args.bench))
     metric = f'{args.model} {args.bench} img/s/chip (bf16, bs{batch_size}, {n_chips} chip)'
     if mfu is not None:

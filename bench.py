@@ -70,7 +70,8 @@ def main():
         args.model = 'vit_tiny_patch16_224'
         args.steps = 5
 
-    _arm_watchdog()
+    # budget: compile (+relay) headroom plus per-step margin for big fused runs
+    _arm_watchdog(480 + 12 * max(args.steps, 10))
     import jax
     import jax.numpy as jnp
     import numpy as np

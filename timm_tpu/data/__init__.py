@@ -14,6 +14,7 @@ from .mixup import FastCollateMixup, Mixup
 from .naflex_loader import NaFlexCollator, NaFlexLoader, calculate_naflex_batch_size, create_naflex_loader
 from .random_erasing import RandomErasing
 from .readers import ReaderImageFolder, create_reader
+from .real_labels import RealLabelsImagenet
 from .transforms import (
     CenterCrop, CenterCropOrPad, Compose, RandomResizedCropAndInterpolation,
     Resize, ResizeKeepRatio, ToNumpy,

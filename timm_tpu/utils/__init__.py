@@ -1,3 +1,4 @@
+from .attention_extract import AttentionExtract
 from .checkpoint_saver import CheckpointSaver
 from .clip_grad import adaptive_clip_grad, clip_grad_norm, clip_grad_value, dispatch_clip_grad, global_grad_norm
 from .log import FormatterNoInfo, setup_default_logging

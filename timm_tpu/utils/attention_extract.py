@@ -1,0 +1,101 @@
+"""Attention-map extraction (reference: timm/utils/attention_extract.py:9-85).
+
+Functional JAX has no forward hooks; extraction re-runs attention score
+computation from per-block token inputs gathered via forward_intermediates —
+the getter-style analogue of the reference's fx/hook wrapper.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['AttentionExtract']
+
+
+class AttentionExtract:
+    """Extract softmax attention maps from ViT-style models.
+
+    Works with any model whose blocks expose `.attn` with the standard
+    (qkv | q_proj/k_proj/v_proj, num_heads, head_dim, scale) contract and a
+    `forward_intermediates` that returns per-block token outputs.
+    """
+
+    def __init__(self, model, names: Optional[List[Union[int, str]]] = None):
+        self.model = model
+        num_blocks = len(model.blocks)
+        if names is None:
+            self.indices = list(range(num_blocks))
+        else:
+            self.indices = [n if isinstance(n, int) else self._parse_index(n) for n in names]
+
+    @staticmethod
+    def _parse_index(name: str) -> int:
+        # accepts 3, 'blocks.3', or 'blocks.3.attn'
+        for part in str(name).split('.'):
+            if part.isdigit():
+                return int(part)
+        raise ValueError(f'No block index found in name {name!r}')
+
+    def _scores(self, attn, tokens, rope=None):
+        from ..layers.attention import apply_rot_embed_cat
+        B, N, C = tokens.shape
+        if getattr(attn, 'qkv', None) is not None:
+            qkv = attn.qkv(tokens)
+            if getattr(attn, 'q_bias', None) is not None:
+                bias = jnp.concatenate([
+                    attn.q_bias[...], jnp.zeros_like(attn.q_bias[...]), attn.v_bias[...]])
+                qkv = qkv + bias.astype(qkv.dtype)
+            qkv = qkv.reshape(B, N, 3, attn.num_heads, attn.head_dim).transpose(2, 0, 3, 1, 4)
+            q, k = qkv[0], qkv[1]
+        else:
+            q = attn.q_proj(tokens).reshape(B, N, attn.num_heads, attn.head_dim).transpose(0, 2, 1, 3)
+            k = attn.k_proj(tokens).reshape(B, N, attn.num_heads, attn.head_dim).transpose(0, 2, 1, 3)
+        if getattr(attn, 'q_norm', None) is not None:
+            q = attn.q_norm(q)
+        if getattr(attn, 'k_norm', None) is not None:
+            k = attn.k_norm(k)
+        if rope is not None:
+            num_prefix = N - rope.shape[-2]
+            if num_prefix > 0:
+                q = jnp.concatenate(
+                    [q[..., :num_prefix, :], apply_rot_embed_cat(q[..., num_prefix:, :], rope)], axis=-2)
+                k = jnp.concatenate(
+                    [k[..., :num_prefix, :], apply_rot_embed_cat(k[..., num_prefix:, :], rope)], axis=-2)
+            else:
+                q, k = apply_rot_embed_cat(q, rope), apply_rot_embed_cat(k, rope)
+        scores = jnp.einsum('bhqd,bhkd->bhqk', q * attn.scale, k)
+        return jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+
+    def __call__(self, x) -> Dict[str, jnp.ndarray]:
+        # block i's attention consumes block i-1's (normed) output
+        need = sorted({i - 1 for i in self.indices if i > 0})
+        inputs = {}
+        if any(i == 0 for i in self.indices):
+            tokens0 = self.model.patch_embed(x)
+            tokens0 = self.model._pos_embed(tokens0)
+            if getattr(self.model, 'norm_pre', None) is not None:
+                tokens0 = self.model.norm_pre(tokens0)
+            inputs[0] = tokens0
+        if need:
+            inters = self.model.forward_intermediates(
+                x, indices=need, output_fmt='NLC', intermediates_only=True,
+                return_prefix_tokens=True)
+            for i, feat in zip(need, inters):
+                if isinstance(feat, tuple):  # (spatial, prefix) → full token stream
+                    feat = jnp.concatenate([feat[1], feat[0]], axis=1)
+                inputs[i + 1] = feat
+
+        rope = None
+        if getattr(self.model, 'rope', None) is not None:
+            rope = self.model.rope.get_embed()
+
+        out = {}
+        for i in self.indices:
+            blk = self.model.blocks[i]
+            # post-norm blocks (ResPost*) feed attention the RAW residual stream
+            post_norm = 'ResPost' in type(blk).__name__
+            tokens = inputs[i] if post_norm else blk.norm1(inputs[i])
+            out[f'blocks.{i}.attn'] = self._scores(blk.attn, tokens, rope=rope)
+        return out

@@ -45,6 +45,8 @@ parser.add_argument('--log-freq', default=20, type=int, metavar='N')
 parser.add_argument('--amp', action='store_true', default=False, help='bf16 compute')
 parser.add_argument('--test-pool', dest='test_pool', action='store_true',
                     help='(not yet supported; warns if set)')
+parser.add_argument('--real-labels', default='', type=str, metavar='FILENAME',
+                    help='ImageNet-Real labels json for relabeled eval')
 parser.add_argument('--results-file', default='', type=str, metavar='FILENAME')
 parser.add_argument('--results-format', default='csv', type=str)
 parser.add_argument('--model-list', default='', type=str, metavar='FILENAME or WILDCARD',
@@ -103,6 +105,12 @@ def validate(args):
         crop_mode=data_config['crop_mode'],
     )
 
+    real_labels = None
+    if args.real_labels:
+        from timm_tpu.data import RealLabelsImagenet
+        real_labels = RealLabelsImagenet(
+            dataset.filenames(basename=True), real_json=args.real_labels)
+
     from flax import nnx
     graphdef, state = nnx.split(model)
     mean = jnp.asarray(data_config['mean'], jnp.float32).reshape(1, 1, 1, -1)
@@ -121,7 +129,7 @@ def validate(args):
         top = jnp.argsort(logits, axis=-1)[:, -5:]
         acc1 = ((top[:, -1] == target) * w).sum() / denom * 100.0
         acc5 = ((top == target[:, None]).any(axis=-1) * w).sum() / denom * 100.0
-        return loss, acc1, acc5
+        return loss, acc1, acc5, top[:, ::-1]  # top-5 preds, best first
 
     loss_m, top1_m, top5_m, time_m = AverageMeter(), AverageMeter(), AverageMeter(), AverageMeter()
     end = time.time()
@@ -135,7 +143,9 @@ def validate(args):
             valid_np[n:] = False
         batch = shard_batch({'x': jnp.asarray(x_np), 't': jnp.asarray(t_np),
                              'v': jnp.asarray(valid_np)}, mesh)
-        loss, acc1, acc5 = eval_step(state, batch['x'], batch['t'], batch['v'])
+        loss, acc1, acc5, topk = eval_step(state, batch['x'], batch['t'], batch['v'])
+        if real_labels is not None:
+            real_labels.add_result(np.asarray(topk)[:n], is_topk=True)  # drop pad rows
         loss_m.update(float(loss), n)
         top1_m.update(float(acc1), n)
         top5_m.update(float(acc5), n)
@@ -149,6 +159,10 @@ def validate(args):
                 f'Acc@1: {top1_m.val:>7.3f} ({top1_m.avg:>7.3f})  '
                 f'Acc@5: {top5_m.val:>7.3f} ({top5_m.avg:>7.3f})')
 
+    if real_labels is not None:
+        # replace top-1/5 with the relabeled scores (reference validate.py:418)
+        top1_m.avg = real_labels.get_accuracy(k=1)
+        top5_m.avg = real_labels.get_accuracy(k=5)
     results = OrderedDict(
         model=args.model,
         top1=round(top1_m.avg, 4), top1_err=round(100 - top1_m.avg, 4),

@@ -126,3 +126,47 @@ def test_optimizer_kwargs_bridge():
                           layer_decay_min_scale=None, opt_kwargs={}, opt_caution=False)
     kw = optimizer_kwargs(cfg)
     assert kw['opt'] == 'adamw' and kw['betas'] == (0.9, 0.95) and kw['layer_decay'] == 0.75
+
+
+def test_coupled_l2_for_wd_less_factories():
+    """Optimizers whose optax factory lacks a weight_decay param must still
+    apply (coupled L2) decay — ADVICE r1 high: sgd/adam/etc silently trained
+    unregularized."""
+    for opt_name in ('sgd', 'adam', 'rmsprop'):
+        model, x, y = _toy_problem()
+        opt = create_optimizer_v2(model, opt=opt_name, lr=1e-2, weight_decay=0.1)
+        params = nnx.state(model, nnx.Param)
+        state = opt.init(params)
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+        updates, _ = opt.update(zero_grads, state, params, lr=1e-2)
+        # with zero grads the only update source is weight decay: kernels move
+        flat = {'/'.join(map(str, p)): v for p, v in jax.tree_util.tree_leaves_with_path(updates)}
+        kernel_updates = [v for k, v in flat.items() if 'kernel' in k]
+        assert kernel_updates and all(float(jnp.abs(u).max()) > 0 for u in kernel_updates), opt_name
+        # bias params are WD-masked (filter_bias_and_bn) and must not move
+        bias_updates = [v for k, v in flat.items() if 'bias' in k]
+        assert all(float(jnp.abs(u).max()) == 0 for u in bias_updates), opt_name
+
+
+def test_adan_three_betas():
+    """--opt-betas with 3 values must reach optax.adan's b3 (ADVICE r1 low)."""
+    model, x, y = _toy_problem()
+    opt = create_optimizer_v2(model, opt='adan', lr=1e-3, betas=(0.9, 0.95, 0.99))
+    assert opt.defaults['b3'] == pytest.approx(0.99)
+
+    def run(b3):
+        o = create_optimizer_v2(model, opt='adan', lr=1e-3, betas=(0.9, 0.95, b3))
+        params = nnx.state(model, nnx.Param)
+        state = o.init(params)
+
+        def loss_fn(p):
+            m = nnx.merge(nnx.graphdef(model), p)
+            return jnp.mean((m(x) - y) ** 2)
+
+        for _ in range(3):
+            _, grads = jax.value_and_grad(loss_fn)(params)
+            updates, state = o.update(grads, state, params, lr=1e-3)
+            params = optax.apply_updates(params, updates)
+        return np.asarray(jax.tree.leaves(params)[0])
+
+    assert not np.allclose(run(0.5), run(0.999))

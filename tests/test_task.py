@@ -123,3 +123,19 @@ def test_dryrun_multichip_entry():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     mod.dryrun_multichip(8)
+
+
+def test_ema_decay_zero_syncs_to_model(mesh8):
+    """decay==0 must copy model params into EMA (reference ModelEmaV3 lerp
+    weight 1.0 during the update_after_step window), not freeze the EMA
+    (ADVICE r1 medium)."""
+    task = _make_task(mesh8)
+    task.setup_ema(decay=0.999, warmup=True, update_after_step=100)
+    batch = _batch(mesh8)
+    # inside the update_after_step window → get_decay == 0 → EMA tracks model
+    assert task.ema.get_decay(1) == 0.0
+    for i in range(2):
+        task.train_step(batch, lr=1e-2, step=i + 1)
+    params = jax.tree.leaves(nnx.state(task.model, nnx.Param))
+    ema = jax.tree.leaves(task.ema_params)
+    assert all(np.allclose(np.asarray(p), np.asarray(e)) for p, e in zip(params, ema))

@@ -69,6 +69,12 @@ def scaled_dot_product_attention(
         from ..kernels import flash_attention_supported, flash_attention
         if flash_attention_supported(q, k, v, attn_mask):
             return flash_attention(q, k, v, mask=attn_mask, scale=scale)
+        # At image-model sequence lengths the plain einsum+softmax graph beats
+        # jax.nn.dot_product_attention on v5e (measured ViT-B/16 @224 train:
+        # 867 vs 786 img/s/chip) — the N^2 score matrix is small enough that
+        # XLA's fusion of it wins over the generic attention lowering.
+        if q.shape[-2] <= 1024:
+            return _sdpa(q, k, v, attn_mask, 0.0, None, scale)
         # XLA's fused path: expects (B, N, H, D)
         mask = attn_mask
         if mask is not None and mask.dtype != jnp.bool_:

@@ -38,11 +38,10 @@ class BinaryCrossEntropy:
             off_value = self.smoothing / num_classes
             on_value = 1.0 - self.smoothing + off_value
             target = jax.nn.one_hot(target, num_classes) * (on_value - off_value) + off_value
-        elif self.smoothing > 0.0:
-            off_value = self.smoothing / num_classes
-            target = target * (1.0 - self.smoothing) + off_value
+        # dense (B, C) targets are assumed pre-softened upstream (mixup/cutmix);
+        # the reference never re-smooths them (binary_cross_entropy.py:41)
         if self.target_threshold is not None:
-            target = (target >= self.target_threshold).astype(x.dtype)
+            target = (target > self.target_threshold).astype(x.dtype)
 
         x = x.astype(jnp.float32)
         target = target.astype(jnp.float32)

@@ -372,10 +372,9 @@ def create_optimizer_v2(
     betas = kwargs.pop('betas', None)
     eps = kwargs.pop('eps', None)
     if info.has_betas and betas is not None:
-        if info.num_betas == 3:
-            opt_args.update(b1=betas[0], b2=betas[1])
-        else:
-            opt_args.update(b1=betas[0], b2=betas[1])
+        opt_args.update(b1=betas[0], b2=betas[1])
+        if info.num_betas == 3 and len(betas) > 2:
+            opt_args['b3'] = betas[2]
     if info.has_eps and eps is not None:
         opt_args['eps'] = eps
     if info.has_momentum:
@@ -408,6 +407,25 @@ def create_optimizer_v2(
             opt_args[k] = v
 
     tx_factory = info.opt_class
+    # Coupled L2 for optimizers whose optax factory has no weight-decay param
+    # (sgd/momentum/adam/nadam/radam/rmsprop/adabelief/...): torch applies WD by
+    # adding wd*p to the gradient before the transform (reference
+    # _optim_factory.py param-group defaults); without this the default
+    # `train.py --weight-decay` silently trains unregularized.
+    supports_wd = sig_params is not None and (
+        'weight_decay' in sig_params or 'weight_decay_rate' in sig_params)
+    if weight_decay and not supports_wd:
+        base_l2 = tx_factory
+        bound_l2 = dict(opt_args)
+        opt_args = {}
+
+        def tx_factory(learning_rate, _base=base_l2, _bound=bound_l2,
+                       _wd=weight_decay, _mask=wd_mask):
+            return optax.chain(
+                optax.add_decayed_weights(_wd, mask=_mask),
+                _base(learning_rate, **_bound),
+            )
+
     if use_lookahead:
         base_factory = tx_factory
         bound_args = dict(opt_args)

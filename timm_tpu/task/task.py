@@ -151,12 +151,9 @@ class TrainingTask:
             nnx.update(model, params)
 
             if has_ema:
-                ema_params = jax.lax.cond(
-                    ema_decay > 0.0,
-                    lambda e: ema_update(e, params, ema_decay),
-                    lambda e: e,
-                    ema_params,
-                )
+                # decay==0 naturally syncs EMA to model (reference ModelEmaV3
+                # lerp weight 1.0 during the update_after_step window).
+                ema_params = ema_update(ema_params, params, ema_decay)
             metrics = {'loss': loss, 'grad_norm': grad_norm}
             return opt_state, ema_params, metrics
 

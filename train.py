@@ -59,6 +59,9 @@ def make_parser():
     group.add_argument('--amp', action='store_true', default=False,
                        help='bf16 compute (the TPU-native AMP)')
     group.add_argument('--amp-dtype', default='bfloat16', type=str)
+    group.add_argument('--device', default=None, type=str,
+                       help='pin the JAX platform (tpu/cpu); default = auto '
+                            '(reference train.py --device)')
     # optimizer
     group = parser.add_argument_group('Optimizer parameters')
     group.add_argument('--opt', default='sgd', type=str, metavar='OPTIMIZER')
@@ -211,6 +214,10 @@ def main():
 
     setup_default_logging()
     args, args_text = _parse_args()
+    if args.device:
+        # must land before the first device op; env JAX_PLATFORMS loses to the
+        # axon plugin's sitecustomize registration, jax.config wins
+        jax.config.update('jax_platforms', args.device)
     world_size, rank, _ = init_distributed_device(args)
     random_seed(args.seed, rank)
 
@@ -335,10 +342,9 @@ def main():
         loader_eval = SyntheticLoader(max(args.synthetic_len // 4, args.batch_size),
                                       args.validation_batch_size or args.batch_size,
                                       img_size, args.num_classes, args.seed + 1)
-        mixup_fn = None
+        mixup_fn = 'auto'
     else:
         from timm_tpu.data import create_dataset, create_loader
-        from timm_tpu.data.mixup import Mixup
         dataset_train = create_dataset(
             args.dataset, root=args.data_dir, split=args.train_split, is_training=True,
             class_map=args.class_map, num_classes=args.num_classes)
@@ -377,6 +383,11 @@ def main():
             num_workers=args.workers,
             crop_pct=data_config['crop_pct'],
         )
+        mixup_fn = 'auto'
+
+    # mixup applies to any (input, target)-tuple loader; naflex handles its own
+    if mixup_fn == 'auto':
+        from timm_tpu.data.mixup import Mixup
         mixup_fn = None
         if args.mixup > 0 or args.cutmix > 0:
             mixup_fn = Mixup(
@@ -431,6 +442,8 @@ def main():
     for epoch in range(start_epoch, num_epochs):
         if hasattr(loader_train, 'set_epoch'):
             loader_train.set_epoch(epoch)  # fresh shuffle/schedule (ref train.py:478)
+        if args.mixup_off_epoch and epoch >= args.mixup_off_epoch and mixup_fn is not None:
+            mixup_fn.mixup_enabled = False  # ref train.py disable-mixup schedule
         train_metrics = train_one_epoch(
             epoch, task, loader_train, args, lr_scheduler, mesh, shard_batch,
             updates_per_epoch, saver=saver, mixup_fn=mixup_fn)

@@ -207,3 +207,186 @@ def test_diff_attention_layer():
     out = attn(x)
     assert out.shape == (2, 10, 64)
     assert 0.2 < attn.lambda_init < 0.8
+
+
+# ---- round-2 layer pack ----------------------------------------------------
+
+def test_rel_pos_bias_shapes():
+    from timm_tpu.layers import RelPosBias, RelPosMlp, gen_relative_position_index
+    idx = gen_relative_position_index((4, 4))
+    assert idx.shape == (16, 16) and idx.max() == 7 * 7 - 1 and idx.min() == 0
+    idx_cls = gen_relative_position_index((4, 4), class_token=True)
+    assert idx_cls.shape == (17, 17) and idx_cls.max() == 7 * 7 + 2
+    rpb = RelPosBias(window_size=(4, 4), num_heads=3, rngs=nnx.Rngs(0))
+    bias = rpb.get_bias()
+    assert bias.shape == (1, 3, 16, 16)
+    # relative bias must be symmetric under query/key swap of identical offsets
+    attn = jnp.zeros((2, 3, 16, 16))
+    out = rpb(attn)
+    assert out.shape == attn.shape
+    rpm = RelPosMlp(window_size=(4, 4), num_heads=3, mode='cr', rngs=nnx.Rngs(0))
+    assert rpm.get_bias().shape == (1, 3, 16, 16)
+    rpm_swin = RelPosMlp(window_size=(4, 4), num_heads=2, mode='swin', rngs=nnx.Rngs(0))
+    assert rpm_swin.get_bias().shape == (1, 2, 16, 16)
+
+
+def test_rel_pos_bias_translation_invariance():
+    from timm_tpu.layers import RelPosBias
+    rpb = RelPosBias(window_size=(3, 3), num_heads=1, rngs=nnx.Rngs(0))
+    b = np.asarray(rpb.get_bias())[0, 0]
+    # tokens 0→4 and 4→8 have the same relative offset (1,1): same bias value
+    assert b[0, 4] == b[4, 8]
+    assert b[1, 5] == b[4, 8]
+
+
+def test_split_attn():
+    from timm_tpu.layers import SplitAttn
+    m = SplitAttn(16, radix=2, rngs=nnx.Rngs(0))
+    m.eval()
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 16), jnp.float32)
+    y = m(x)
+    assert y.shape == (2, 8, 8, 16)
+    m1 = SplitAttn(16, radix=1, rngs=nnx.Rngs(0))
+    m1.eval()
+    assert m1(x).shape == (2, 8, 8, 16)
+
+
+def test_selective_kernel():
+    from timm_tpu.layers import SelectiveKernel
+    m = SelectiveKernel(16, 16, split_input=True, rngs=nnx.Rngs(0))
+    m.eval()
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 16), jnp.float32)
+    assert m(x).shape == (2, 8, 8, 16)
+
+
+def test_gather_excite_and_global_context():
+    from timm_tpu.layers import GatherExcite, GlobalContext
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 16), jnp.float32)
+    for kwargs in (dict(extent=0), dict(extent=2), dict(extent=2, extra_params=True)):
+        m = GatherExcite(16, **kwargs, rngs=nnx.Rngs(0))
+        m.eval()
+        assert m(x).shape == x.shape, kwargs
+    gc = GlobalContext(16, rngs=nnx.Rngs(0))
+    gc.eval()
+    assert gc(x).shape == x.shape
+    gca = GlobalContext(16, fuse_add=True, fuse_scale=False, rngs=nnx.Rngs(0))
+    gca.eval()
+    assert gca(x).shape == x.shape
+
+
+def test_drop_block_2d_stats():
+    from timm_tpu.layers import drop_block_2d
+    x = jnp.ones((4, 16, 16, 8))
+    key = jax.random.PRNGKey(0)
+    y = drop_block_2d(x, key, drop_prob=0.2, block_size=5, scale_by_keep=False)
+    dropped = float((y == 0).mean())
+    assert 0.05 < dropped < 0.5  # roughly drop_prob worth of area zeroed
+    # scale_by_keep keeps the expectation roughly constant
+    y2 = drop_block_2d(x, key, drop_prob=0.2, block_size=5, scale_by_keep=True)
+    assert abs(float(y2.mean()) - 1.0) < 0.05
+
+
+def test_split_batchnorm_distinct_stats():
+    from timm_tpu.layers import SplitBatchNormAct2d, convert_splitbn_model
+    m = SplitBatchNormAct2d(8, num_splits=2, apply_act=False, rngs=nnx.Rngs(0))
+    rng = np.random.RandomState(0)
+    # first half ~N(0,1), second half ~N(4,1): aux stats should diverge
+    x = np.concatenate([rng.randn(8, 4, 4, 8), rng.randn(8, 4, 4, 8) + 4.0]).astype(np.float32)
+    m.train()
+    m(jnp.asarray(x))
+    # one EMA update at momentum 0.1: primary ≈ 0.1*0, aux ≈ 0.1*4
+    assert float(m.mean[...].mean()) < 0.1
+    assert float(m.aux_bn[0].mean[...].mean()) > 0.25
+    # eval uses primary stats on the full batch
+    m.eval()
+    y = m(jnp.asarray(x))
+    assert y.shape == x.shape
+
+    # conversion walks a small model and swaps BN layers in place
+    import timm_tpu
+    model = timm_tpu.create_model('test_efficientnet', num_classes=10)
+    convert_splitbn_model(model, num_splits=2)
+    found = []
+
+    def walk(mod):
+        for v in vars(mod).values():
+            if isinstance(v, SplitBatchNormAct2d):
+                found.append(v)
+            elif isinstance(v, nnx.List):
+                for it in v:
+                    if isinstance(it, SplitBatchNormAct2d):
+                        found.append(it)
+                    elif isinstance(it, nnx.Module):
+                        walk(it)
+            elif isinstance(v, nnx.Module):
+                walk(v)
+    walk(model)
+    assert found, 'no BN layers converted'
+
+
+def test_filter_response_norm():
+    from timm_tpu.layers import FilterResponseNormAct2d, FilterResponseNormTlu2d
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 6, 6, 8) * 3, jnp.float32)
+    y = FilterResponseNormAct2d(8, rngs=nnx.Rngs(0))(x)
+    assert y.shape == x.shape and float(y.min()) >= 0.0  # relu applied
+    y2 = FilterResponseNormTlu2d(8, rngs=nnx.Rngs(0))(x)
+    assert y2.shape == x.shape
+
+
+def test_cond_conv2d_routing():
+    from timm_tpu.layers import CondConv2d
+    m = CondConv2d(8, 16, 3, num_experts=4, bias=True, rngs=nnx.Rngs(0))
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 8), jnp.float32)
+    r_a = jax.nn.softmax(jnp.asarray([[1.0, 0, 0, 0], [0, 1.0, 0, 0]]) * 10)
+    y = m(x, r_a)
+    assert y.shape == (2, 8, 8, 16)
+    # different routing → different outputs for the same input
+    r_b = jax.nn.softmax(jnp.asarray([[0, 0, 1.0, 0], [0, 0, 0, 1.0]]) * 10)
+    assert not np.allclose(np.asarray(y), np.asarray(m(x, r_b)))
+
+
+def test_mixed_conv2d():
+    from timm_tpu.layers import MixedConv2d
+    m = MixedConv2d(16, 16, kernel_size=[3, 5], depthwise=True, rngs=nnx.Rngs(0))
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 16), jnp.float32)
+    assert m(x).shape == (2, 8, 8, 16)
+
+
+def test_test_time_pool_head():
+    import timm_tpu
+    from timm_tpu.layers import TestTimePoolHead
+    model = timm_tpu.create_model('test_efficientnet', num_classes=10)
+    model.eval()
+    wrapped = TestTimePoolHead(model, original_pool=2)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 96, 96, 3), jnp.float32)
+    out = wrapped(x)
+    assert out.shape == (2, 10)
+
+
+def test_create_attn_new_modules():
+    from timm_tpu.layers import create_attn
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 16), jnp.float32)
+    for name in ('ge', 'gc', 'splat', 'sk'):
+        m = create_attn(name, 16, rngs=nnx.Rngs(0))
+        m.eval()
+        assert m(x).shape == x.shape, name
+
+
+def test_radix_softmax_cardinality_order():
+    """radix weights must be radix-major after flatten so the caller's
+    (B, radix, C) reshape picks weights for the right cardinal group."""
+    from timm_tpu.layers.split_attn import radix_softmax
+    B, card, radix, ch = 1, 2, 2, 3
+    logits = jnp.arange(card * radix * ch, dtype=jnp.float32).reshape(1, 1, 1, -1) * 100
+    out = radix_softmax(logits, radix, card).reshape(B, radix, card * ch)
+    # within each (card, ch) column the two radix entries sum to 1
+    sums = np.asarray(out.sum(axis=1))
+    assert np.allclose(sums, 1.0, atol=1e-5)
+
+
+def test_split_attn_groups():
+    from timm_tpu.layers import SplitAttn
+    m = SplitAttn(16, radix=2, groups=2, rngs=nnx.Rngs(0))
+    m.eval()
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 16), jnp.float32)
+    assert m(x).shape == (2, 8, 8, 16)

@@ -14,10 +14,15 @@ from .eca import CecaModule, EcaModule
 from .evo_norm import EvoNorm2dB0, EvoNorm2dS0
 from .std_conv import ScaledStdConv2d, StdConv2d
 from .create_conv2d import ConvNormAct, create_conv2d, get_padding
+from .cond_conv2d import CondConv2d, get_condconv_initializer
 from .create_norm import create_norm_layer, get_norm_layer
-from .drop import DropPath, Dropout, calculate_drop_path_rates, drop_path
+from .drop import DropBlock2d, DropPath, Dropout, calculate_drop_path_rates, drop_block_2d, drop_path
+from .filter_response_norm import FilterResponseNormAct2d, FilterResponseNormTlu2d
+from .gather_excite import GatherExcite
+from .global_context import GlobalContext
 from .helpers import extend_tuple, make_divisible, to_1tuple, to_2tuple, to_3tuple, to_4tuple, to_ntuple
 from .layer_scale import LayerScale, LayerScale2d
+from .mixed_conv2d import MixedConv2d
 from .mlp import ConvMlp, GatedMlp, GlobalResponseNorm, GlobalResponseNormMlp, GluMlp, Mlp, SwiGLU, SwiGLUPacked
 from .norm import (
     BatchNorm2d, GroupNorm, GroupNorm1, LayerNorm, LayerNorm2d, LayerNormFp32,
@@ -31,6 +36,14 @@ from .patch_dropout import PatchDropout
 from .patch_embed import PatchEmbed, resample_patch_embed
 from .pool import SelectAdaptivePool2d, adaptive_pool_feat_mult, global_pool_nlc
 from .pos_embed import resample_abs_pos_embed, resample_abs_pos_embed_nhwc
+from .pos_embed_rel import (
+    RelPosBias, RelPosMlp, gen_relative_log_coords, gen_relative_position_index,
+    resize_rel_pos_bias_table_simple,
+)
+from .selective_kernel import SelectiveKernel, SelectiveKernelAttn
+from .split_attn import SplitAttn
+from .split_batchnorm import SplitBatchNorm2d, SplitBatchNormAct2d, convert_splitbn_model
+from .test_time_pool import TestTimePoolHead, apply_test_time_pool
 from .pos_embed_sincos import (
     RotaryEmbeddingCat, build_fourier_pos_embed, build_rotary_pos_embed,
     build_sincos2d_pos_embed, freq_bands, pixel_freq_bands,

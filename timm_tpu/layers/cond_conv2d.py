@@ -1,0 +1,98 @@
+"""Conditionally parameterized convolution (CondConv, arXiv:1904.04971)
+(reference: timm/layers/cond_conv2d.py:36-139).
+
+TPU-first: per-sample kernels are built by one (B, E) x (E, P) matmul and the
+per-sample conv runs as a vmap'd conv — XLA batches it; no grouped-conv
+reshaping hackery is needed.
+"""
+from __future__ import annotations
+
+import math
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from .helpers import to_2tuple
+
+__all__ = ['CondConv2d', 'get_condconv_initializer']
+
+
+def get_condconv_initializer(initializer, num_experts, expert_shape):
+    """Init each expert row as if it were an independent kernel of
+    `expert_shape` (reference cond_conv2d.py:23-33)."""
+    def condconv_initializer(key, shape, dtype):
+        assert shape[0] == num_experts and shape[1] == math.prod(expert_shape)
+        keys = jax.random.split(key, num_experts)
+        rows = [initializer(k, expert_shape, dtype).reshape(-1) for k in keys]
+        return jnp.stack(rows)
+    return condconv_initializer
+
+
+class CondConv2d(nnx.Module):
+    """NHWC conditionally-parameterized conv. `__call__(x, routing_weights)`
+    with routing (B, num_experts); expert kernels stored flat (E, P) with
+    HWIO expert shape."""
+
+    def __init__(
+            self,
+            in_channels: int,
+            out_channels: int,
+            kernel_size: Union[int, tuple] = 3,
+            stride: int = 1,
+            padding='',
+            dilation: int = 1,
+            groups: int = 1,
+            bias: bool = False,
+            num_experts: int = 4,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = to_2tuple(kernel_size)
+        self.stride = to_2tuple(stride)
+        self.dilation = to_2tuple(dilation)
+        self.groups = groups
+        self.num_experts = num_experts
+        self.dtype = dtype
+        if isinstance(padding, str):
+            self.padding = 'SAME' if padding.lower() in ('same', '') else 'VALID'
+        else:
+            p = to_2tuple(padding)
+            self.padding = [(p[0], p[0]), (p[1], p[1])]
+        # HWIO expert kernel shape (flax conv convention)
+        self.weight_shape = self.kernel_size + (in_channels // groups, out_channels)
+        fan_in = math.prod(self.weight_shape[:-1])
+        bound = 1.0 / math.sqrt(fan_in)
+        kaiming = jax.nn.initializers.variance_scaling(1.0 / 3.0, 'fan_in', 'uniform')
+        self.weight = nnx.Param(get_condconv_initializer(
+            kaiming, num_experts, self.weight_shape)(
+            rngs.params(), (num_experts, math.prod(self.weight_shape)), param_dtype))
+        if bias:
+            uni = jax.nn.initializers.uniform(scale=2 * bound)
+            self.bias = nnx.Param(
+                uni(rngs.params(), (num_experts, out_channels), param_dtype) - bound)
+        else:
+            self.bias = None
+
+    def __call__(self, x, routing_weights):
+        B = x.shape[0]
+        dt = self.dtype or x.dtype
+        weight = (routing_weights.astype(dt) @ self.weight[...].astype(dt))
+        weight = weight.reshape((B,) + self.weight_shape)  # (B, kh, kw, Cin/g, Cout)
+
+        def conv_one(xi, wi):
+            return jax.lax.conv_general_dilated(
+                xi[None], wi, window_strides=self.stride, padding=self.padding,
+                rhs_dilation=self.dilation, feature_group_count=self.groups,
+                dimension_numbers=('NHWC', 'HWIO', 'NHWC'))[0]
+
+        out = jax.vmap(conv_one)(x.astype(dt), weight)
+        if self.bias is not None:
+            b = routing_weights.astype(dt) @ self.bias[...].astype(dt)  # (B, Cout)
+            out = out + b[:, None, None, :]
+        return out

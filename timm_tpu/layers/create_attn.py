@@ -2,10 +2,15 @@
 from __future__ import annotations
 
 
+from functools import partial
 from typing import Callable, Union
 
 from .cbam import CbamModule, LightCbamModule
 from .eca import CecaModule, EcaModule
+from .gather_excite import GatherExcite
+from .global_context import GlobalContext
+from .selective_kernel import SelectiveKernel
+from .split_attn import SplitAttn
 from .squeeze_excite import EffectiveSEModule, SEModule
 
 __all__ = ['get_attn', 'create_attn']
@@ -17,6 +22,11 @@ _ATTN_MAP = dict(
     ceca=CecaModule,
     cbam=CbamModule,
     lcbam=LightCbamModule,
+    ge=GatherExcite,
+    gc=GlobalContext,
+    gca=partial(GlobalContext, fuse_add=True, fuse_scale=False),
+    sk=SelectiveKernel,
+    splat=SplitAttn,
 )
 
 

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 from flax import nnx
 
-__all__ = ['DropPath', 'Dropout', 'calculate_drop_path_rates', 'drop_path']
+__all__ = ['DropPath', 'Dropout', 'DropBlock2d', 'calculate_drop_path_rates', 'drop_path', 'drop_block_2d']
 
 
 def drop_path(x, key, drop_prob: float = 0.0, scale_by_keep: bool = True):
@@ -82,3 +82,72 @@ def calculate_drop_path_rates(
         out.append(rates[idx:idx + d])
         idx += d
     return out
+
+
+def drop_block_2d(
+        x, key,
+        drop_prob: float = 0.1,
+        block_size: int = 7,
+        gamma_scale: float = 1.0,
+        with_noise: bool = False,
+        couple_channels: bool = True,
+        scale_by_keep: bool = True,
+):
+    """DropBlock on NHWC features (reference drop.py:24-100, arXiv:1810.12890).
+    Block centres drawn at rate gamma; a stride-1 max-pool dilates them to
+    kh x kw blocks."""
+    B, H, W, C = x.shape
+    kh, kw = min(block_size, H), min(block_size, W)
+    gamma = float(gamma_scale * drop_prob * H * W) / float(kh * kw) / float((H - kh + 1) * (W - kw + 1))
+
+    noise_shape = (B, H, W, 1 if couple_channels else C)
+    k1, k2 = jax.random.split(key)
+    centers = jax.random.bernoulli(k1, gamma, noise_shape).astype(x.dtype)
+    pad_h, pad_w = kh // 2, kw // 2
+    block_mask = jax.lax.reduce_window(
+        centers, -jnp.inf, jax.lax.max, (1, kh, kw, 1), (1, 1, 1, 1),
+        [(0, 0), (pad_h, pad_h), (pad_w, pad_w), (0, 0)])
+    if kh % 2 == 0 or kw % 2 == 0:
+        block_mask = block_mask[:, (kh + 1) % 2:, (kw + 1) % 2:, :]
+        block_mask = block_mask[:, :H, :W, :]
+    keep_mask = 1.0 - block_mask
+
+    if with_noise:
+        noise = jax.random.normal(k2, keep_mask.shape, x.dtype) * block_mask
+        return x * keep_mask + noise
+    if scale_by_keep:
+        scale = keep_mask.size / (keep_mask.astype(jnp.float32).sum() + 1e-7)
+        keep_mask = keep_mask * scale.astype(x.dtype)
+    return x * keep_mask
+
+
+class DropBlock2d(nnx.Module):
+    """DropBlock regularizer module (reference drop.py:~103)."""
+
+    def __init__(
+            self,
+            drop_prob: float = 0.1,
+            block_size: int = 7,
+            gamma_scale: float = 1.0,
+            with_noise: bool = False,
+            inplace: bool = False,  # parity arg; jax arrays are immutable
+            couple_channels: bool = True,
+            scale_by_keep: bool = True,
+            *,
+            rngs: Optional[nnx.Rngs] = None,
+    ):
+        self.drop_prob = float(drop_prob)
+        self.block_size = block_size
+        self.gamma_scale = gamma_scale
+        self.with_noise = with_noise
+        self.couple_channels = couple_channels
+        self.scale_by_keep = scale_by_keep
+        self.deterministic = False
+        self.rngs = rngs.fork() if rngs is not None and self.drop_prob > 0.0 else None
+
+    def __call__(self, x):
+        if self.deterministic or self.drop_prob == 0.0 or self.rngs is None:
+            return x
+        return drop_block_2d(
+            x, self.rngs.dropout(), self.drop_prob, self.block_size, self.gamma_scale,
+            self.with_noise, self.couple_channels, self.scale_by_keep)

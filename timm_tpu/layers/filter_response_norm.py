@@ -1,0 +1,59 @@
+"""Filter Response Normalization (reference: timm/layers/filter_response_norm.py,
+arXiv:1911.09737) for NHWC features.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_act import get_act_fn
+
+__all__ = ['FilterResponseNormAct2d', 'FilterResponseNormTlu2d', 'inv_instance_rms']
+
+
+def inv_instance_rms(x, eps: float = 1e-5):
+    """1/rms over the spatial dims, per sample per channel."""
+    r = jnp.square(x.astype(jnp.float32)).mean(axis=(1, 2), keepdims=True)
+    return ((r + eps) ** -0.5).astype(x.dtype)
+
+
+class FilterResponseNormTlu2d(nnx.Module):
+    """FRN + thresholded linear unit (tau) activation (reference :21-55)."""
+
+    def __init__(self, num_features: int, apply_act: bool = True, eps: float = 1e-5,
+                 rms: bool = True, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs, **_):
+        self.apply_act = apply_act
+        self.rms = rms
+        self.eps = eps
+        self.weight = nnx.Param(jnp.ones((num_features,), param_dtype))
+        self.bias = nnx.Param(jnp.zeros((num_features,), param_dtype))
+        self.tau = nnx.Param(jnp.zeros((num_features,), param_dtype)) if apply_act else None
+
+    def __call__(self, x):
+        dt = x.dtype
+        x = x * inv_instance_rms(x, self.eps)
+        x = x * self.weight[...].astype(dt) + self.bias[...].astype(dt)
+        if self.tau is not None:
+            return jnp.maximum(x, self.tau[...].astype(dt))
+        return x
+
+
+class FilterResponseNormAct2d(nnx.Module):
+    """FRN + conventional activation (reference :58-95)."""
+
+    def __init__(self, num_features: int, apply_act: bool = True, act_layer='relu',
+                 rms: bool = True, eps: float = 1e-5,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs, **_):
+        self.act = get_act_fn(act_layer) if (act_layer is not None and apply_act) else None
+        self.rms = rms
+        self.eps = eps
+        self.weight = nnx.Param(jnp.ones((num_features,), param_dtype))
+        self.bias = nnx.Param(jnp.zeros((num_features,), param_dtype))
+
+    def __call__(self, x):
+        dt = x.dtype
+        x = x * inv_instance_rms(x, self.eps)
+        x = x * self.weight[...].astype(dt) + self.bias[...].astype(dt)
+        if self.act is not None:
+            x = self.act(x)
+        return x

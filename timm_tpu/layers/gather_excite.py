@@ -1,0 +1,110 @@
+"""Gather-Excite attention over NHWC features
+(reference: timm/layers/gather_excite.py:26-105).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_act import get_act_fn
+from .create_conv2d import create_conv2d
+from .helpers import make_divisible
+from .mlp import ConvMlp
+from .norm_act import BatchNormAct2d
+
+__all__ = ['GatherExcite']
+
+
+class GatherExcite(nnx.Module):
+    """Gather (spatial aggregate) → excite (gate). `extent=0` is global."""
+
+    def __init__(
+            self,
+            channels: int,
+            feat_size: Optional[Tuple[int, int]] = None,
+            extra_params: bool = False,
+            extent: int = 0,
+            use_mlp: bool = True,
+            rd_ratio: float = 1. / 16,
+            rd_channels: Optional[int] = None,
+            rd_divisor: int = 1,
+            add_maxpool: bool = False,
+            act_layer='relu',
+            norm_layer=None,
+            gate_layer='sigmoid',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.add_maxpool = add_maxpool
+        self.extent = extent
+        self.act = get_act_fn(act_layer)
+        norm_layer = norm_layer or BatchNormAct2d
+        conv_kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        if extra_params:
+            convs, norms = [], []
+            if extent == 0:
+                assert feat_size is not None, 'spatial feature size required for global extent w/ params'
+                convs.append(create_conv2d(channels, channels, kernel_size=feat_size, depthwise=True, **conv_kw))
+                norms.append(norm_layer(channels, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs))
+            else:
+                assert extent % 2 == 0
+                for _ in range(int(math.log2(extent))):
+                    convs.append(create_conv2d(channels, channels, kernel_size=3, stride=2, **conv_kw, depthwise=True))
+                    norms.append(norm_layer(channels, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs))
+            self.gather = nnx.List(convs)
+            self.gather_norms = nnx.List(norms)
+        else:
+            self.gather = None
+            self.gather_norms = None
+            if self.extent == 0:
+                self.gk = self.gs = 0
+            else:
+                assert extent % 2 == 0
+                self.gk = self.extent * 2 - 1
+                self.gs = self.extent
+
+        if not rd_channels:
+            rd_channels = make_divisible(channels * rd_ratio, rd_divisor, round_limit=0.)
+        self.mlp = ConvMlp(channels, rd_channels, act_layer=act_layer,
+                       dtype=dtype, param_dtype=param_dtype, rngs=rngs) if use_mlp else None
+        self.gate = get_act_fn(gate_layer)
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        if self.gather is not None:
+            x_ge = x
+            n = len(self.gather)
+            for i, (conv, norm) in enumerate(zip(self.gather, self.gather_norms)):
+                x_ge = norm(conv(x_ge))
+                if i != n - 1:
+                    x_ge = self.act(x_ge)
+        elif self.extent == 0:
+            x_ge = x.mean(axis=(1, 2), keepdims=True)
+            if self.add_maxpool:
+                x_ge = 0.5 * x_ge + 0.5 * x.max(axis=(1, 2), keepdims=True)
+        else:
+            pad = self.gk // 2
+            x_ge = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, (1, self.gk, self.gk, 1), (1, self.gs, self.gs, 1),
+                [(0, 0), (pad, pad), (pad, pad), (0, 0)])
+            ones = jnp.ones((1, H, W, 1), x.dtype)
+            counts = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, (1, self.gk, self.gk, 1), (1, self.gs, self.gs, 1),
+                [(0, 0), (pad, pad), (pad, pad), (0, 0)])
+            x_ge = x_ge / counts  # count_include_pad=False
+            if self.add_maxpool:
+                x_max = jax.lax.reduce_window(
+                    x, -jnp.inf, jax.lax.max, (1, self.gk, self.gk, 1), (1, self.gs, self.gs, 1),
+                    [(0, 0), (pad, pad), (pad, pad), (0, 0)])
+                x_ge = 0.5 * x_ge + 0.5 * x_max
+        if self.mlp is not None:
+            x_ge = self.mlp(x_ge)
+        if x_ge.shape[1] != 1 or x_ge.shape[2] != 1:
+            x_ge = jax.image.resize(x_ge, (B, H, W, C), 'nearest')
+        return x * self.gate(x_ge)

@@ -1,0 +1,70 @@
+"""Global-context attention block (GCNet) over NHWC features
+(reference: timm/layers/global_context.py:21-90).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_act import get_act_fn
+from .create_conv2d import create_conv2d
+from .helpers import make_divisible
+from .mlp import ConvMlp
+from .norm import LayerNorm
+
+__all__ = ['GlobalContext']
+
+
+class GlobalContext(nnx.Module):
+    """Softmax-attention context pooling + scale/add fuse MLPs."""
+
+    def __init__(
+            self,
+            channels: int,
+            use_attn: bool = True,
+            fuse_add: bool = False,
+            fuse_scale: bool = True,
+            init_last_zero: bool = False,
+            rd_ratio: float = 1. / 8,
+            rd_channels: Optional[int] = None,
+            rd_divisor: int = 1,
+            act_layer='relu',
+            gate_layer='sigmoid',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.conv_attn = create_conv2d(
+            channels, 1, 1, bias=True, dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+        ) if use_attn else None
+        if rd_channels is None:
+            rd_channels = make_divisible(channels * rd_ratio, rd_divisor, round_limit=0.)
+        mlp_kw = dict(act_layer=act_layer, norm_layer=LayerNorm,
+                      dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.mlp_add = ConvMlp(channels, rd_channels, **mlp_kw) if fuse_add else None
+        self.mlp_scale = ConvMlp(channels, rd_channels, **mlp_kw) if fuse_scale else None
+        self.gate = get_act_fn(gate_layer)
+        if self.mlp_add is not None:
+            # additive branch starts as identity (reference reset_parameters
+            # zero-inits mlp_add.fc2 unconditionally)
+            self.mlp_add.fc2.kernel[...] = jnp.zeros_like(self.mlp_add.fc2.kernel[...])
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        if self.conv_attn is not None:
+            attn = self.conv_attn(x).reshape(B, H * W)  # (B, HW)
+            attn = jax.nn.softmax(attn, axis=-1)
+            context = jnp.einsum('bnc,bn->bc', x.reshape(B, H * W, C), attn)
+            context = context.reshape(B, 1, 1, C)
+        else:
+            context = x.mean(axis=(1, 2), keepdims=True)
+
+        if self.mlp_scale is not None:
+            x = x * self.gate(self.mlp_scale(context))
+        if self.mlp_add is not None:
+            x = x + self.mlp_add(context)
+        return x

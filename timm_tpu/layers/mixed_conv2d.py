@@ -1,0 +1,60 @@
+"""Mixed grouped convolution (MixConv, arXiv:1907.09595)
+(reference: timm/layers/mixed_conv2d.py:21-68): channel splits each get a
+different kernel size.
+"""
+from __future__ import annotations
+
+from typing import List, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_conv2d import create_conv2d
+
+__all__ = ['MixedConv2d']
+
+
+def _split_channels(num_chan: int, num_groups: int) -> List[int]:
+    split = [num_chan // num_groups for _ in range(num_groups)]
+    split[0] += num_chan - sum(split)
+    return split
+
+
+class MixedConv2d(nnx.Module):
+
+    def __init__(
+            self,
+            in_channels: int,
+            out_channels: int,
+            kernel_size: Union[int, List[int]] = 3,
+            stride: int = 1,
+            padding='',
+            dilation: int = 1,
+            depthwise: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+            **kwargs,
+    ):
+        kernel_size = kernel_size if isinstance(kernel_size, list) else [kernel_size]
+        num_groups = len(kernel_size)
+        in_splits = _split_channels(in_channels, num_groups)
+        out_splits = _split_channels(out_channels, num_groups)
+        self.in_channels = sum(in_splits)
+        self.out_channels = sum(out_splits)
+        self.convs = nnx.List([
+            create_conv2d(
+                in_ch, out_ch, k, stride=stride, padding=padding, dilation=dilation,
+                groups=in_ch if depthwise else 1,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs, **kwargs)
+            for k, in_ch, out_ch in zip(kernel_size, in_splits, out_splits)])
+        self.splits = in_splits
+
+    def __call__(self, x):
+        start = 0
+        outs = []
+        for conv, n in zip(self.convs, self.splits):
+            outs.append(conv(x[..., start:start + n]))
+            start += n
+        return jnp.concatenate(outs, axis=-1)

@@ -1,9 +1,9 @@
 """MLP blocks (reference: timm/layers/mlp.py:1-290).
 
 All variants operate on channels-last inputs of any rank — the same module
-serves transformer tokens (B, N, C) and NHWC conv features (B, H, W, C), which
-is why the reference's ConvMlp has no separate implementation here (a 1x1 conv
-over NHWC *is* a Linear on the last axis).
+serves transformer tokens (B, N, C) and NHWC conv features (B, H, W, C); a
+1x1 conv over NHWC *is* a Linear on the last axis. `ConvMlp` still exists as
+its own class because its op order differs (norm *before* act, relu default).
 """
 from __future__ import annotations
 
@@ -70,7 +70,45 @@ class Mlp(nnx.Module):
         return x
 
 
-ConvMlp = Mlp  # NHWC 1x1-conv MLP == Linear on last axis (see module docstring)
+class ConvMlp(nnx.Module):
+    """fc1 → norm → act → drop → fc2 (reference mlp.py:215-248). The 1x1 convs
+    collapse to Linear on the trailing axis in NHWC; note the norm sits
+    *before* the activation, unlike `Mlp`."""
+
+    def __init__(
+            self,
+            in_features: int,
+            hidden_features: Optional[int] = None,
+            out_features: Optional[int] = None,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Optional[Callable] = None,
+            bias: Union[bool, tuple] = True,
+            drop: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        out_features = out_features or in_features
+        hidden_features = hidden_features or in_features
+        bias = to_2tuple(bias)
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs,
+        )
+        self.fc1 = linear(in_features, hidden_features, use_bias=bias[0])
+        self.norm = norm_layer(hidden_features, rngs=rngs) if norm_layer is not None else None
+        self.act = get_act_fn(act_layer)
+        self.drop = Dropout(drop, rngs=rngs)
+        self.fc2 = linear(hidden_features, out_features, use_bias=bias[1])
+
+    def __call__(self, x):
+        x = self.fc1(x)
+        if self.norm is not None:
+            x = self.norm(x)
+        x = self.act(x)
+        x = self.drop(x)
+        return self.fc2(x)
 
 
 class GluMlp(nnx.Module):

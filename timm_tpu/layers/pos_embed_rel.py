@@ -1,0 +1,184 @@
+"""Relative position bias tables (reference: timm/layers/pos_embed_rel.py).
+
+TPU-first design notes: the relative-position *index* is a trace-time
+constant (numpy, computed once at module build), so the bias lookup lowers
+to a single static gather that XLA folds into the attention fusion. The
+*table* is the only learnable state. Swin-V2-style log-CPB (`RelPosMlp`)
+keeps the log-coordinate grid static as well and runs the tiny MLP on it
+per forward (cheap: (2W-1)^2 x heads).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from .mlp import Mlp
+from .weight_init import trunc_normal_
+
+__all__ = [
+    'gen_relative_position_index', 'gen_relative_log_coords', 'RelPosBias', 'RelPosMlp',
+    'resize_rel_pos_bias_table_simple',
+]
+
+
+def gen_relative_position_index(
+        q_size: Tuple[int, int],
+        k_size: Optional[Tuple[int, int]] = None,
+        class_token: bool = False,
+) -> np.ndarray:
+    """Pairwise relative position index for tokens in a (h, w) window
+    (reference pos_embed_rel.py:21-75). With `class_token`, rows/cols 0 get
+    the three extra BEiT cls bucket ids."""
+    assert k_size is None, 'q/k size mismatch not supported'
+    h, w = q_size
+    coords = np.stack(np.meshgrid(np.arange(h), np.arange(w), indexing='ij')).reshape(2, -1)
+    rel = coords[:, :, None] - coords[:, None, :]  # (2, N, N)
+    rel = rel.transpose(1, 2, 0).astype(np.int64)  # (N, N, 2)
+    rel[:, :, 0] += h - 1
+    rel[:, :, 1] += w - 1
+    rel[:, :, 0] *= 2 * w - 1
+    num_rel_dist = (2 * h - 1) * (2 * w - 1)
+    index = rel.sum(-1)  # (N, N)
+    if class_token:
+        index = np.pad(index, ((1, 0), (1, 0)))
+        index[0, :] = num_rel_dist
+        index[:, 0] = num_rel_dist + 1
+        index[0, 0] = num_rel_dist + 2
+    return index
+
+
+def resize_rel_pos_bias_table_simple(table: np.ndarray, new_window_size: Tuple[int, int],
+                                     new_bias_shape: Tuple[int, ...]) -> np.ndarray:
+    """Bilinear resize of a (L, H) rel-pos table to a new window size,
+    preserving trailing cls-token buckets (reference pos_embed_rel.py:77-121)."""
+    dst_h, dst_w = 2 * new_window_size[0] - 1, 2 * new_window_size[1] - 1
+    num_extra = new_bias_shape[0] - dst_h * dst_w
+    src_len = table.shape[0] - num_extra
+    src_size = int(math.sqrt(src_len))
+    if src_size * src_size != src_len:
+        return table  # non-square source; give up
+    extra = table[src_len:] if num_extra > 0 else None
+    core = table[:src_len].reshape(src_size, src_size, -1)
+    core = jax.image.resize(jnp.asarray(core), (dst_h, dst_w, core.shape[-1]), 'bilinear')
+    core = np.asarray(core).reshape(dst_h * dst_w, -1)
+    if extra is not None:
+        core = np.concatenate([core, extra], axis=0)
+    return core
+
+
+class RelPosBias(nnx.Module):
+    """Swin-V1 style learned relative position bias
+    (reference pos_embed_rel.py:272-331)."""
+
+    def __init__(
+            self,
+            window_size: Tuple[int, int],
+            num_heads: int,
+            prefix_tokens: int = 0,
+            *,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert prefix_tokens <= 1
+        self.window_size = window_size
+        self.window_area = window_size[0] * window_size[1]
+        self.num_heads = num_heads
+        self.prefix_tokens = prefix_tokens
+        self.bias_shape = (self.window_area + prefix_tokens,) * 2 + (num_heads,)
+        num_rel_dist = (2 * window_size[0] - 1) * (2 * window_size[1] - 1) + 3 * prefix_tokens
+        self.relative_position_bias_table = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (num_rel_dist, num_heads), param_dtype))
+        self._index = jnp.asarray(gen_relative_position_index(
+            window_size, class_token=prefix_tokens > 0).reshape(-1))
+
+    def get_bias(self) -> jax.Array:
+        bias = self.relative_position_bias_table[...][self._index]
+        bias = bias.reshape(self.bias_shape).transpose(2, 0, 1)  # (H, N, N)
+        return bias[None]
+
+    def __call__(self, attn, shared_rel_pos=None):
+        return attn + self.get_bias().astype(attn.dtype)
+
+
+def gen_relative_log_coords(
+        win_size: Tuple[int, int],
+        pretrained_win_size: Tuple[int, int] = (0, 0),
+        mode: str = 'swin',
+) -> np.ndarray:
+    """Log-spaced relative coordinate grid for MLP-CPB
+    (reference pos_embed_rel.py:334-363; Swin-V2 §: log-CPB)."""
+    assert mode in ('swin', 'cr')
+    h, w = win_size
+    rel_h = np.arange(-(h - 1), h, dtype=np.float32)
+    rel_w = np.arange(-(w - 1), w, dtype=np.float32)
+    coords = np.stack(np.meshgrid(rel_h, rel_w, indexing='ij'), axis=-1)  # (2h-1, 2w-1, 2)
+    if mode == 'swin':
+        if pretrained_win_size[0] > 0:
+            coords[:, :, 0] /= pretrained_win_size[0] - 1
+            coords[:, :, 1] /= pretrained_win_size[1] - 1
+        else:
+            coords[:, :, 0] /= h - 1
+            coords[:, :, 1] /= w - 1
+        coords *= 8  # normalize to -8..8
+        coords = np.sign(coords) * np.log2(1.0 + np.abs(coords)) / np.log2(8)
+    else:  # swin-v2-cr: unscaled natural log
+        coords = np.sign(coords) * np.log(1.0 + np.abs(coords))
+    return coords
+
+
+class RelPosMlp(nnx.Module):
+    """MLP-based continuous relative position bias (Swin-V2 log-CPB;
+    reference pos_embed_rel.py:365-465)."""
+
+    def __init__(
+            self,
+            window_size: Tuple[int, int],
+            num_heads: int = 8,
+            hidden_dim: int = 128,
+            prefix_tokens: int = 0,
+            mode: str = 'cr',
+            pretrained_window_size: Tuple[int, int] = (0, 0),
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.window_size = window_size
+        self.window_area = window_size[0] * window_size[1]
+        self.prefix_tokens = prefix_tokens
+        self.num_heads = num_heads
+        self.bias_shape = (self.window_area,) * 2 + (num_heads,)
+        if mode == 'swin':
+            self.bias_act = 'sigmoid'
+            self.bias_gain = 16.0
+            mlp_bias = (True, False)
+        else:
+            self.bias_act = None
+            self.bias_gain = None
+            mlp_bias = True
+        self.mlp = Mlp(
+            2, hidden_features=hidden_dim, out_features=num_heads, act_layer='relu',
+            bias=mlp_bias, drop=(0.125, 0.0), dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self._index = jnp.asarray(gen_relative_position_index(window_size).reshape(-1))
+        self._log_coords = jnp.asarray(gen_relative_log_coords(
+            window_size, pretrained_window_size, mode=mode))
+
+    def get_bias(self) -> jax.Array:
+        bias = self.mlp(self._log_coords)  # (2h-1, 2w-1, heads)
+        bias = bias.reshape(-1, self.num_heads)[self._index]
+        bias = bias.reshape(self.bias_shape).transpose(2, 0, 1)
+        if self.bias_act == 'sigmoid':
+            bias = jax.nn.sigmoid(bias)
+        if self.bias_gain is not None:
+            bias = self.bias_gain * bias
+        if self.prefix_tokens:
+            bias = jnp.pad(bias, ((0, 0), (self.prefix_tokens, 0), (self.prefix_tokens, 0)))
+        return bias[None]
+
+    def __call__(self, attn, shared_rel_pos=None):
+        return attn + self.get_bias().astype(attn.dtype)

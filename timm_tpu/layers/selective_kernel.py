@@ -1,0 +1,127 @@
+"""Selective-kernel convolution (SKNet) over NHWC features
+(reference: timm/layers/selective_kernel.py:24-160).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_act import get_act_fn
+from .create_conv2d import ConvNormAct, create_conv2d
+from .helpers import make_divisible
+from .norm_act import BatchNormAct2d
+
+__all__ = ['SelectiveKernelAttn', 'SelectiveKernel']
+
+
+def _kernel_valid(k):
+    if isinstance(k, (list, tuple)):
+        for ki in k:
+            _kernel_valid(ki)
+        return
+    assert k >= 3 and k % 2
+
+
+class SelectiveKernelAttn(nnx.Module):
+    """Per-path channel attention: softmax over paths (reference :24-59)."""
+
+    def __init__(
+            self,
+            channels: int,
+            num_paths: int = 2,
+            attn_channels: int = 32,
+            act_layer='relu',
+            norm_layer=None,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.num_paths = num_paths
+        conv_kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.fc_reduce = create_conv2d(channels, attn_channels, 1, bias=False, **conv_kw)
+        norm_layer = norm_layer or BatchNormAct2d
+        self.bn = norm_layer(attn_channels, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.act = get_act_fn(act_layer)
+        self.fc_select = create_conv2d(attn_channels, channels * num_paths, 1, bias=False, **conv_kw)
+
+    def __call__(self, x):
+        # x: (B, P, H, W, C)
+        assert x.shape[1] == self.num_paths
+        s = x.sum(axis=1).mean(axis=(1, 2), keepdims=True)  # (B, 1, 1, C)
+        s = self.act(self.bn(self.fc_reduce(s)))
+        s = self.fc_select(s)  # (B, 1, 1, C*P)
+        B = s.shape[0]
+        s = s.reshape(B, 1, 1, self.num_paths, -1).transpose(0, 3, 1, 2, 4)  # (B, P, 1, 1, C)
+        return jax.nn.softmax(s, axis=1)
+
+
+class SelectiveKernel(nnx.Module):
+    """Multi-kernel-size conv paths merged by learned attention
+    (reference :61-160; 5x5 becomes dilated 3x3 with keep_3x3)."""
+
+    def __init__(
+            self,
+            in_channels: int,
+            out_channels: Optional[int] = None,
+            kernel_size: Optional[Union[int, List[int]]] = None,
+            stride: int = 1,
+            dilation: int = 1,
+            groups: int = 1,
+            rd_ratio: float = 1. / 16,
+            rd_channels: Optional[int] = None,
+            rd_divisor: int = 8,
+            keep_3x3: bool = True,
+            split_input: bool = True,
+            act_layer='relu',
+            norm_layer=None,
+            aa_layer=None,
+            drop_layer=None,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        out_channels = out_channels or in_channels
+        kernel_size = kernel_size or [3, 5]
+        _kernel_valid(kernel_size)
+        if not isinstance(kernel_size, list):
+            kernel_size = [kernel_size] * 2
+        if keep_3x3:
+            dilation = [dilation * (k - 1) // 2 for k in kernel_size]
+            kernel_size = [3] * len(kernel_size)
+        else:
+            dilation = [dilation] * len(kernel_size)
+        self.num_paths = len(kernel_size)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.split_input = split_input
+        if self.split_input:
+            assert in_channels % self.num_paths == 0
+            in_channels = in_channels // self.num_paths
+        groups = min(out_channels, groups)
+
+        self.paths = nnx.List([
+            ConvNormAct(
+                in_channels, out_channels, kernel_size=k, stride=stride, dilation=d,
+                groups=groups, act_layer=act_layer, norm_layer=norm_layer,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            for k, d in zip(kernel_size, dilation)])
+
+        attn_channels = rd_channels or make_divisible(out_channels * rd_ratio, divisor=rd_divisor)
+        self.attn = SelectiveKernelAttn(
+            out_channels, self.num_paths, attn_channels,
+            act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        if self.split_input:
+            splits = jnp.split(x, self.num_paths, axis=-1)
+            x_paths = [op(splits[i]) for i, op in enumerate(self.paths)]
+        else:
+            x_paths = [op(x) for op in self.paths]
+        x = jnp.stack(x_paths, axis=1)  # (B, P, H, W, C)
+        x = x * self.attn(x)
+        return x.sum(axis=1)

@@ -1,0 +1,105 @@
+"""Split attention (ResNeSt 'splat') over NHWC features
+(reference: timm/layers/split_attn.py:18-112).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_act import get_act_fn
+from .create_conv2d import create_conv2d
+from .helpers import make_divisible
+from .norm_act import BatchNormAct2d
+
+__all__ = ['RadixSoftmax', 'SplitAttn']
+
+
+def radix_softmax(x, radix: int, cardinality: int):
+    """Softmax across the radix axis per (cardinality) group; sigmoid at radix 1
+    (reference split_attn.py:18-32). x: (B, 1, 1, C*radix) → (B, C*radix)."""
+    B = x.shape[0]
+    if radix > 1:
+        # radix-major flatten (reference transposes (card, radix) → (radix, card)
+        # before flattening) so the caller's (B, radix, C) reshape aligns
+        x = x.reshape(B, cardinality, radix, -1)
+        x = jax.nn.softmax(x, axis=2)
+        return x.transpose(0, 2, 1, 3).reshape(B, -1)
+    return jax.nn.sigmoid(x.reshape(B, -1))
+
+
+RadixSoftmax = radix_softmax
+
+
+class SplitAttn(nnx.Module):
+    """Radix-grouped conv with learned soft attention over the radix splits."""
+
+    def __init__(
+            self,
+            in_channels: int,
+            out_channels: Optional[int] = None,
+            kernel_size: int = 3,
+            stride: int = 1,
+            padding=None,
+            dilation: int = 1,
+            groups: int = 1,
+            bias: bool = False,
+            radix: int = 2,
+            rd_ratio: float = 0.25,
+            rd_channels: Optional[int] = None,
+            rd_divisor: int = 8,
+            act_layer='relu',
+            norm_layer=None,
+            drop_layer=None,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        out_channels = out_channels or in_channels
+        self.radix = radix
+        self.cardinality = groups
+        self.out_channels = out_channels
+        mid_chs = out_channels * radix
+        if rd_channels is None:
+            attn_chs = make_divisible(in_channels * radix * rd_ratio, divisor=rd_divisor, min_value=32)
+        else:
+            attn_chs = rd_channels * radix
+
+        conv_kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv = create_conv2d(
+            in_channels, mid_chs, kernel_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups * radix, bias=bias, **conv_kw)
+        norm_layer = norm_layer or BatchNormAct2d
+        self.bn0 = norm_layer(mid_chs, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop = drop_layer(rngs=rngs) if drop_layer is not None else None
+        self.act0 = get_act_fn(act_layer)
+        self.fc1 = create_conv2d(out_channels, attn_chs, 1, groups=groups, bias=True, **conv_kw)
+        self.bn1 = norm_layer(attn_chs, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.act1 = get_act_fn(act_layer)
+        self.fc2 = create_conv2d(attn_chs, mid_chs, 1, groups=groups, bias=True, **conv_kw)
+
+    def __call__(self, x):
+        x = self.conv(x)
+        x = self.bn0(x)
+        if self.drop is not None:
+            x = self.drop(x)
+        x = self.act0(x)
+
+        B, H, W, RC = x.shape
+        if self.radix > 1:
+            xr = x.reshape(B, H, W, self.radix, RC // self.radix)
+            x_gap = xr.sum(axis=3)
+        else:
+            x_gap = x
+        x_gap = x_gap.mean(axis=(1, 2), keepdims=True)
+        x_gap = self.act1(self.bn1(self.fc1(x_gap)))
+        x_attn = self.fc2(x_gap)  # (B, 1, 1, RC)
+
+        x_attn = radix_softmax(x_attn, self.radix, self.cardinality)  # (B, RC)
+        if self.radix > 1:
+            attn = x_attn.reshape(B, 1, 1, self.radix, RC // self.radix)
+            return (xr * attn).sum(axis=3)
+        return x * x_attn.reshape(B, 1, 1, RC)

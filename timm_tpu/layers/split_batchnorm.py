@@ -1,0 +1,96 @@
+"""Split ('auxiliary') BatchNorm for aug-split training
+(reference: timm/layers/split_batchnorm.py:19-87, AdvProp §4.2).
+
+The batch is split into `num_splits` equal parts along the batch axis; the
+first (clean) split flows through the primary BN statistics, the remaining
+(augmented) splits each keep their own aux statistics. At eval time only the
+primary statistics are used — so the aux layers can simply be dropped for
+deployment.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .norm import BatchNorm2d
+from .norm_act import BatchNormAct2d
+
+__all__ = ['SplitBatchNorm2d', 'SplitBatchNormAct2d', 'convert_splitbn_model']
+
+
+class SplitBatchNormAct2d(BatchNormAct2d):
+    """BatchNormAct2d whose train-mode statistics are computed per batch split."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 apply_act=True, act_layer='relu', num_splits=2,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        assert num_splits > 1, 'Should have at least one aux BN layer (num_splits at least 2)'
+        super().__init__(
+            num_features, eps=eps, momentum=momentum, affine=affine,
+            apply_act=apply_act, act_layer=act_layer,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.num_splits = num_splits
+        self.aux_bn = nnx.List([
+            BatchNorm2d(num_features, eps=eps, momentum=momentum, affine=affine,
+                        dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            for _ in range(num_splits - 1)])
+
+    def __call__(self, x):
+        if not self.use_running_average:  # training: per-split statistics
+            split = x.shape[0] // self.num_splits
+            assert x.shape[0] == split * self.num_splits, \
+                'batch size must be evenly divisible by num_splits'
+            outs = [nnx.BatchNorm.__call__(self, x[:split])]
+            for i, aux in enumerate(self.aux_bn):
+                outs.append(aux(x[(i + 1) * split:(i + 2) * split]))
+            x = jnp.concatenate(outs, axis=0)
+        else:
+            x = nnx.BatchNorm.__call__(self, x)
+        if self.drop is not None:
+            x = self.drop(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+SplitBatchNorm2d = SplitBatchNormAct2d
+
+
+def convert_splitbn_model(module: nnx.Module, num_splits: int = 2) -> nnx.Module:
+    """Recursively replace BatchNorm(Act)2d with SplitBatchNormAct2d,
+    copying affine params + running stats into the primary and every aux BN
+    (reference split_batchnorm.py:54-87). In-place on the module tree."""
+
+    def _convert_one(bn):
+        new = SplitBatchNormAct2d(
+            bn.num_features, eps=bn.epsilon, momentum=1.0 - bn.momentum,
+            num_splits=num_splits, rngs=nnx.Rngs(0))
+        new.act = getattr(bn, 'act', None)
+        new.drop = getattr(bn, 'drop', None)
+        for tgt in [new] + list(new.aux_bn):
+            if bn.scale is not None and tgt.scale is not None:
+                tgt.scale[...] = bn.scale[...]
+                tgt.bias[...] = bn.bias[...]
+            tgt.mean[...] = bn.mean[...]
+            tgt.var[...] = bn.var[...]
+        new.use_running_average = bn.use_running_average
+        return new
+
+    def _walk(m):
+        for name, child in list(vars(m).items()):
+            if isinstance(child, SplitBatchNormAct2d):
+                continue
+            if isinstance(child, nnx.BatchNorm):
+                setattr(m, name, _convert_one(child))
+            elif isinstance(child, nnx.List):
+                for i, item in enumerate(child):
+                    if isinstance(item, SplitBatchNormAct2d):
+                        continue
+                    if isinstance(item, nnx.BatchNorm):
+                        child[i] = _convert_one(item)
+                    elif isinstance(item, nnx.Module):
+                        _walk(item)
+            elif isinstance(child, nnx.Module):
+                _walk(child)
+    _walk(module)
+    return module

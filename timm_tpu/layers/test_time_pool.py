@@ -1,0 +1,63 @@
+"""Test-time average-max pooling head (reference: timm/layers/test_time_pool.py).
+
+When eval resolution exceeds the pretrained train resolution, pool the larger
+feature map with the *original* pool window (stride 1), classify each window,
+then avg+max pool the per-window logits.
+"""
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ['TestTimePoolHead', 'apply_test_time_pool']
+
+
+class TestTimePoolHead(nnx.Module):
+    """Wraps a model; `original_pool` is the pretrained pool window."""
+
+    def __init__(self, base: nnx.Module, original_pool=7):
+        self.base = base
+        self.original_pool = (original_pool, original_pool) if isinstance(original_pool, int) \
+            else tuple(original_pool)
+        self.num_classes = base.num_classes
+        # reuse the trained classifier weights directly (reference copies them
+        # into a 1x1 conv; NHWC makes the Linear directly applicable)
+        self.fc = base.get_classifier()
+
+    def __call__(self, x):
+        x = self.base.forward_features(x)  # (B, H, W, C) for conv nets
+        if x.ndim == 3:  # (B, N, C) token models: plain masked-free mean+max
+            logits = self.fc(x)
+            return 0.5 * (logits.mean(axis=1) + logits.max(axis=1))
+        ph, pw = self.original_pool
+        x = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, (1, ph, pw, 1), (1, 1, 1, 1), 'VALID') / (ph * pw)
+        logits = self.fc(x)  # (B, h', w', num_classes)
+        return 0.5 * (logits.mean(axis=(1, 2)) + logits.max(axis=(1, 2)))
+
+    def forward_features(self, x):
+        return self.base.forward_features(x)
+
+
+def apply_test_time_pool(model, config, use_test_size: bool = False):
+    """Enable TTA pooling when the eval input size exceeds the pretrained
+    default (reference test_time_pool.py:39-52)."""
+    if not getattr(model, 'pretrained_cfg', None):
+        return model, False
+    cfg = model.pretrained_cfg
+    get = (lambda k, d=None: cfg.get(k, d)) if isinstance(cfg, dict) else (lambda k, d=None: getattr(cfg, k, d))
+    df_input_size = (get('test_input_size') if use_test_size else None) or get('input_size')
+    pool_size = get('pool_size')
+    if df_input_size is None or pool_size is None:
+        return model, False
+    if config['input_size'][-1] > df_input_size[-1] and config['input_size'][-2] > df_input_size[-2]:
+        _logger.info(
+            f'Target input size {config["input_size"][-2:]} > pretrained default '
+            f'{df_input_size[-2:]}, using test time pooling')
+        return TestTimePoolHead(model, original_pool=pool_size), True
+    return model, False

@@ -259,6 +259,24 @@ def test_selective_kernel():
     assert m(x).shape == (2, 8, 8, 16)
 
 
+def test_selective_kernel_aa_drop_wired():
+    from timm_tpu.layers import BlurPool2d, SelectiveKernel
+    from timm_tpu.layers.drop import Dropout
+    import functools
+    m = SelectiveKernel(
+        16, 16, stride=2, split_input=False,
+        aa_layer=BlurPool2d,
+        drop_layer=functools.partial(Dropout, 0.5, rngs=nnx.Rngs(7)),
+        rngs=nnx.Rngs(0))
+    # aa pool must actually be attached (conv strides 1, aa strides 2)
+    assert all(p.aa is not None for p in m.paths)
+    assert all(p.conv.strides == (1, 1) for p in m.paths)
+    assert all(p.bn.drop is not None for p in m.paths)
+    m.eval()
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 16), jnp.float32)
+    assert m(x).shape == (2, 4, 4, 16)
+
+
 def test_gather_excite_and_global_context():
     from timm_tpu.layers import GatherExcite, GlobalContext
     x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 16), jnp.float32)
@@ -324,6 +342,16 @@ def test_split_batchnorm_distinct_stats():
     assert found, 'no BN layers converted'
 
 
+def test_split_batchnorm_plain_no_act():
+    from timm_tpu.layers import SplitBatchNorm2d
+    m = SplitBatchNorm2d(8, num_splits=2, rngs=nnx.Rngs(0))
+    m.train()
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 4, 4, 8), jnp.float32)
+    y = m(x)
+    # plain BN: negative outputs survive (no hidden relu)
+    assert float(y.min()) < 0.0
+
+
 def test_filter_response_norm():
     from timm_tpu.layers import FilterResponseNormAct2d, FilterResponseNormTlu2d
     x = jnp.asarray(np.random.RandomState(0).rand(2, 6, 6, 8) * 3, jnp.float32)
@@ -343,6 +371,12 @@ def test_cond_conv2d_routing():
     # different routing → different outputs for the same input
     r_b = jax.nn.softmax(jnp.asarray([[0, 0, 1.0, 0], [0, 0, 0, 1.0]]) * 10)
     assert not np.allclose(np.asarray(y), np.asarray(m(x, r_b)))
+    # padding=None resolves like create_conv2d (same-when-stride-1), and
+    # unknown strings raise instead of silently meaning VALID
+    m2 = CondConv2d(8, 16, 3, padding=None, rngs=nnx.Rngs(0))
+    assert m2(x, r_a[:, :4]).shape == (2, 8, 8, 16)
+    with pytest.raises(ValueError):
+        CondConv2d(8, 16, 3, padding='samee', rngs=nnx.Rngs(0))
 
 
 def test_mixed_conv2d():

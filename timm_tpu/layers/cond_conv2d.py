@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 from flax import nnx
 
+from .create_conv2d import _resolve_padding
 from .helpers import to_2tuple
 
 __all__ = ['CondConv2d', 'get_condconv_initializer']
@@ -59,11 +60,7 @@ class CondConv2d(nnx.Module):
         self.groups = groups
         self.num_experts = num_experts
         self.dtype = dtype
-        if isinstance(padding, str):
-            self.padding = 'SAME' if padding.lower() in ('same', '') else 'VALID'
-        else:
-            p = to_2tuple(padding)
-            self.padding = [(p[0], p[0]), (p[1], p[1])]
+        self.padding = _resolve_padding(padding, self.kernel_size, stride, dilation)
         # HWIO expert kernel shape (flax conv convention)
         self.weight_shape = self.kernel_size + (in_channels // groups, out_channels)
         fan_in = math.prod(self.weight_shape[:-1])

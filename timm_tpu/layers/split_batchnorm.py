@@ -53,7 +53,16 @@ class SplitBatchNormAct2d(BatchNormAct2d):
         return x
 
 
-SplitBatchNorm2d = SplitBatchNormAct2d
+class SplitBatchNorm2d(SplitBatchNormAct2d):
+    """Plain split BN — no activation, matching the reference class of this
+    name (split_batchnorm.py:19)."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 num_splits=2, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        super().__init__(
+            num_features, eps=eps, momentum=momentum, affine=affine,
+            apply_act=False, num_splits=num_splits,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
 
 
 def convert_splitbn_model(module: nnx.Module, num_splits: int = 2) -> nnx.Module:

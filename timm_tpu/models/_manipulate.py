@@ -109,15 +109,20 @@ def _run_modules(modules, x):
     return x
 
 
-def checkpoint_seq(functions, x, every: int = 1, flatten: bool = False, skip_last: bool = False):
+def checkpoint_seq(functions, x, every: int = 1, flatten: bool = False, skip_last: bool = False,
+                   policy=None):
     """Apply a sequence of nnx modules with rematerialisation every `every`
     modules (reference _manipulate.py:213 checkpoint_seq). Trades recompute
     for HBM — the TPU equivalent of torch activation checkpointing.
+
+    `policy` is a `jax.checkpoint_policies` predicate (e.g. ``dots_saveable``)
+    selecting which intermediates are saved vs recomputed in the backward pass;
+    None = save nothing (maximum memory saving, maximum recompute).
     """
     from flax import nnx
     functions = list(functions)
     end = len(functions) - 1 if skip_last else len(functions)
-    remat_run = nnx.remat(_run_modules)
+    remat_run = nnx.remat(_run_modules, policy=policy)
     idx = 0
     while idx < end:
         chunk = tuple(functions[idx:min(idx + every, end)])

@@ -106,7 +106,13 @@ def compare(model_name: str, img_size: 'int | None' = None) -> float:
     with torch.no_grad():
         ref_out = tm(torch.from_numpy(x)).numpy()
     our_out = np.asarray(m(jnp.asarray(x.transpose(0, 2, 3, 1))))
-    return float(np.abs(ref_out - our_out).max())
+    # scale-aware ONLY for pathological magnitudes: multi-branch nets
+    # (e.g. MobileOne) explode at random init with logits of ~1e14, making
+    # absolute error meaningless. Ordinary models (|logits| < 1e3) keep the
+    # strict absolute gate.
+    scale = float(np.abs(ref_out).max())
+    scale = scale if scale > 1e3 else 1.0
+    return float(np.abs(ref_out - our_out).max() / scale)
 
 
 def main(models, tol: float = 2e-3):

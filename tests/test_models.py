@@ -19,6 +19,8 @@ FAST_FILTERS = [
 EXCLUDE_FILTERS = [
     '*_large*', '*_huge*', '*so400m*', '*_384', '*_giant*', '*_gigantic*', '*_xlarge*',
     'resnet101*', 'resnet152*', 'wide_resnet*', 'efficientnetv2_m*', 'mixer_l*',
+    '*x4_clip*', '*x16_clip*', '*x64_clip*', 'repvgg_d2se', 'repvgg_b3*',
+    'bat_*',  # BAT bilinear attn needs 256px inputs (block_size 8 divisibility)
 ]
 TEST_MODELS = list_models(filter=FAST_FILTERS)
 ALL_MODELS = list_models(exclude_filters=EXCLUDE_FILTERS)
@@ -190,3 +192,39 @@ def test_torch_checkpoint_conversion():
     assert out['patch_embed.proj.kernel'].shape == (16, 16, 3, 64)
     assert 'norm.scale' in out
     assert 'bn.mean' in out
+
+
+@pytest.mark.base
+def test_byobnet_reparameterize_matches():
+    """RepVGG/MobileOne branch fusion must be numerically transparent."""
+    from timm_tpu.utils import reparameterize_model
+    x = jnp.asarray(np.random.RandomState(0).rand(1, 64, 64, 3), jnp.float32)
+    for name in ('repvgg_a0', 'mobileone_s0'):
+        m = timm_tpu.create_model(name, num_classes=10)
+        m.train()
+        _ = m(x + 0.3)  # populate BN running stats with non-trivial values
+        m.eval()
+        before = np.asarray(m(x))
+        reparameterize_model(m)
+        after = np.asarray(m(x))
+        rel = np.abs(before - after).max() / max(1.0, np.abs(before).max())
+        assert rel < 1e-5, (name, rel)
+
+
+@pytest.mark.base
+def test_byobnet_head_types():
+    """attn_abs / attn_rot / mlp heads produce correctly-shaped outputs."""
+    from timm_tpu.models.byobnet import ByoBlockCfg, ByoModelCfg, ByobNet
+    cfg = ByoModelCfg(
+        blocks=(ByoBlockCfg(type='basic', d=1, c=32, s=2),),
+        stem_chs=16, stem_pool='',
+    )
+    x = jnp.asarray(np.random.rand(2, 64, 64, 3), jnp.float32)
+    from dataclasses import replace as dc_replace
+    for head_type, kw in (('classifier', {}), ('mlp', dict(head_hidden_size=24)),
+                          ('attn_abs', dict(head_hidden_size=64)), ('attn_rot', dict(head_hidden_size=64))):
+        m = ByobNet(dc_replace(cfg, head_type=head_type, **kw), num_classes=10, img_size=64, rngs=nnx.Rngs(0))
+        m.eval()
+        assert m(x).shape == (2, 10), head_type
+        pre = m.forward_head(m.forward_features(x), pre_logits=True)
+        assert pre.ndim == 2 and pre.shape[0] == 2, head_type

@@ -1,17 +1,17 @@
 from .attention import Attention, AttentionRope, maybe_add_mask, scaled_dot_product_attention
-from .attention_pool import AttentionPoolLatent
+from .attention_pool import AttentionPool2d, AttentionPoolLatent, RotAttentionPool2d
 from .classifier import ClassifierHead, NormMlpClassifierHead, create_classifier
 from .config import (
     is_exportable, is_scriptable, set_exportable, set_scriptable,
     set_fused_attn, use_fused_attn,
 )
-from .blur_pool import BlurPool2d
+from .blur_pool import AvgPool2dAA, BlurPool2d, get_aa_layer
 from .cbam import CbamModule, LightCbamModule
 from .create_act import create_act_layer, get_act_fn, get_act_layer
 from .create_attn import create_attn, get_attn
 from .diff_attention import DiffAttention
 from .eca import CecaModule, EcaModule
-from .evo_norm import EvoNorm2dB0, EvoNorm2dS0
+from .evo_norm import EvoNorm2dB0, EvoNorm2dS0, EvoNorm2dS0a
 from .std_conv import ScaledStdConv2d, StdConv2d
 from .create_conv2d import ConvNormAct, create_conv2d, get_padding
 from .cond_conv2d import CondConv2d, get_condconv_initializer
@@ -24,13 +24,14 @@ from .helpers import extend_tuple, make_divisible, to_1tuple, to_2tuple, to_3tup
 from .layer_scale import LayerScale, LayerScale2d
 from .mixed_conv2d import MixedConv2d
 from .mlp import ConvMlp, GatedMlp, GlobalResponseNorm, GlobalResponseNormMlp, GluMlp, Mlp, SwiGLU, SwiGLUPacked
+from .non_local_attn import BatNonLocalAttn, BilinearAttnTransform, NonLocalAttn
 from .norm import (
     BatchNorm2d, GroupNorm, GroupNorm1, LayerNorm, LayerNorm2d, LayerNormFp32,
     RmsNorm, RmsNorm2d, SimpleNorm, SimpleNorm2d,
 )
 from .norm_act import (
     BatchNormAct2d, FrozenBatchNormAct2d, GroupNorm1Act, GroupNormAct,
-    LayerNormAct, LayerNormAct2d,
+    LayerNormAct, LayerNormAct2d, get_norm_act_layer,
 )
 from .patch_dropout import PatchDropout
 from .patch_embed import PatchEmbed, resample_patch_embed

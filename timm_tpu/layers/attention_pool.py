@@ -1,22 +1,27 @@
-"""Latent-query attention pooling (reference: timm/layers/attention_pool.py).
+"""Attention pooling heads (reference: timm/layers/attention_pool.py +
+attention_pool2d.py).
 
-Used by ViT 'map' pooling — a learned latent attends over the token sequence.
+`AttentionPoolLatent` — ViT 'map' pooling (learned latent attends over tokens).
+`AttentionPool2d` / `RotAttentionPool2d` — CLIP-style replacements for global
+average pooling over an NHWC feature map, with learned-absolute vs rotary
+position embedding respectively.
 """
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Optional, Union
+from typing import Callable, Optional, Tuple, Union
 
 import jax.numpy as jnp
 from flax import nnx
 
-from .attention import scaled_dot_product_attention
+from .attention import apply_rot_embed_cat, scaled_dot_product_attention
 from .drop import Dropout
+from .helpers import to_2tuple
 from .mlp import Mlp
 from .norm import LayerNorm
 from .weight_init import trunc_normal_, zeros_
 
-__all__ = ['AttentionPoolLatent']
+__all__ = ['AttentionPoolLatent', 'AttentionPool2d', 'RotAttentionPool2d']
 
 
 class AttentionPoolLatent(nnx.Module):
@@ -101,3 +106,171 @@ class AttentionPoolLatent(nnx.Module):
         elif self.pool == 'avg':
             x = x.mean(axis=1)
         return x
+
+
+class _AttentionPool2dBase(nnx.Module):
+    """Shared machinery for the CLIP-style 2D attention pools
+    (reference attention_pool2d.py:22-320). Input is an NHWC feature map;
+    a mean (or cls) token is prepended and one MHSA layer runs over the
+    N+1 tokens; 'token' pooling returns the first output token."""
+
+    def __init__(
+            self,
+            in_features: int,
+            out_features: Optional[int] = None,
+            embed_dim: Optional[int] = None,
+            head_dim: Optional[int] = 64,
+            num_heads: Optional[int] = None,
+            qkv_bias: bool = True,
+            qkv_separate: bool = False,
+            pool_type: str = 'token',
+            class_token: bool = False,
+            drop_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert pool_type in ('', 'token')
+        self.embed_dim = embed_dim = embed_dim or in_features
+        self.in_features = in_features
+        if out_features is None:
+            self.out_features = in_features
+        elif out_features > 0:
+            self.out_features = out_features
+        else:
+            self.out_features = embed_dim  # out_features=0 disables projection
+        if num_heads is not None:
+            assert embed_dim % num_heads == 0
+            head_dim = embed_dim // num_heads
+        else:
+            assert embed_dim % head_dim == 0
+            num_heads = embed_dim // head_dim
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.pool_type = pool_type.lower()
+        self.scale = head_dim ** -0.5
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+        self.cls_token = nnx.Param(jnp.zeros((1, embed_dim), param_dtype)) if class_token else None
+
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=in_features ** -0.5), bias_init=zeros_, rngs=rngs)
+        if qkv_separate:
+            self.q = linear(in_features, embed_dim, use_bias=qkv_bias)
+            self.k = linear(in_features, embed_dim, use_bias=qkv_bias)
+            self.v = linear(in_features, embed_dim, use_bias=qkv_bias)
+            self.qkv = None
+        else:
+            self.q = self.k = self.v = None
+            self.qkv = linear(in_features, embed_dim * 3, use_bias=qkv_bias)
+        self.drop = Dropout(drop_rate, rngs=rngs)
+        self.proj = linear(embed_dim, self.out_features) if out_features != 0 else None
+
+    def reset(self, num_classes: Optional[int] = None, pool_type: Optional[str] = None, *, rngs=None):
+        if pool_type is not None:
+            assert pool_type in ('', 'token')
+            self.pool_type = pool_type
+        if num_classes is not None:
+            if num_classes > 0:
+                self.proj = nnx.Linear(
+                    self.embed_dim, num_classes, dtype=self._dtype, param_dtype=self._param_dtype,
+                    kernel_init=trunc_normal_(std=self.embed_dim ** -0.5), bias_init=zeros_,
+                    rngs=rngs or nnx.Rngs(0))
+            else:
+                self.proj = None
+            self.out_features = num_classes if num_classes > 0 else self.embed_dim
+
+    def _tokens(self, x):
+        """(B, H, W, C) → (B, N+1, C) with mean/cls token prepended."""
+        B, H, W, C = x.shape
+        x = x.reshape(B, H * W, C)
+        if self.cls_token is None:
+            x = jnp.concatenate([x.mean(axis=1, keepdims=True), x], axis=1)
+        else:
+            cls = jnp.broadcast_to(self.cls_token[...].astype(x.dtype)[None], (B, 1, self.embed_dim))
+            x = jnp.concatenate([cls, x], axis=1)
+        return x
+
+    def _qkv_heads(self, x):
+        B, N, _ = x.shape
+        if self.qkv is None:
+            q = self.q(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+            k = self.k(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+            v = self.v(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        else:
+            qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, self.head_dim).transpose(2, 0, 3, 1, 4)
+            q, k, v = qkv[0], qkv[1], qkv[2]
+        return q, k, v
+
+    def _pool(self, x, H: int, W: int):
+        if self.pool_type == 'token':
+            return x[:, 0]
+        return x[:, 1:].reshape(x.shape[0], H, W, -1)
+
+
+class AttentionPool2d(_AttentionPool2dBase):
+    """Learned absolute-position attention pool (reference attention_pool2d.py:175).
+
+    Requires `feat_size` at construction; the pos embed is resampled at call
+    time when the runtime feature size differs.
+    """
+
+    def __init__(self, in_features: int, feat_size: Union[int, Tuple[int, int]] = 7, **kwargs):
+        super().__init__(in_features, **kwargs)
+        self.feat_size = to_2tuple(feat_size)
+        self.seq_len = self.feat_size[0] * self.feat_size[1]
+        key = kwargs.get('rngs', nnx.Rngs(0)).params()
+        self.pos_embed = nnx.Param(
+            trunc_normal_(std=in_features ** -0.5)(key, (self.seq_len + 1, in_features), self._param_dtype))
+
+    def __call__(self, x, pre_logits: bool = False):
+        from .pos_embed import resample_abs_pos_embed
+        B, H, W, C = x.shape
+        x = self._tokens(x)
+        pos = self.pos_embed[...][None]
+        if (H, W) != self.feat_size:
+            pos = resample_abs_pos_embed(pos, (H, W), old_size=self.feat_size, num_prefix_tokens=1)
+        x = x + pos.astype(x.dtype)
+        q, k, v = self._qkv_heads(x)
+        x = scaled_dot_product_attention(q, k, v, scale=self.scale)
+        x = x.transpose(0, 2, 1, 3).reshape(B, H * W + 1, -1)
+        x = self.drop(x)
+        if pre_logits or self.proj is None:
+            return self._pool(x, H, W)
+        return self._pool(self.proj(x), H, W)
+
+
+class RotAttentionPool2d(_AttentionPool2dBase):
+    """Rotary-position attention pool (reference attention_pool2d.py:22).
+
+    No fixed feature size — the ROPE table is built for the runtime (H, W)
+    relative to `ref_feat_size`.
+    """
+
+    def __init__(self, in_features: int, ref_feat_size: Union[int, Tuple[int, int]] = 7, **kwargs):
+        from .pos_embed_sincos import RotaryEmbeddingCat
+        super().__init__(in_features, **kwargs)
+        self.pos_embed = RotaryEmbeddingCat(
+            self.embed_dim // self.num_heads,  # table is (N, 2*head_dim) = cat(sin, cos)
+            in_pixels=False,
+            ref_feat_shape=to_2tuple(ref_feat_size),
+        )
+
+    def __call__(self, x, pre_logits: bool = False):
+        B, H, W, C = x.shape
+        x = self._tokens(x)
+        q, k, v = self._qkv_heads(x)
+        rope = self.pos_embed.get_embed((H, W))
+        q = jnp.concatenate(
+            [q[:, :, :1], apply_rot_embed_cat(q[:, :, 1:], rope)], axis=2).astype(v.dtype)
+        k = jnp.concatenate(
+            [k[:, :, :1], apply_rot_embed_cat(k[:, :, 1:], rope)], axis=2).astype(v.dtype)
+        x = scaled_dot_product_attention(q, k, v, scale=self.scale)
+        x = x.transpose(0, 2, 1, 3).reshape(B, H * W + 1, -1)
+        x = self.drop(x)
+        if pre_logits or self.proj is None:
+            return self._pool(x, H, W)
+        return self._pool(self.proj(x), H, W)

@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 from flax import nnx
 
-__all__ = ['BlurPool2d']
+__all__ = ['BlurPool2d', 'AvgPool2dAA', 'get_aa_layer']
 
 
 class BlurPool2d(nnx.Module):
@@ -34,3 +34,34 @@ class BlurPool2d(nnx.Module):
             dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
             feature_group_count=self.channels,
         )
+
+
+class AvgPool2dAA(nnx.Module):
+    """Plain 2x2 average-pool 'anti-aliasing' layer (reference create_aa's
+    'avg' option) — used by the CLIP ResNets' strided blocks."""
+
+    def __init__(self, channels: int = 0, stride: int = 2, *, rngs=None):
+        self.stride = stride
+
+    def __call__(self, x):
+        s = self.stride
+        return jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, (1, s, s, 1), (1, s, s, 1), 'SAME') / (s * s)
+
+
+def get_aa_layer(aa_layer):
+    """Resolve an anti-aliasing layer from name/callable
+    (reference blur_pool.py create_aa)."""
+    if aa_layer is None or aa_layer == '':
+        return None
+    if not isinstance(aa_layer, str):
+        return aa_layer
+    name = aa_layer.lower().replace('_', '').replace('2d', '')
+    if name == 'avg' or name == 'avgpool':
+        return AvgPool2dAA
+    if name in ('blur', 'blurpool'):
+        return BlurPool2d
+    if name == 'blurpc':
+        import functools
+        return functools.partial(BlurPool2d, filt_size=4)
+    raise ValueError(f'Unknown anti-aliasing layer {aa_layer}')

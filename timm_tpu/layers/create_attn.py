@@ -9,6 +9,7 @@ from .cbam import CbamModule, LightCbamModule
 from .eca import CecaModule, EcaModule
 from .gather_excite import GatherExcite
 from .global_context import GlobalContext
+from .non_local_attn import BatNonLocalAttn, NonLocalAttn
 from .selective_kernel import SelectiveKernel
 from .split_attn import SplitAttn
 from .squeeze_excite import EffectiveSEModule, SEModule
@@ -25,6 +26,8 @@ _ATTN_MAP = dict(
     ge=GatherExcite,
     gc=GlobalContext,
     gca=partial(GlobalContext, fuse_add=True, fuse_scale=False),
+    nl=NonLocalAttn,
+    bat=BatNonLocalAttn,
     sk=SelectiveKernel,
     splat=SplitAttn,
 )

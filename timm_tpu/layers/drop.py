@@ -42,10 +42,15 @@ class DropPath(nnx.Module):
 
 
 class Dropout(nnx.Dropout):
-    """nnx Dropout with a torch-ish positional-rate constructor."""
+    """nnx Dropout with a torch-ish positional-rate constructor.
 
-    def __init__(self, rate: float = 0.0, *, rngs: Optional[nnx.Rngs] = None):
-        super().__init__(rate=rate, rngs=rngs if rate > 0.0 else None)
+    `broadcast_dims=(1, 2)` on NHWC input gives nn.Dropout2d semantics
+    (whole feature maps dropped together).
+    """
+
+    def __init__(self, rate: float = 0.0, broadcast_dims=(), *, rngs: Optional[nnx.Rngs] = None):
+        super().__init__(rate=rate, broadcast_dims=broadcast_dims,
+                         rngs=rngs if rate > 0.0 else None)
 
 
 def dropout_rng_key(drop) -> Optional[jax.Array]:

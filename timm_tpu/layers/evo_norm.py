@@ -9,7 +9,7 @@ from typing import Optional
 import jax.numpy as jnp
 from flax import nnx
 
-__all__ = ['EvoNorm2dB0', 'EvoNorm2dS0']
+__all__ = ['EvoNorm2dB0', 'EvoNorm2dS0', 'EvoNorm2dS0a']
 
 
 class EvoNorm2dB0(nnx.Module):
@@ -74,4 +74,28 @@ class EvoNorm2dS0(nnx.Module):
             std = jnp.sqrt(var + self.eps)
             std = jnp.broadcast_to(std, xg.shape).reshape(B, H, W, C).astype(x.dtype)
             x = x * jax.nn.sigmoid(v * x) / std
+        return x * self.weight[...].astype(x.dtype) + self.bias[...].astype(x.dtype)
+
+
+class EvoNorm2dS0a(EvoNorm2dS0):
+    """S0 variant that always divides by the group std, act or not
+    (reference evo_norm.py:284-316). Default eps is 1e-3."""
+
+    def __init__(self, num_features: int, groups: int = 32, group_size: Optional[int] = None,
+                 apply_act: bool = True, eps: float = 1e-3,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs, **kwargs):
+        super().__init__(
+            num_features, groups=groups, group_size=group_size, apply_act=apply_act,
+            eps=eps, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        import jax
+        B, H, W, C = x.shape
+        xg = x.astype(jnp.float32).reshape(B, H, W, self.groups, C // self.groups)
+        var = xg.var(axis=(1, 2, 4), keepdims=True)
+        std = jnp.broadcast_to(jnp.sqrt(var + self.eps), xg.shape).reshape(B, H, W, C).astype(x.dtype)
+        if self.v is not None:
+            v = self.v[...].astype(x.dtype)
+            x = x * jax.nn.sigmoid(v * x)
+        x = x / std
         return x * self.weight[...].astype(x.dtype) + self.bias[...].astype(x.dtype)

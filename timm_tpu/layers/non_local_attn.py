@@ -1,0 +1,140 @@
+"""Non-Local and Bilinear-Attention-Transform (BAT) attention
+(reference: timm/layers/non_local_attn.py:1-189, Chi et al. CVPR 2020).
+
+NHWC throughout: the non-local attention is one einsum-softmax-einsum over
+flattened spatial positions; the BAT bilinear transform is two batched
+matmuls with block-structured row/column mixing matrices, both of which XLA
+maps straight onto the MXU.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from .create_conv2d import ConvNormAct, create_conv2d
+from .drop import Dropout
+from .helpers import make_divisible
+
+__all__ = ['NonLocalAttn', 'BilinearAttnTransform', 'BatNonLocalAttn']
+
+
+class NonLocalAttn(nnx.Module):
+    """Classic spatial non-local block (reference non_local_attn.py:19-84)."""
+
+    def __init__(self, in_channels, use_scale: bool = True, rd_ratio: float = 1 / 8,
+                 rd_channels: Optional[int] = None, rd_divisor: int = 8,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs, **_):
+        from .norm import BatchNorm2d
+        if rd_channels is None:
+            rd_channels = make_divisible(in_channels * rd_ratio, divisor=rd_divisor)
+        self.scale = in_channels ** -0.5 if use_scale else 1.0
+        conv = lambda ci, co: create_conv2d(
+            ci, co, 1, bias=True, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.t = conv(in_channels, rd_channels)
+        self.p = conv(in_channels, rd_channels)
+        self.g = conv(in_channels, rd_channels)
+        self.z = conv(rd_channels, in_channels)
+        self.norm = BatchNorm2d(in_channels, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        # zero-init the BN scale so the block starts as identity (reference
+        # reset_parameters zeroes the norm weight)
+        if self.norm.scale is not None:
+            self.norm.scale[...] = jnp.zeros_like(self.norm.scale[...])
+
+    def __call__(self, x):
+        shortcut = x
+        B, H, W, _ = x.shape
+        t = self.t(x).reshape(B, H * W, -1)
+        p = self.p(x).reshape(B, H * W, -1)
+        g = self.g(x).reshape(B, H * W, -1)
+        att = jnp.einsum('bnc,bmc->bnm', t, p) * self.scale
+        att = jax.nn.softmax(att, axis=2)
+        y = jnp.einsum('bnm,bmc->bnc', att, g).reshape(B, H, W, -1)
+        y = self.z(y)
+        return self.norm(y) + shortcut
+
+
+def _kron_identity(x, t: int):
+    """kron(x, I_t) on trailing (bs, bs) matrices — the reference's
+    `resize_mat` (non_local_attn.py:110-120) without the split/cat dance."""
+    if t <= 1:
+        return x
+    *lead, bs, bs2 = x.shape
+    assert bs == bs2
+    eye = jnp.eye(t, dtype=x.dtype)
+    out = x[..., :, None, :, None] * eye[None, :, None, :]
+    return out.reshape(*lead, bs * t, bs * t)
+
+
+class BilinearAttnTransform(nnx.Module):
+    """Grouped bilinear attentional transform (reference non_local_attn.py:87)."""
+
+    def __init__(self, in_channels: int, block_size: int, groups: int,
+                 act_layer='relu', norm_layer=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv1 = ConvNormAct(in_channels, groups, 1, act_layer=act_layer,
+                                 norm_layer=norm_layer, **dd)
+        self.conv_p = create_conv2d(groups, block_size * block_size * groups,
+                                    (block_size, 1), padding='valid', bias=True, **dd)
+        self.conv_q = create_conv2d(groups, block_size * block_size * groups,
+                                    (1, block_size), padding='valid', bias=True, **dd)
+        self.conv2 = ConvNormAct(in_channels, in_channels, 1, act_layer=act_layer,
+                                 norm_layer=norm_layer, **dd)
+        self.block_size = block_size
+        self.groups = groups
+        self.in_channels = in_channels
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        assert H % self.block_size == 0 and W % self.block_size == 0
+        bs, G = self.block_size, self.groups
+        out = self.conv1(x)  # (B, H, W, G)
+        # adaptive max pool to (bs, 1) rows / (1, bs) cols — H/W divisible by bs
+        rp = out.reshape(B, bs, H // bs, W, G).max(axis=(2, 3), keepdims=False)[:, :, None]  # (B, bs, 1, G)
+        cp = out.reshape(B, H, bs, W // bs, G).max(axis=(1, 3), keepdims=False)[:, None]     # (B, 1, bs, G)
+        p = jax.nn.sigmoid(self.conv_p(rp).reshape(B, G, bs, bs))
+        q = jax.nn.sigmoid(self.conv_q(cp).reshape(B, G, bs, bs))
+        p = p / p.sum(axis=3, keepdims=True)
+        q = q / q.sum(axis=2, keepdims=True)
+        # expand per-group matrices to all channels of the group
+        cpg = C // G
+        p = jnp.broadcast_to(p[:, :, None], (B, G, cpg, bs, bs)).reshape(B, C, bs, bs)
+        q = jnp.broadcast_to(q[:, :, None], (B, G, cpg, bs, bs)).reshape(B, C, bs, bs)
+        p = _kron_identity(p, H // bs)  # (B, C, H, H)
+        q = _kron_identity(q, W // bs)  # (B, C, W, W)
+        # y = p @ x @ q with NHWC x
+        y = jnp.einsum('bchk,bkwc->bhwc', p, x)
+        y = jnp.einsum('bhkc,bckw->bhwc', y, q)
+        return self.conv2(y)
+
+
+class BatNonLocalAttn(nnx.Module):
+    """BAT block: reduce 1x1 → bilinear transform → expand 1x1 + residual
+    (reference non_local_attn.py:148-189)."""
+
+    def __init__(self, in_channels: int, block_size: int = 7, groups: int = 2,
+                 rd_ratio: float = 0.25, rd_channels: Optional[int] = None,
+                 rd_divisor: int = 8, drop_rate: float = 0.2,
+                 act_layer='relu', norm_layer=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs, **_):
+        if rd_channels is None:
+            rd_channels = make_divisible(in_channels * rd_ratio, divisor=rd_divisor)
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv1 = ConvNormAct(in_channels, rd_channels, 1, act_layer=act_layer,
+                                 norm_layer=norm_layer, **dd)
+        self.ba = BilinearAttnTransform(rd_channels, block_size, groups,
+                                        act_layer=act_layer, norm_layer=norm_layer, **dd)
+        self.conv2 = ConvNormAct(rd_channels, in_channels, 1, act_layer=act_layer,
+                                 norm_layer=norm_layer, **dd)
+        # channel-wise (2d) dropout: drop whole feature maps, like nn.Dropout2d
+        self.dropout = Dropout(drop_rate, broadcast_dims=(1, 2), rngs=rngs)
+
+    def __call__(self, x):
+        xl = self.conv1(x)
+        y = self.ba(xl)
+        y = self.conv2(y)
+        y = self.dropout(y)
+        return y + x

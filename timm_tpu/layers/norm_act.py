@@ -13,7 +13,10 @@ from flax import nnx
 from .create_act import get_act_fn
 from .norm import BatchNorm2d, GroupNorm, LayerNorm
 
-__all__ = ['BatchNormAct2d', 'GroupNormAct', 'GroupNorm1Act', 'LayerNormAct', 'LayerNormAct2d', 'FrozenBatchNormAct2d']
+__all__ = [
+    'BatchNormAct2d', 'GroupNormAct', 'GroupNorm1Act', 'LayerNormAct', 'LayerNormAct2d',
+    'FrozenBatchNormAct2d', 'get_norm_act_layer',
+]
 
 
 class BatchNormAct2d(BatchNorm2d):
@@ -140,3 +143,41 @@ class LayerNormAct(LayerNorm):
 
 
 LayerNormAct2d = LayerNormAct  # NHWC: identical
+
+
+def get_norm_act_layer(norm_layer, act_layer=None):
+    """Resolve a (norm+act) composite layer class from a name or callable
+    (reference create_norm_act.py:107 get_norm_act_layer). When `act_layer`
+    is given, it is bound as the composite's default activation.
+
+    EvoNorms carry their own activation and accept/ignore `act_layer`.
+    """
+    import functools
+    import inspect
+    if norm_layer is None:
+        return None
+    if not isinstance(norm_layer, str):
+        cls = norm_layer
+    else:
+        from .evo_norm import EvoNorm2dB0, EvoNorm2dS0
+        from .filter_response_norm import FilterResponseNormAct2d, FilterResponseNormTlu2d
+        name = norm_layer.replace('_', '').lower()
+        _MAP = dict(
+            batchnorm=BatchNormAct2d,
+            batchnorm2d=BatchNormAct2d,
+            groupnorm=GroupNormAct,
+            groupnorm1=GroupNorm1Act,
+            layernorm=LayerNormAct,
+            layernorm2d=LayerNormAct2d,
+            evonormb0=EvoNorm2dB0,
+            evonorms0=EvoNorm2dS0,
+            frn=FilterResponseNormAct2d,
+            frntlu=FilterResponseNormTlu2d,
+        )
+        if name not in _MAP:
+            raise ValueError(f'Unknown norm+act layer {norm_layer}')
+        cls = _MAP[name]
+    base = cls.func if isinstance(cls, functools.partial) else cls
+    if act_layer is not None and 'act_layer' in inspect.signature(base.__init__).parameters:
+        cls = functools.partial(cls, act_layer=act_layer)
+    return cls

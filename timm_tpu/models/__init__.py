@@ -13,6 +13,7 @@ from ._registry import (
     list_pretrained, model_entrypoint, register_model, split_model_name_tag,
 )
 
+from .byobnet import ByoBlockCfg, ByoModelCfg, ByobNet
 from .convnext import ConvNeXt
 from .deit import VisionTransformerDistilled
 from .densenet import DenseNet

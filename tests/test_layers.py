@@ -182,7 +182,7 @@ def test_scaled_std_conv():
     x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 8), jnp.float32)
     assert conv(x).shape == (2, 8, 8, 16)
     # kernel itself must stay unstandardized (standardization is call-time)
-    w = conv.conv.kernel[...]
+    w = conv.kernel[...]
     assert float(jnp.abs(w.mean(axis=(0, 1, 2))).max()) > 1e-4
 
 

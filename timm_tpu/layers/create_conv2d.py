@@ -26,14 +26,23 @@ def get_padding(kernel_size: int, stride: int = 1, dilation: int = 1):
 
 
 def _resolve_padding(padding, kernel_size, stride, dilation):
-    """Map timm padding conventions onto flax conv padding."""
+    """Map timm padding conventions onto flax conv padding.
+
+    '' (the timm default) means SYMMETRIC torch-style padding, identical to
+    None — NOT TF-SAME. Only the explicit 'same' string selects TF-SAME
+    (asymmetric for stride>1 on even inputs), matching reference
+    padding.py:get_padding_value.
+    """
     if isinstance(padding, str):
         padding = padding.lower()
-        if padding in ('same', ''):
+        if padding == 'same':
             return 'SAME'
         if padding == 'valid':
             return 'VALID'
-        raise ValueError(f'Unknown padding {padding}')
+        if padding == '':
+            padding = None
+        else:
+            raise ValueError(f'Unknown padding {padding}')
     if padding is None:
         padding = get_padding(kernel_size, stride, dilation)
     if isinstance(padding, int):

@@ -22,8 +22,10 @@ from .eva import Eva
 from .mlp_mixer import MlpMixer
 from .mobilenetv3 import MobileNetV3
 from .naflexvit import NaFlexVit
+from .nfnet import NfCfg, NormFreeNet
 from .regnet import RegNet
 from .resnet import ResNet
+from .resnetv2 import ResNetV2
 from .swin_transformer import SwinTransformer
 from .vgg import VGG
 from .vision_transformer import VisionTransformer

@@ -123,7 +123,7 @@ class ConvNeXtStage(nnx.Module):
             self.downsample_norm = norm_layer(in_chs, rngs=rngs)
             self.downsample_conv = create_conv2d(
                 in_chs, out_chs, stride if stride > 1 else 1,
-                stride=stride, dilation=dilation[0], bias=conv_bias,
+                stride=stride, dilation=dilation[0], padding=0, bias=conv_bias,
                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
             in_chs = out_chs
         else:
@@ -202,7 +202,7 @@ class ConvNeXt(nnx.Module):
         assert stem_type in ('patch', 'overlap', 'overlap_tiered')
         if stem_type == 'patch':
             self.stem_conv = create_conv2d(
-                in_chans, dims[0], patch_size, stride=patch_size, bias=conv_bias,
+                in_chans, dims[0], patch_size, stride=patch_size, padding=0, bias=conv_bias,
                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
             self.stem_conv2 = None
             self.stem_norm = norm_layer(dims[0], rngs=rngs)

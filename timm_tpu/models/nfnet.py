@@ -1,0 +1,763 @@
+"""Normalization-Free Networks: NFNet-F, NF-RegNet, NF-ResNet
+(reference: timm/models/nfnet.py:1-1189; Brock et al. 2021,
+arXiv:2101.08692 + arXiv:2102.06171).
+
+TPU-first notes:
+  * No BatchNorm anywhere — signal propagation is controlled by ScaledStdConv
+    weight standardization + analytic alpha/beta variance bookkeeping, which
+    makes every block a pure function of its inputs: ideal for `jit`, no
+    cross-replica stat sync, no train/eval divergence in the trunk.
+  * AGC (adaptive gradient clipping), the training-side half of the NFNet
+    recipe, already lives in `timm_tpu/utils/clip_grad.py` and plugs into the
+    jitted train step via `--clip-mode agc`.
+  * The activation-correcting gamma constants fold into the conv weight
+    standardization scale (`gamma_in_act=False` default) exactly as the
+    reference does; dm_ variants keep gamma in the activation and use TF-SAME
+    padding for DeepMind weight compatibility.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    ClassifierHead, DropPath, ScaledStdConv2d, calculate_drop_path_rates,
+    get_act_fn, get_attn, make_divisible,
+)
+from ..layers.std_conv import ScaledStdConv2dSame
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+from .resnet import avg_pool2d, max_pool2d
+
+__all__ = ['NormFreeNet', 'NfCfg']
+
+
+@dataclass
+class NfCfg:
+    """Normalization-free network config (reference nfnet.py:39-61)."""
+    depths: Tuple[int, int, int, int]
+    channels: Tuple[int, int, int, int]
+    alpha: float = 0.2
+    stem_type: str = '3x3'
+    stem_chs: Optional[int] = None
+    group_size: Optional[int] = None
+    attn_layer: Optional[str] = None
+    attn_kwargs: Optional[Dict[str, Any]] = None
+    attn_gain: float = 2.0  # NF correction gain when attn is used
+    width_factor: float = 1.0
+    bottle_ratio: float = 0.5
+    num_features: int = 0
+    ch_div: int = 8
+    reg: bool = False  # RegNet-like: expand from in_chs, attn in middle
+    extra_conv: bool = False
+    gamma_in_act: bool = False
+    same_padding: bool = False
+    std_conv_eps: float = 1e-5
+    skipinit: bool = False
+    zero_init_fc: bool = False
+    act_layer: str = 'silu'
+
+
+def act_with_gamma(act_type: str, gamma: float = 1.0) -> Callable:
+    """Gamma-scaled activation (reference nfnet.py:64-105 GammaAct)."""
+    fn = get_act_fn(act_type)
+
+    def _act(x):
+        return fn(x) * gamma
+    return _act
+
+
+# variance-preserving gains, from the official deepmind nfnets repo
+_nonlin_gamma = dict(
+    identity=1.0,
+    celu=1.270926833152771,
+    elu=1.2716004848480225,
+    gelu=1.7015043497085571,
+    leaky_relu=1.70590341091156,
+    log_sigmoid=1.9193484783172607,
+    log_softmax=1.0002083778381348,
+    relu=1.7139588594436646,
+    relu6=1.7131484746932983,
+    selu=1.0008515119552612,
+    sigmoid=4.803835391998291,
+    silu=1.7881293296813965,
+    softsign=2.338853120803833,
+    softplus=1.9203323125839233,
+    tanh=1.5939117670059204,
+)
+
+
+class DownsampleAvg(nnx.Module):
+    """AvgPool + std-conv shortcut (reference nfnet.py:107-151)."""
+
+    def __init__(self, in_chs, out_chs, stride=1, dilation=1, first_dilation=None,
+                 conv_layer=ScaledStdConv2d, *, dtype=None, param_dtype=jnp.float32, rngs):
+        self.pool_stride = stride if dilation == 1 else 1
+        self.do_pool = stride > 1 or dilation > 1
+        self.conv = conv_layer(in_chs, out_chs, 1, stride=1,
+                               dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        if self.do_pool:
+            x = avg_pool2d(x, 2, self.pool_stride, pad_same=True)
+        return self.conv(x)
+
+
+class NormFreeBlock(nnx.Module):
+    """Pre-activation norm-free residual block (reference nfnet.py:153-283)."""
+
+    def __init__(self, in_chs, out_chs=None, stride=1, dilation=1, first_dilation=None,
+                 alpha=1.0, beta=1.0, bottle_ratio=0.25, group_size=None, ch_div=1,
+                 reg=True, extra_conv=False, skipinit=False, attn_layer=None,
+                 attn_gain=2.0, act_layer=None, conv_layer=ScaledStdConv2d,
+                 drop_path_rate=0., *, dtype=None, param_dtype=jnp.float32, rngs):
+        first_dilation = first_dilation or dilation
+        out_chs = out_chs or in_chs
+        # RegNet variants scale bottleneck from in_chs, ResNet-like from out_chs
+        mid_chs = make_divisible(in_chs * bottle_ratio if reg else out_chs * bottle_ratio, ch_div)
+        groups = 1 if not group_size else mid_chs // group_size
+        if group_size and group_size % ch_div == 0:
+            mid_chs = group_size * groups
+        self.alpha = alpha
+        self.beta = beta
+        self.attn_gain = attn_gain
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        if in_chs != out_chs or stride != 1 or dilation != first_dilation:
+            self.downsample = DownsampleAvg(
+                in_chs, out_chs, stride=stride, dilation=dilation,
+                first_dilation=first_dilation, conv_layer=conv_layer, **dd)
+        else:
+            self.downsample = None
+
+        self.act1 = act_layer
+        self.conv1 = conv_layer(in_chs, mid_chs, 1, **dd)
+        self.act2 = act_layer
+        self.conv2 = conv_layer(mid_chs, mid_chs, 3, stride=stride, dilation=first_dilation,
+                                groups=groups, **dd)
+        if extra_conv:
+            self.act2b = act_layer
+            self.conv2b = conv_layer(mid_chs, mid_chs, 3, stride=1, dilation=dilation,
+                                     groups=groups, **dd)
+        else:
+            self.act2b = None
+            self.conv2b = None
+        self.attn = attn_layer(mid_chs, **dd) if reg and attn_layer is not None else None
+        self.act3 = act_layer
+        self.conv3 = conv_layer(mid_chs, out_chs, 1, gain_init=1. if skipinit else 0., **dd)
+        self.attn_last = attn_layer(out_chs, **dd) if not reg and attn_layer is not None else None
+        self.drop_path = DropPath(drop_path_rate, rngs=rngs)
+        self.skipinit_gain = nnx.Param(jnp.zeros((), param_dtype)) if skipinit else None
+
+    def __call__(self, x):
+        out = self.act1(x) * self.beta
+        shortcut = x
+        if self.downsample is not None:
+            shortcut = self.downsample(out)
+        out = self.conv1(out)
+        out = self.conv2(self.act2(out))
+        if self.conv2b is not None:
+            out = self.conv2b(self.act2b(out))
+        if self.attn is not None:
+            out = self.attn_gain * self.attn(out)
+        out = self.conv3(self.act3(out))
+        if self.attn_last is not None:
+            out = self.attn_gain * self.attn_last(out)
+        out = self.drop_path(out)
+        if self.skipinit_gain is not None:
+            out = out * self.skipinit_gain[...].astype(out.dtype)
+        return out * self.alpha + shortcut
+
+
+class Stem(nnx.Module):
+    """Norm-free stem (reference nfnet.py:285-347 create_stem)."""
+
+    def __init__(self, in_chs, out_chs, stem_type='', conv_layer=None, act_layer=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs):
+        assert stem_type in ('', 'deep', 'deep_tiered', 'deep_quad', '3x3', '7x7',
+                             'deep_pool', '3x3_pool', '7x7_pool')
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.stride = 2
+        self.act = act_layer
+        self.feature = dict(num_chs=out_chs, reduction=2, module='stem.conv')
+        self.conv_names = []
+        if 'deep' in stem_type:
+            if 'quad' in stem_type:
+                assert 'pool' not in stem_type
+                stem_chs = (out_chs // 8, out_chs // 4, out_chs // 2, out_chs)
+                strides = (2, 1, 1, 2)
+                self.stride = 4
+                self.feature = dict(num_chs=out_chs // 2, reduction=2, module='stem.conv3')
+            else:
+                if 'tiered' in stem_type:
+                    stem_chs = (3 * out_chs // 8, out_chs // 2, out_chs)
+                else:
+                    stem_chs = (out_chs // 2, out_chs // 2, out_chs)
+                strides = (2, 1, 1)
+                self.feature = dict(num_chs=out_chs // 2, reduction=2, module='stem.conv2')
+            prev = in_chs
+            for i, (c, s) in enumerate(zip(stem_chs, strides)):
+                setattr(self, f'conv{i + 1}', conv_layer(prev, c, kernel_size=3, stride=s, **dd))
+                self.conv_names.append(f'conv{i + 1}')
+                prev = c
+            self.last_act = False  # act applied between convs, not after last
+        elif '3x3' in stem_type:
+            self.conv = conv_layer(in_chs, out_chs, kernel_size=3, stride=2, **dd)
+            self.conv_names = ['conv']
+            self.last_act = False
+        else:  # 7x7
+            self.conv = conv_layer(in_chs, out_chs, kernel_size=7, stride=2, **dd)
+            self.conv_names = ['conv']
+            self.last_act = False
+        self.pool = 'pool' in stem_type
+        if self.pool:
+            self.stride = 4
+
+    def __call__(self, x):
+        for i, name in enumerate(self.conv_names):
+            x = getattr(self, name)(x)
+            if i != len(self.conv_names) - 1:
+                x = self.act(x)
+        if self.pool:
+            x = max_pool2d(x, 3, 2)
+        return x
+
+
+class NormFreeNet(nnx.Module):
+    """Normalization-free network (reference nfnet.py:368-596)."""
+
+    def __init__(
+            self,
+            cfg: NfCfg,
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            global_pool: str = 'avg',
+            output_stride: int = 32,
+            drop_rate: float = 0.,
+            drop_path_rate: float = 0.,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: Optional[nnx.Rngs] = None,
+            **kwargs,
+    ):
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        cfg = replace(cfg, **kwargs)
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        self.grad_checkpointing = False
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        assert cfg.act_layer in _nonlin_gamma, \
+            f'Please add non-linearity constants for activation ({cfg.act_layer}).'
+        conv_layer = ScaledStdConv2dSame if cfg.same_padding else ScaledStdConv2d
+        if cfg.gamma_in_act:
+            act_layer = act_with_gamma(cfg.act_layer, gamma=_nonlin_gamma[cfg.act_layer])
+            conv_layer = partial(conv_layer, eps=cfg.std_conv_eps)
+        else:
+            act_layer = get_act_fn(cfg.act_layer)
+            conv_layer = partial(conv_layer, gamma=_nonlin_gamma[cfg.act_layer], eps=cfg.std_conv_eps)
+        attn_layer = partial(get_attn(cfg.attn_layer), **(cfg.attn_kwargs or {})) \
+            if cfg.attn_layer else None
+
+        stem_chs = make_divisible((cfg.stem_chs or cfg.channels[0]) * cfg.width_factor, cfg.ch_div)
+        self.stem = Stem(in_chans, stem_chs, cfg.stem_type, conv_layer=conv_layer,
+                         act_layer=act_layer, **dd)
+        stem_stride = self.stem.stride
+
+        self.feature_info = [self.stem.feature]
+        drop_path_rates = calculate_drop_path_rates(drop_path_rate, cfg.depths, stagewise=True)
+        prev_chs = stem_chs
+        net_stride = stem_stride
+        dilation = 1
+        expected_var = 1.0
+        stages = []
+        for stage_idx, stage_depth in enumerate(cfg.depths):
+            stride = 1 if stage_idx == 0 and stem_stride > 2 else 2
+            if net_stride >= output_stride and stride > 1:
+                dilation *= stride
+                stride = 1
+            net_stride *= stride
+            first_dilation = 1 if dilation in (1, 2) else 2
+
+            blocks = []
+            for block_idx in range(stage_depth):
+                first_block = block_idx == 0 and stage_idx == 0
+                out_chs = make_divisible(cfg.channels[stage_idx] * cfg.width_factor, cfg.ch_div)
+                blocks += [NormFreeBlock(
+                    in_chs=prev_chs, out_chs=out_chs,
+                    alpha=cfg.alpha,
+                    beta=1. / expected_var ** 0.5,
+                    stride=stride if block_idx == 0 else 1,
+                    dilation=dilation,
+                    first_dilation=first_dilation,
+                    group_size=cfg.group_size,
+                    bottle_ratio=1. if cfg.reg and first_block else cfg.bottle_ratio,
+                    ch_div=cfg.ch_div,
+                    reg=cfg.reg,
+                    extra_conv=cfg.extra_conv,
+                    skipinit=cfg.skipinit,
+                    attn_layer=attn_layer,
+                    attn_gain=cfg.attn_gain,
+                    act_layer=act_layer,
+                    conv_layer=conv_layer,
+                    drop_path_rate=drop_path_rates[stage_idx][block_idx],
+                    **dd,
+                )]
+                if block_idx == 0:
+                    expected_var = 1.0  # reset after first block of each stage
+                expected_var += cfg.alpha ** 2
+                first_dilation = dilation
+                prev_chs = out_chs
+            self.feature_info += [dict(num_chs=prev_chs, reduction=net_stride, module=f'stages.{stage_idx}')]
+            stages += [nnx.List(blocks)]
+        self.stages = nnx.List(stages)
+
+        if cfg.num_features:
+            self.num_features = make_divisible(cfg.width_factor * cfg.num_features, cfg.ch_div)
+            self.final_conv = conv_layer(prev_chs, self.num_features, 1, **dd)
+            self.feature_info[-1] = dict(
+                num_chs=self.num_features, reduction=net_stride, module='final_conv')
+        else:
+            self.num_features = prev_chs
+            self.final_conv = None
+        self.final_act = act_layer
+
+        self.head_hidden_size = self.num_features
+        self.head = ClassifierHead(
+            self.num_features, num_classes, pool_type=global_pool, drop_rate=drop_rate, **dd)
+        if cfg.zero_init_fc and self.head.fc is not None:
+            self.head.fc.kernel[...] = jnp.zeros_like(self.head.fc.kernel[...])
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^stem',
+            blocks=[
+                (r'^stages\.(\d+)' if coarse else r'^stages\.(\d+)\.(\d+)', None),
+                (r'^final_conv', (99999,)),
+            ],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        self.head.reset(num_classes, global_pool, rngs=rngs)
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.stem(x)
+        for stage in self.stages:
+            if self.grad_checkpointing:
+                x = checkpoint_seq(stage, x)
+            else:
+                for b in stage:
+                    x = b(x)
+        if self.final_conv is not None:
+            x = self.final_conv(x)
+        return self.final_act(x)
+
+    def forward_head(self, x, pre_logits: bool = False):
+        return self.head(x, pre_logits=pre_logits)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(self, x, indices=None, norm: bool = False,
+                              stop_early: bool = False, output_fmt: str = 'NHWC',
+                              intermediates_only: bool = False):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages) + 1, indices)
+        intermediates = []
+        x = self.stem(x)
+        if 0 in take_indices:
+            intermediates.append(x)
+        for i, stage in enumerate(self.stages):
+            if not stop_early or i <= max_index - 1:
+                for b in stage:
+                    x = b(x)
+                if (i + 1) in take_indices:
+                    intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        if self.final_conv is not None:
+            x = self.final_conv(x)
+        x = self.final_act(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, _ = feature_take_indices(len(self.stages) + 1, indices)
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def _nfres_cfg(depths, channels=(256, 512, 1024, 2048), group_size=None,
+               act_layer='relu', attn_layer=None, attn_kwargs=None) -> NfCfg:
+    return NfCfg(
+        depths=depths, channels=channels, stem_type='7x7_pool', stem_chs=64,
+        bottle_ratio=0.25, group_size=group_size, act_layer=act_layer,
+        attn_layer=attn_layer, attn_kwargs=attn_kwargs or {})
+
+
+def _nfreg_cfg(depths, channels=(48, 104, 208, 440)) -> NfCfg:
+    return NfCfg(
+        depths=depths, channels=channels, stem_type='3x3', group_size=8,
+        width_factor=0.75, bottle_ratio=2.25, num_features=1280 * channels[-1] // 440,
+        reg=True, attn_layer='se', attn_kwargs=dict(rd_ratio=0.5))
+
+
+def _nfnet_cfg(depths, channels=(256, 512, 1536, 1536), group_size=128, bottle_ratio=0.5,
+               feat_mult=2., act_layer='gelu', attn_layer='se', attn_kwargs=None) -> NfCfg:
+    return NfCfg(
+        depths=depths, channels=channels, stem_type='deep_quad', stem_chs=128,
+        group_size=group_size, bottle_ratio=bottle_ratio, extra_conv=True,
+        num_features=int(channels[-1] * feat_mult), act_layer=act_layer,
+        attn_layer=attn_layer,
+        attn_kwargs=attn_kwargs if attn_kwargs is not None else dict(rd_ratio=0.5))
+
+
+def _dm_nfnet_cfg(depths, channels=(256, 512, 1536, 1536), act_layer='gelu',
+                  skipinit=True) -> NfCfg:
+    return NfCfg(
+        depths=depths, channels=channels, stem_type='deep_quad', stem_chs=128,
+        group_size=128, bottle_ratio=0.5, extra_conv=True, gamma_in_act=True,
+        same_padding=True, skipinit=skipinit, num_features=int(channels[-1] * 2.0),
+        act_layer=act_layer, attn_layer='se', attn_kwargs=dict(rd_ratio=0.5))
+
+
+model_cfgs = dict(
+    dm_nfnet_f0=_dm_nfnet_cfg(depths=(1, 2, 6, 3)),
+    dm_nfnet_f1=_dm_nfnet_cfg(depths=(2, 4, 12, 6)),
+    dm_nfnet_f2=_dm_nfnet_cfg(depths=(3, 6, 18, 9)),
+    dm_nfnet_f3=_dm_nfnet_cfg(depths=(4, 8, 24, 12)),
+    dm_nfnet_f4=_dm_nfnet_cfg(depths=(5, 10, 30, 15)),
+    dm_nfnet_f5=_dm_nfnet_cfg(depths=(6, 12, 36, 18)),
+    dm_nfnet_f6=_dm_nfnet_cfg(depths=(7, 14, 42, 21)),
+
+    nfnet_f0=_nfnet_cfg(depths=(1, 2, 6, 3)),
+    nfnet_f1=_nfnet_cfg(depths=(2, 4, 12, 6)),
+    nfnet_f2=_nfnet_cfg(depths=(3, 6, 18, 9)),
+    nfnet_f3=_nfnet_cfg(depths=(4, 8, 24, 12)),
+    nfnet_f4=_nfnet_cfg(depths=(5, 10, 30, 15)),
+    nfnet_f5=_nfnet_cfg(depths=(6, 12, 36, 18)),
+    nfnet_f6=_nfnet_cfg(depths=(7, 14, 42, 21)),
+    nfnet_f7=_nfnet_cfg(depths=(8, 16, 48, 24)),
+
+    nfnet_l0=_nfnet_cfg(
+        depths=(1, 2, 6, 3), feat_mult=1.5, group_size=64, bottle_ratio=0.25,
+        attn_kwargs=dict(rd_ratio=0.25, rd_divisor=8), act_layer='silu'),
+    eca_nfnet_l0=_nfnet_cfg(
+        depths=(1, 2, 6, 3), feat_mult=1.5, group_size=64, bottle_ratio=0.25,
+        attn_layer='eca', attn_kwargs=dict(), act_layer='silu'),
+    eca_nfnet_l1=_nfnet_cfg(
+        depths=(2, 4, 12, 6), feat_mult=2, group_size=64, bottle_ratio=0.25,
+        attn_layer='eca', attn_kwargs=dict(), act_layer='silu'),
+    eca_nfnet_l2=_nfnet_cfg(
+        depths=(3, 6, 18, 9), feat_mult=2, group_size=64, bottle_ratio=0.25,
+        attn_layer='eca', attn_kwargs=dict(), act_layer='silu'),
+    eca_nfnet_l3=_nfnet_cfg(
+        depths=(4, 8, 24, 12), feat_mult=2, group_size=64, bottle_ratio=0.25,
+        attn_layer='eca', attn_kwargs=dict(), act_layer='silu'),
+
+    nf_regnet_b0=_nfreg_cfg(depths=(1, 3, 6, 6)),
+    nf_regnet_b1=_nfreg_cfg(depths=(2, 4, 7, 7)),
+    nf_regnet_b2=_nfreg_cfg(depths=(2, 4, 8, 8), channels=(56, 112, 232, 488)),
+    nf_regnet_b3=_nfreg_cfg(depths=(2, 5, 9, 9), channels=(56, 128, 248, 528)),
+    nf_regnet_b4=_nfreg_cfg(depths=(2, 6, 11, 11), channels=(64, 144, 288, 616)),
+    nf_regnet_b5=_nfreg_cfg(depths=(3, 7, 14, 14), channels=(80, 168, 336, 704)),
+
+    nf_resnet26=_nfres_cfg(depths=(2, 2, 2, 2)),
+    nf_resnet50=_nfres_cfg(depths=(3, 4, 6, 3)),
+    nf_resnet101=_nfres_cfg(depths=(3, 4, 23, 3)),
+
+    nf_seresnet26=_nfres_cfg(depths=(2, 2, 2, 2), attn_layer='se', attn_kwargs=dict(rd_ratio=1 / 16)),
+    nf_seresnet50=_nfres_cfg(depths=(3, 4, 6, 3), attn_layer='se', attn_kwargs=dict(rd_ratio=1 / 16)),
+    nf_seresnet101=_nfres_cfg(depths=(3, 4, 23, 3), attn_layer='se', attn_kwargs=dict(rd_ratio=1 / 16)),
+
+    nf_ecaresnet26=_nfres_cfg(depths=(2, 2, 2, 2), attn_layer='eca', attn_kwargs=dict()),
+    nf_ecaresnet50=_nfres_cfg(depths=(3, 4, 6, 3), attn_layer='eca', attn_kwargs=dict()),
+    nf_ecaresnet101=_nfres_cfg(depths=(3, 4, 23, 3), attn_layer='eca', attn_kwargs=dict()),
+
+    test_nfnet=_nfnet_cfg(
+        depths=(1, 1, 1, 1), channels=(32, 64, 96, 128), feat_mult=1.5, group_size=8,
+        bottle_ratio=0.25, attn_kwargs=dict(rd_ratio=0.25, rd_divisor=8), act_layer='silu'),
+)
+
+
+def checkpoint_filter_fn(state_dict, model):
+    """Reference nfnet layouts map 1:1; the ScaledStdConv gain is stored
+    (C, 1, 1, 1) in torch and (C,) here."""
+    from ._torch_convert import convert_torch_state_dict
+    out = {}
+    for k, v in state_dict.items():
+        if k.endswith('.gain') and getattr(v, 'ndim', 0) == 4:
+            v = v.reshape(v.shape[0])
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_normfreenet(variant: str, pretrained: bool = False, **kwargs) -> NormFreeNet:
+    return build_model_with_cfg(
+        NormFreeNet, variant, pretrained,
+        model_cfg=model_cfgs[variant],
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(flatten_sequential=True),
+        **kwargs,
+    )
+
+
+def _dcfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': (7, 7),
+        'crop_pct': 0.9,
+        'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406),
+        'std': (0.229, 0.224, 0.225),
+        'first_conv': 'stem.conv1',
+        'classifier': 'head.fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'dm_nfnet_f0.dm_in1k': _dcfg(input_size=(3, 192, 192), pool_size=(6, 6), test_input_size=(3, 256, 256)),
+    'dm_nfnet_f1.dm_in1k': _dcfg(input_size=(3, 224, 224), test_input_size=(3, 320, 320)),
+    'dm_nfnet_f2.dm_in1k': _dcfg(input_size=(3, 256, 256), pool_size=(8, 8), test_input_size=(3, 352, 352)),
+    'dm_nfnet_f3.dm_in1k': _dcfg(input_size=(3, 320, 320), pool_size=(10, 10), test_input_size=(3, 416, 416)),
+    'dm_nfnet_f4.dm_in1k': _dcfg(input_size=(3, 384, 384), pool_size=(12, 12), test_input_size=(3, 512, 512)),
+    'dm_nfnet_f5.dm_in1k': _dcfg(input_size=(3, 416, 416), pool_size=(13, 13), test_input_size=(3, 544, 544)),
+    'dm_nfnet_f6.dm_in1k': _dcfg(input_size=(3, 448, 448), pool_size=(14, 14), test_input_size=(3, 576, 576)),
+    'nfnet_f0.untrained': _dcfg(input_size=(3, 192, 192), pool_size=(6, 6)),
+    'nfnet_f1.untrained': _dcfg(),
+    'nfnet_f2.untrained': _dcfg(input_size=(3, 256, 256), pool_size=(8, 8)),
+    'nfnet_f3.untrained': _dcfg(input_size=(3, 320, 320), pool_size=(10, 10)),
+    'nfnet_f4.untrained': _dcfg(input_size=(3, 384, 384), pool_size=(12, 12)),
+    'nfnet_f5.untrained': _dcfg(input_size=(3, 416, 416), pool_size=(13, 13)),
+    'nfnet_f6.untrained': _dcfg(input_size=(3, 448, 448), pool_size=(14, 14)),
+    'nfnet_f7.untrained': _dcfg(input_size=(3, 480, 480), pool_size=(15, 15)),
+    'nfnet_l0.ra2_in1k': _dcfg(input_size=(3, 224, 224), test_input_size=(3, 288, 288), crop_pct=1.0),
+    'eca_nfnet_l0.ra2_in1k': _dcfg(input_size=(3, 224, 224), test_input_size=(3, 288, 288), crop_pct=1.0),
+    'eca_nfnet_l1.ra2_in1k': _dcfg(input_size=(3, 256, 256), pool_size=(8, 8), test_input_size=(3, 320, 320), crop_pct=1.0),
+    'eca_nfnet_l2.ra3_in1k': _dcfg(input_size=(3, 320, 320), pool_size=(10, 10), test_input_size=(3, 384, 384), crop_pct=1.0),
+    'eca_nfnet_l3.untrained': _dcfg(input_size=(3, 352, 352), pool_size=(11, 11), test_input_size=(3, 448, 448), crop_pct=1.0),
+    'nf_regnet_b0.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_regnet_b1.ra2_in1k': _dcfg(first_conv='stem.conv', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.9),
+    'nf_regnet_b2.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_regnet_b3.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_regnet_b4.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_regnet_b5.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_resnet26.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_resnet50.ra2_in1k': _dcfg(first_conv='stem.conv', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.94),
+    'nf_resnet101.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_seresnet26.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_seresnet50.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_seresnet101.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_ecaresnet26.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_ecaresnet50.untrained': _dcfg(first_conv='stem.conv'),
+    'nf_ecaresnet101.untrained': _dcfg(first_conv='stem.conv'),
+    'test_nfnet.r160_in1k': _dcfg(input_size=(3, 160, 160), pool_size=(5, 5), crop_pct=0.95),
+})
+
+
+@register_model
+def dm_nfnet_f0(pretrained=False, **kwargs) -> NormFreeNet:
+    """NFNet-F0 w/ DeepMind weight compatibility (SAME padding, gamma-in-act)."""
+    return _create_normfreenet('dm_nfnet_f0', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def dm_nfnet_f1(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('dm_nfnet_f1', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def dm_nfnet_f2(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('dm_nfnet_f2', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def dm_nfnet_f3(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('dm_nfnet_f3', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def dm_nfnet_f4(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('dm_nfnet_f4', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def dm_nfnet_f5(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('dm_nfnet_f5', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def dm_nfnet_f6(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('dm_nfnet_f6', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nfnet_f0(pretrained=False, **kwargs) -> NormFreeNet:
+    """NFNet-F0 (https://arxiv.org/abs/2102.06171)."""
+    return _create_normfreenet('nfnet_f0', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nfnet_f1(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nfnet_f1', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nfnet_f2(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nfnet_f2', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nfnet_f3(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nfnet_f3', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nfnet_f4(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nfnet_f4', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nfnet_f5(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nfnet_f5', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nfnet_f6(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nfnet_f6', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nfnet_f7(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nfnet_f7', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nfnet_l0(pretrained=False, **kwargs) -> NormFreeNet:
+    """NFNet-L0: F0 body with SE rd_ratio 0.25 and SiLU."""
+    return _create_normfreenet('nfnet_l0', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def eca_nfnet_l0(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('eca_nfnet_l0', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def eca_nfnet_l1(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('eca_nfnet_l1', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def eca_nfnet_l2(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('eca_nfnet_l2', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def eca_nfnet_l3(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('eca_nfnet_l3', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_regnet_b0(pretrained=False, **kwargs) -> NormFreeNet:
+    """Norm-free RegNet-B0 (https://arxiv.org/abs/2101.08692)."""
+    return _create_normfreenet('nf_regnet_b0', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_regnet_b1(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_regnet_b1', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_regnet_b2(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_regnet_b2', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_regnet_b3(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_regnet_b3', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_regnet_b4(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_regnet_b4', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_regnet_b5(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_regnet_b5', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_resnet26(pretrained=False, **kwargs) -> NormFreeNet:
+    """Norm-free pre-activation ResNet-26."""
+    return _create_normfreenet('nf_resnet26', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_resnet50(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_resnet50', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_resnet101(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_resnet101', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_seresnet26(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_seresnet26', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_seresnet50(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_seresnet50', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_seresnet101(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_seresnet101', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_ecaresnet26(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_ecaresnet26', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_ecaresnet50(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_ecaresnet50', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def nf_ecaresnet101(pretrained=False, **kwargs) -> NormFreeNet:
+    return _create_normfreenet('nf_ecaresnet101', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def test_nfnet(pretrained=False, **kwargs) -> NormFreeNet:
+    """Minimal NFNet for testing."""
+    return _create_normfreenet('test_nfnet', pretrained=pretrained, **kwargs)

@@ -1,0 +1,665 @@
+"""Pre-activation (v2) ResNets, incl. Big Transfer (BiT) variants
+(reference: timm/models/resnetv2.py:1-1192; He et al. 2016 identity mappings,
+Kolesnikov et al. 2019 BiT).
+
+TPU-first notes: NHWC throughout; the BiT trunk (StdConv + GroupNorm) has no
+batch statistics, so the whole network is a pure function — no train/eval BN
+divergence and no cross-replica stat sync under pjit. The 'fixed' stem pool
+reproduces BiT's zero-pad + VALID max-pool exactly (not -inf padding), which
+matters for sign-indefinite pre-activation features.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    BatchNormAct2d, ClassifierHead, DropPath, EvoNorm2dS0, FilterResponseNormTlu2d,
+    GroupNormAct, StdConv2d, calculate_drop_path_rates, create_conv2d, get_act_fn,
+    get_norm_act_layer, make_divisible,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+from .resnet import avg_pool2d, max_pool2d
+
+__all__ = ['ResNetV2']
+
+
+class PreActBasic(nnx.Module):
+    """Pre-activation basic block (reference resnetv2.py:50-140)."""
+
+    def __init__(self, in_chs, out_chs=None, bottle_ratio=1.0, stride=1, dilation=1,
+                 first_dilation=None, groups=1, act_layer=None, conv_layer=None,
+                 norm_layer=None, proj_layer=None, drop_path_rate=0.,
+                 *, dtype=None, param_dtype=jnp.float32, rngs):
+        first_dilation = first_dilation or dilation
+        conv_layer = conv_layer or StdConv2d
+        norm_layer = norm_layer or partial(GroupNormAct, num_groups=32)
+        out_chs = out_chs or in_chs
+        mid_chs = make_divisible(out_chs * bottle_ratio)
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        if proj_layer is not None and (stride != 1 or first_dilation != dilation or in_chs != out_chs):
+            self.downsample = proj_layer(
+                in_chs, out_chs, stride=stride, dilation=dilation,
+                first_dilation=first_dilation, preact=True,
+                conv_layer=conv_layer, norm_layer=norm_layer, **dd)
+        else:
+            self.downsample = None
+
+        self.norm1 = norm_layer(in_chs, **dd)
+        self.conv1 = conv_layer(in_chs, mid_chs, 3, stride=stride, dilation=first_dilation, groups=groups, **dd)
+        self.norm2 = norm_layer(mid_chs, **dd)
+        self.conv2 = conv_layer(mid_chs, out_chs, 3, dilation=dilation, groups=groups, **dd)
+        self.drop_path = DropPath(drop_path_rate, rngs=rngs)
+
+    def zero_init_last(self):
+        self.conv2.kernel[...] = jnp.zeros_like(self.conv2.kernel[...])
+
+    def __call__(self, x):
+        x_preact = self.norm1(x)
+        shortcut = x
+        if self.downsample is not None:
+            shortcut = self.downsample(x_preact)
+        x = self.conv1(x_preact)
+        x = self.conv2(self.norm2(x))
+        x = self.drop_path(x)
+        return x + shortcut
+
+
+class PreActBottleneck(nnx.Module):
+    """Pre-activation bottleneck block (reference resnetv2.py:142-241)."""
+
+    def __init__(self, in_chs, out_chs=None, bottle_ratio=0.25, stride=1, dilation=1,
+                 first_dilation=None, groups=1, act_layer=None, conv_layer=None,
+                 norm_layer=None, proj_layer=None, drop_path_rate=0.,
+                 *, dtype=None, param_dtype=jnp.float32, rngs):
+        first_dilation = first_dilation or dilation
+        conv_layer = conv_layer or StdConv2d
+        norm_layer = norm_layer or partial(GroupNormAct, num_groups=32)
+        out_chs = out_chs or in_chs
+        mid_chs = make_divisible(out_chs * bottle_ratio)
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        if proj_layer is not None:
+            self.downsample = proj_layer(
+                in_chs, out_chs, stride=stride, dilation=dilation,
+                first_dilation=first_dilation, preact=True,
+                conv_layer=conv_layer, norm_layer=norm_layer, **dd)
+        else:
+            self.downsample = None
+
+        self.norm1 = norm_layer(in_chs, **dd)
+        self.conv1 = conv_layer(in_chs, mid_chs, 1, **dd)
+        self.norm2 = norm_layer(mid_chs, **dd)
+        self.conv2 = conv_layer(mid_chs, mid_chs, 3, stride=stride, dilation=first_dilation, groups=groups, **dd)
+        self.norm3 = norm_layer(mid_chs, **dd)
+        self.conv3 = conv_layer(mid_chs, out_chs, 1, **dd)
+        self.drop_path = DropPath(drop_path_rate, rngs=rngs)
+
+    def zero_init_last(self):
+        self.conv3.kernel[...] = jnp.zeros_like(self.conv3.kernel[...])
+
+    def __call__(self, x):
+        x_preact = self.norm1(x)
+        shortcut = x
+        if self.downsample is not None:
+            shortcut = self.downsample(x_preact)
+        x = self.conv1(x_preact)
+        x = self.conv2(self.norm2(x))
+        x = self.conv3(self.norm3(x))
+        x = self.drop_path(x)
+        return x + shortcut
+
+
+class Bottleneck(nnx.Module):
+    """Post-activation bottleneck, v1.5-style (reference resnetv2.py:243-324)."""
+
+    def __init__(self, in_chs, out_chs=None, bottle_ratio=0.25, stride=1, dilation=1,
+                 first_dilation=None, groups=1, act_layer=None, conv_layer=None,
+                 norm_layer=None, proj_layer=None, drop_path_rate=0.,
+                 *, dtype=None, param_dtype=jnp.float32, rngs):
+        first_dilation = first_dilation or dilation
+        act_layer = act_layer or 'relu'
+        conv_layer = conv_layer or StdConv2d
+        norm_layer = norm_layer or partial(GroupNormAct, num_groups=32)
+        out_chs = out_chs or in_chs
+        mid_chs = make_divisible(out_chs * bottle_ratio)
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        if proj_layer is not None:
+            self.downsample = proj_layer(
+                in_chs, out_chs, stride=stride, dilation=dilation, preact=False,
+                conv_layer=conv_layer, norm_layer=norm_layer, **dd)
+        else:
+            self.downsample = None
+
+        self.conv1 = conv_layer(in_chs, mid_chs, 1, **dd)
+        self.norm1 = norm_layer(mid_chs, **dd)
+        self.conv2 = conv_layer(mid_chs, mid_chs, 3, stride=stride, dilation=first_dilation, groups=groups, **dd)
+        self.norm2 = norm_layer(mid_chs, **dd)
+        self.conv3 = conv_layer(mid_chs, out_chs, 1, **dd)
+        self.norm3 = norm_layer(out_chs, apply_act=False, **dd)
+        self.drop_path = DropPath(drop_path_rate, rngs=rngs)
+        self.act3 = get_act_fn(act_layer)
+
+    def zero_init_last(self):
+        if getattr(self.norm3, 'scale', None) is not None:
+            self.norm3.scale[...] = jnp.zeros_like(self.norm3.scale[...])
+
+    def __call__(self, x):
+        shortcut = x
+        if self.downsample is not None:
+            shortcut = self.downsample(x)
+        x = self.conv1(x)
+        x = self.norm1(x)
+        x = self.conv2(x)
+        x = self.norm2(x)
+        x = self.conv3(x)
+        x = self.norm3(x)
+        x = self.drop_path(x)
+        return self.act3(x + shortcut)
+
+
+class DownsampleConv(nnx.Module):
+    def __init__(self, in_chs, out_chs, stride=1, dilation=1, first_dilation=None,
+                 preact=True, conv_layer=None, norm_layer=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs):
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv = conv_layer(in_chs, out_chs, 1, stride=stride, **dd)
+        self.norm = None if preact else norm_layer(out_chs, apply_act=False, **dd)
+
+    def __call__(self, x):
+        x = self.conv(x)
+        return x if self.norm is None else self.norm(x)
+
+
+class DownsampleAvg(nnx.Module):
+    def __init__(self, in_chs, out_chs, stride=1, dilation=1, first_dilation=None,
+                 preact=True, conv_layer=None, norm_layer=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs):
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.pool_stride = stride if dilation == 1 else 1
+        self.do_pool = stride > 1 or dilation > 1
+        self.conv = conv_layer(in_chs, out_chs, 1, stride=1, **dd)
+        self.norm = None if preact else norm_layer(out_chs, apply_act=False, **dd)
+
+    def __call__(self, x):
+        if self.do_pool:
+            x = avg_pool2d(x, 2, self.pool_stride, pad_same=True)
+        x = self.conv(x)
+        return x if self.norm is None else self.norm(x)
+
+
+class ResNetStage(nnx.Module):
+    """One v2 stage (reference resnetv2.py:398-459)."""
+
+    def __init__(self, in_chs, out_chs, stride, dilation, depth, bottle_ratio=0.25,
+                 groups=1, avg_down=False, block_dpr=None, block_fn=PreActBottleneck,
+                 act_layer=None, conv_layer=None, norm_layer=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs, **block_kwargs):
+        self.grad_checkpointing = False
+        first_dilation = 1 if dilation in (1, 2) else 2
+        layer_kwargs = dict(act_layer=act_layer, conv_layer=conv_layer, norm_layer=norm_layer)
+        proj_layer = DownsampleAvg if avg_down else DownsampleConv
+        prev_chs = in_chs
+        blocks = []
+        for block_idx in range(depth):
+            drop_path_rate = block_dpr[block_idx] if block_dpr else 0.
+            s = stride if block_idx == 0 else 1
+            blocks.append(block_fn(
+                prev_chs, out_chs, stride=s, dilation=dilation, bottle_ratio=bottle_ratio,
+                groups=groups, first_dilation=first_dilation, proj_layer=proj_layer,
+                drop_path_rate=drop_path_rate, dtype=dtype, param_dtype=param_dtype,
+                rngs=rngs, **layer_kwargs, **block_kwargs))
+            prev_chs = out_chs
+            first_dilation = dilation
+            proj_layer = None
+        self.blocks = nnx.List(blocks)
+
+    def __call__(self, x):
+        if self.grad_checkpointing:
+            return checkpoint_seq(self.blocks, x)
+        for b in self.blocks:
+            x = b(x)
+        return x
+
+
+def is_stem_deep(stem_type: str) -> bool:
+    return any(s in stem_type for s in ('deep', 'tiered'))
+
+
+class Stem(nnx.Module):
+    """v2 stem (reference resnetv2.py:473-519 create_resnetv2_stem)."""
+
+    def __init__(self, in_chs, out_chs=64, stem_type='', preact=True,
+                 conv_layer=StdConv2d, norm_layer=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs):
+        assert stem_type in ('', 'fixed', 'same', 'deep', 'deep_fixed', 'deep_same', 'tiered')
+        norm_layer = norm_layer or partial(GroupNormAct, num_groups=32)
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.deep = is_stem_deep(stem_type)
+        if self.deep:
+            stem_chs = (3 * out_chs // 8, out_chs // 2) if 'tiered' in stem_type \
+                else (out_chs // 2, out_chs // 2)
+            self.conv1 = conv_layer(in_chs, stem_chs[0], kernel_size=3, stride=2, **dd)
+            self.norm1 = norm_layer(stem_chs[0], **dd)
+            self.conv2 = conv_layer(stem_chs[0], stem_chs[1], kernel_size=3, stride=1, **dd)
+            self.norm2 = norm_layer(stem_chs[1], **dd)
+            self.conv3 = conv_layer(stem_chs[1], out_chs, kernel_size=3, stride=1, **dd)
+            self.norm3 = None if preact else norm_layer(out_chs, **dd)
+            self.conv = self.norm = None
+        else:
+            self.conv = conv_layer(in_chs, out_chs, kernel_size=7, stride=2, **dd)
+            self.norm = None if preact else norm_layer(out_chs, **dd)
+            self.conv1 = None
+        # 'fixed' = BiT zero-pad-1 + VALID 3x3/2 max pool; 'same' = TF-SAME pool
+        self.pool_mode = 'fixed' if 'fixed' in stem_type else ('same' if 'same' in stem_type else 'torch')
+
+    def _pool(self, x):
+        if self.pool_mode == 'fixed':
+            x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+            neg = -jnp.inf if x.dtype == jnp.float32 else jnp.finfo(x.dtype).min
+            return jax.lax.reduce_window(
+                x, neg, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), 'VALID')
+        if self.pool_mode == 'same':
+            neg = -jnp.inf if x.dtype == jnp.float32 else jnp.finfo(x.dtype).min
+            return jax.lax.reduce_window(
+                x, neg, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), 'SAME')
+        return max_pool2d(x, 3, 2)
+
+    def __call__(self, x):
+        if self.deep:
+            x = self.norm1(self.conv1(x))
+            x = self.norm2(self.conv2(x))
+            x = self.conv3(x)
+            if self.norm3 is not None:
+                x = self.norm3(x)
+        else:
+            x = self.conv(x)
+            if self.norm is not None:
+                x = self.norm(x)
+        return self._pool(x)
+
+
+class ResNetV2(nnx.Module):
+    """Pre-activation ResNet (reference resnetv2.py:521-795)."""
+
+    def __init__(
+            self,
+            layers: Tuple[int, ...],
+            channels: Tuple[int, ...] = (256, 512, 1024, 2048),
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            global_pool: str = 'avg',
+            output_stride: int = 32,
+            width_factor: int = 1,
+            stem_chs: int = 64,
+            stem_type: str = '',
+            avg_down: bool = False,
+            preact: bool = True,
+            basic: bool = False,
+            bottle_ratio: float = 0.25,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = partial(GroupNormAct, num_groups=32),
+            conv_layer: Callable = StdConv2d,
+            drop_rate: float = 0.,
+            drop_path_rate: float = 0.,
+            zero_init_last: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: Optional[nnx.Rngs] = None,
+    ):
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        wf = width_factor
+        norm_layer = get_norm_act_layer(norm_layer, act_layer=act_layer)
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        self.feature_info = []
+        stem_chs = make_divisible(stem_chs * wf)
+        self.stem = Stem(in_chans, stem_chs, stem_type, preact,
+                         conv_layer=conv_layer, norm_layer=norm_layer, **dd)
+        stem_feat = ('stem.conv3' if is_stem_deep(stem_type) else 'stem.conv') if preact else 'stem.norm'
+        self.feature_info.append(dict(num_chs=stem_chs, reduction=2, module=stem_feat))
+
+        prev_chs = stem_chs
+        curr_stride = 4
+        dilation = 1
+        block_dprs = calculate_drop_path_rates(drop_path_rate, layers, stagewise=True)
+        if preact:
+            block_fn = PreActBasic if basic else PreActBottleneck
+        else:
+            assert not basic
+            block_fn = Bottleneck
+        stages = []
+        for stage_idx, (d, c, bdpr) in enumerate(zip(layers, channels, block_dprs)):
+            out_chs = make_divisible(c * wf)
+            stride = 1 if stage_idx == 0 else 2
+            if curr_stride >= output_stride:
+                dilation *= stride
+                stride = 1
+            stage = ResNetStage(
+                prev_chs, out_chs, stride=stride, dilation=dilation, depth=d,
+                bottle_ratio=bottle_ratio, avg_down=avg_down, act_layer=act_layer,
+                conv_layer=conv_layer, norm_layer=norm_layer, block_dpr=bdpr,
+                block_fn=block_fn, **dd)
+            prev_chs = out_chs
+            curr_stride *= stride
+            self.feature_info += [dict(num_chs=prev_chs, reduction=curr_stride, module=f'stages.{stage_idx}')]
+            stages.append(stage)
+        self.stages = nnx.List(stages)
+
+        self.num_features = self.head_hidden_size = prev_chs
+        self.norm = norm_layer(self.num_features, **dd) if preact else None
+        self.head = ClassifierHead(
+            self.num_features, num_classes, pool_type=global_pool, drop_rate=drop_rate, **dd)
+
+        if zero_init_last:
+            for stage in self.stages:
+                for b in stage.blocks:
+                    if hasattr(b, 'zero_init_last'):
+                        b.zero_init_last()
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^stem',
+            blocks=r'^stages\.(\d+)' if coarse else [
+                (r'^stages\.(\d+)\.blocks\.(\d+)', None),
+                (r'^norm', (99999,)),
+            ],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        for s in self.stages:
+            s.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        self.head.reset(num_classes, global_pool, rngs=rngs)
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.stem(x)
+        for stage in self.stages:
+            x = stage(x)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        return self.head(x, pre_logits=pre_logits)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(self, x, indices=None, norm: bool = False,
+                              stop_early: bool = False, output_fmt: str = 'NHWC',
+                              intermediates_only: bool = False):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages) + 1, indices)
+        intermediates = []
+        x = self.stem(x)
+        if 0 in take_indices:
+            intermediates.append(x)
+        last_idx = len(self.stages)
+        for feat_idx, stage in enumerate(self.stages, start=1):
+            if stop_early and feat_idx > max_index:
+                break
+            x = stage(x)
+            if feat_idx in take_indices:
+                if feat_idx == last_idx and norm and self.norm is not None:
+                    intermediates.append(self.norm(x))
+                else:
+                    intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        if self.norm is not None:
+            x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stages) + 1, indices)
+        self.stages = nnx.List(list(self.stages)[:max_index])
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    """Reference layouts map 1:1 after handling the BiT conv head
+    (head.fc is a 1x1 Conv2d there, a Linear here)."""
+    from ._torch_convert import convert_torch_state_dict
+    out = {}
+    for k, v in state_dict.items():
+        if k == 'head.fc.weight' and getattr(v, 'ndim', 0) == 4:
+            v = v.reshape(v.shape[0], v.shape[1])  # (N, C, 1, 1) -> (N, C)
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_resnetv2(variant: str, pretrained: bool = False, **kwargs) -> ResNetV2:
+    return build_model_with_cfg(
+        ResNetV2, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(flatten_sequential=True),
+        **kwargs,
+    )
+
+
+def _create_resnetv2_bit(variant: str, pretrained: bool = False, **kwargs) -> ResNetV2:
+    return _create_resnetv2(
+        variant, pretrained=pretrained, stem_type='fixed',
+        conv_layer=partial(StdConv2d, eps=1e-8), **kwargs)
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': (7, 7),
+        'crop_pct': 0.875,
+        'interpolation': 'bilinear',
+        'mean': (0.5, 0.5, 0.5),
+        'std': (0.5, 0.5, 0.5),
+        'first_conv': 'stem.conv',
+        'classifier': 'head.fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'resnetv2_50x1_bit.goog_in21k_ft_in1k': _cfg(),
+    'resnetv2_50x3_bit.goog_in21k_ft_in1k': _cfg(),
+    'resnetv2_101x1_bit.goog_in21k_ft_in1k': _cfg(),
+    'resnetv2_101x3_bit.goog_in21k_ft_in1k': _cfg(),
+    'resnetv2_152x2_bit.goog_in21k_ft_in1k': _cfg(),
+    'resnetv2_152x4_bit.goog_in21k_ft_in1k': _cfg(input_size=(3, 480, 480), pool_size=(15, 15)),
+    'resnetv2_18.ra4_e3600_r224_in1k': _cfg(
+        mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), interpolation='bicubic'),
+    'resnetv2_18d.untrained': _cfg(
+        mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225),
+        interpolation='bicubic', first_conv='stem.conv1'),
+    'resnetv2_34.ra4_e3600_r224_in1k': _cfg(
+        mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), interpolation='bicubic'),
+    'resnetv2_34d.ra4_e3600_r224_in1k': _cfg(
+        mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225),
+        interpolation='bicubic', first_conv='stem.conv1'),
+    'resnetv2_50.a1h_in1k': _cfg(interpolation='bicubic', crop_pct=0.95),
+    'resnetv2_50d.untrained': _cfg(interpolation='bicubic', first_conv='stem.conv1'),
+    'resnetv2_50t.untrained': _cfg(interpolation='bicubic', first_conv='stem.conv1'),
+    'resnetv2_101.a1h_in1k': _cfg(interpolation='bicubic', crop_pct=0.95),
+    'resnetv2_101d.untrained': _cfg(interpolation='bicubic', first_conv='stem.conv1'),
+    'resnetv2_152.untrained': _cfg(interpolation='bicubic'),
+    'resnetv2_152d.untrained': _cfg(interpolation='bicubic', first_conv='stem.conv1'),
+    'resnetv2_50d_gn.ah_in1k': _cfg(
+        interpolation='bicubic', first_conv='stem.conv1', crop_pct=0.95),
+    'resnetv2_50d_evos.ah_in1k': _cfg(
+        interpolation='bicubic', first_conv='stem.conv1', crop_pct=0.95),
+    'resnetv2_50d_frn.untrained': _cfg(interpolation='bicubic', first_conv='stem.conv1'),
+})
+
+
+@register_model
+def resnetv2_50x1_bit(pretrained=False, **kwargs) -> ResNetV2:
+    """Big Transfer (BiT) ResNetV2-50x1."""
+    return _create_resnetv2_bit(
+        'resnetv2_50x1_bit', pretrained=pretrained, layers=(3, 4, 6, 3), width_factor=1, **kwargs)
+
+
+@register_model
+def resnetv2_50x3_bit(pretrained=False, **kwargs) -> ResNetV2:
+    return _create_resnetv2_bit(
+        'resnetv2_50x3_bit', pretrained=pretrained, layers=(3, 4, 6, 3), width_factor=3, **kwargs)
+
+
+@register_model
+def resnetv2_101x1_bit(pretrained=False, **kwargs) -> ResNetV2:
+    return _create_resnetv2_bit(
+        'resnetv2_101x1_bit', pretrained=pretrained, layers=(3, 4, 23, 3), width_factor=1, **kwargs)
+
+
+@register_model
+def resnetv2_101x3_bit(pretrained=False, **kwargs) -> ResNetV2:
+    return _create_resnetv2_bit(
+        'resnetv2_101x3_bit', pretrained=pretrained, layers=(3, 4, 23, 3), width_factor=3, **kwargs)
+
+
+@register_model
+def resnetv2_152x2_bit(pretrained=False, **kwargs) -> ResNetV2:
+    return _create_resnetv2_bit(
+        'resnetv2_152x2_bit', pretrained=pretrained, layers=(3, 8, 36, 3), width_factor=2, **kwargs)
+
+
+@register_model
+def resnetv2_152x4_bit(pretrained=False, **kwargs) -> ResNetV2:
+    return _create_resnetv2_bit(
+        'resnetv2_152x4_bit', pretrained=pretrained, layers=(3, 8, 36, 3), width_factor=4, **kwargs)
+
+
+@register_model
+def resnetv2_18(pretrained=False, **kwargs) -> ResNetV2:
+    """Pre-act ResNet-18 with plain conv + BN."""
+    model_args = dict(
+        layers=(2, 2, 2, 2), channels=(64, 128, 256, 512), basic=True, bottle_ratio=1.0,
+        conv_layer=create_conv2d, norm_layer=BatchNormAct2d)
+    return _create_resnetv2('resnetv2_18', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_18d(pretrained=False, **kwargs) -> ResNetV2:
+    model_args = dict(
+        layers=(2, 2, 2, 2), channels=(64, 128, 256, 512), basic=True, bottle_ratio=1.0,
+        conv_layer=create_conv2d, norm_layer=BatchNormAct2d, stem_type='deep', avg_down=True)
+    return _create_resnetv2('resnetv2_18d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_34(pretrained=False, **kwargs) -> ResNetV2:
+    model_args = dict(
+        layers=(3, 4, 6, 3), channels=(64, 128, 256, 512), basic=True, bottle_ratio=1.0,
+        conv_layer=create_conv2d, norm_layer=BatchNormAct2d)
+    return _create_resnetv2('resnetv2_34', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_34d(pretrained=False, **kwargs) -> ResNetV2:
+    model_args = dict(
+        layers=(3, 4, 6, 3), channels=(64, 128, 256, 512), basic=True, bottle_ratio=1.0,
+        conv_layer=create_conv2d, norm_layer=BatchNormAct2d, stem_type='deep', avg_down=True)
+    return _create_resnetv2('resnetv2_34d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_50(pretrained=False, **kwargs) -> ResNetV2:
+    model_args = dict(layers=(3, 4, 6, 3), conv_layer=create_conv2d, norm_layer=BatchNormAct2d)
+    return _create_resnetv2('resnetv2_50', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_50d(pretrained=False, **kwargs) -> ResNetV2:
+    model_args = dict(
+        layers=(3, 4, 6, 3), conv_layer=create_conv2d, norm_layer=BatchNormAct2d,
+        stem_type='deep', avg_down=True)
+    return _create_resnetv2('resnetv2_50d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_50t(pretrained=False, **kwargs) -> ResNetV2:
+    model_args = dict(
+        layers=(3, 4, 6, 3), conv_layer=create_conv2d, norm_layer=BatchNormAct2d,
+        stem_type='tiered', avg_down=True)
+    return _create_resnetv2('resnetv2_50t', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_101(pretrained=False, **kwargs) -> ResNetV2:
+    model_args = dict(layers=(3, 4, 23, 3), conv_layer=create_conv2d, norm_layer=BatchNormAct2d)
+    return _create_resnetv2('resnetv2_101', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_101d(pretrained=False, **kwargs) -> ResNetV2:
+    model_args = dict(
+        layers=(3, 4, 23, 3), conv_layer=create_conv2d, norm_layer=BatchNormAct2d,
+        stem_type='deep', avg_down=True)
+    return _create_resnetv2('resnetv2_101d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_152(pretrained=False, **kwargs) -> ResNetV2:
+    model_args = dict(layers=(3, 8, 36, 3), conv_layer=create_conv2d, norm_layer=BatchNormAct2d)
+    return _create_resnetv2('resnetv2_152', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_152d(pretrained=False, **kwargs) -> ResNetV2:
+    model_args = dict(
+        layers=(3, 8, 36, 3), conv_layer=create_conv2d, norm_layer=BatchNormAct2d,
+        stem_type='deep', avg_down=True)
+    return _create_resnetv2('resnetv2_152d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_50d_gn(pretrained=False, **kwargs) -> ResNetV2:
+    """Pre-act ResNet-50d with GroupNorm."""
+    model_args = dict(
+        layers=(3, 4, 6, 3), conv_layer=create_conv2d, norm_layer=GroupNormAct,
+        stem_type='deep', avg_down=True)
+    return _create_resnetv2('resnetv2_50d_gn', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_50d_evos(pretrained=False, **kwargs) -> ResNetV2:
+    """Pre-act ResNet-50d with EvoNorm-S0."""
+    model_args = dict(
+        layers=(3, 4, 6, 3), conv_layer=create_conv2d, norm_layer=EvoNorm2dS0,
+        stem_type='deep', avg_down=True)
+    return _create_resnetv2('resnetv2_50d_evos', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetv2_50d_frn(pretrained=False, **kwargs) -> ResNetV2:
+    """Pre-act ResNet-50d with Filter Response Norm + TLU."""
+    model_args = dict(
+        layers=(3, 4, 6, 3), conv_layer=create_conv2d, norm_layer=FilterResponseNormTlu2d,
+        stem_type='deep', avg_down=True)
+    return _create_resnetv2('resnetv2_50d_frn', pretrained=pretrained, **dict(model_args, **kwargs))

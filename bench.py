@@ -13,11 +13,18 @@ reference's CUDA-event timing excludes host overhead (benchmark.py:149-157).
 
 Relay-wedge hardening (rounds 1+2 both recorded 0.0 because a wedged tile
 lease made every device op hang): the parent process never touches the device.
-It probes in throwaway subprocesses with exponential backoff over ~10 min,
+It probes in throwaway subprocesses with growing cooldowns (~10.5 min budget),
 then runs the real measurement in a fresh subprocess (twice if needed) under a
 hard timeout — a fresh process can succeed where a stale probe process wedged.
-If the TPU stays unreachable the whole window, it replays the most recent
-self-measured result committed in BENCH_SELF.json, clearly labelled as such.
+
+Fallback policy: ONLY when the device is provably unreachable (all probes
+failed AND the fresh-process attempts failed) does it replay the most recent
+self-measured result from BENCH_SELF.json — clearly labelled with
+`replay: true`, the original measurement timestamp, and a NONZERO exit code so
+automated consumers can distinguish it from a live measurement. If probes
+succeed but the bench child fails, that is a genuine code regression: it
+reports value 0.0, a nonzero exit code, and the child's stderr tail — never a
+stale number.
 """
 from __future__ import annotations
 
@@ -82,33 +89,44 @@ def _probe_device(timeout_s: int = 120) -> bool:
 
 
 def _probe_with_backoff(total_budget_s: int = 630) -> bool:
-    """6 probe attempts with growing cooldowns (~10.5 min worst case).
+    """Up to 6 probe attempts with linearly growing cooldowns, all bounded by
+    total_budget_s (default 630s ≈ 10.5 min worst case: cooldowns and probe
+    timeouts are both shrunk to fit the remaining budget).
     Returns True as soon as one succeeds."""
-    cooldowns = [0, 30, 60, 90, 120, 150]  # + 6 × 120s probe timeouts ≈ 19 min cap
+    cooldowns = [0, 30, 60, 90, 120, 150]
     start = time.time()
-    for i, cd in enumerate(cooldowns):
-        if cd:
-            time.sleep(cd)
-        if _probe_device(timeout_s=min(120, max(30, total_budget_s - int(time.time() - start)))):
-            return True
-        if time.time() - start > total_budget_s:
+    for cd in cooldowns:
+        remaining = total_budget_s - (time.time() - start)
+        if remaining <= 0:
             break
+        if cd:
+            time.sleep(min(cd, remaining))
+        remaining = total_budget_s - (time.time() - start)
+        if remaining <= 0:
+            break
+        if _probe_device(timeout_s=int(min(120, max(30, remaining)))):
+            return True
     return False
 
 
 def _replay_self_result(reason: str) -> int:
-    """Last-resort fallback: replay the most recent self-measured result that
-    was committed during the round, clearly labelled so the judge knows it was
-    measured earlier in the round rather than at driver-bench time."""
+    """Last-resort fallback, used ONLY when the device is provably unreachable
+    (all probes failed): replay the most recent self-measured result committed
+    during the round. The output is explicitly labelled (`replay: true`,
+    original timestamp in `measured_at`) and the exit code is nonzero (3) so
+    automated consumers can tell it apart from a live driver-time measurement."""
     try:
         with open(SELF_RESULT_PATH) as f:
             saved = json.load(f)
         out = dict(saved['result'])
+        out['replay'] = True
+        out['measured_at'] = saved.get('measured_at', '?')
+        out['replay_reason'] = reason
         out['metric'] = (
             f"REPLAY of self-measured result from {saved.get('measured_at', '?')} "
             f"({reason}; see BENCH_SELF.json): " + out['metric'])
         print(json.dumps(out), flush=True)
-        return 0
+        return 3
     except Exception:
         print(json.dumps({
             'metric': f'benchmark aborted: {reason}; no BENCH_SELF.json to replay',
@@ -126,7 +144,11 @@ def _run_child(args, timeout_s: int) -> dict | None:
         cmd += ['--batch-size', str(args.batch_size)]
     try:
         r = subprocess.run(cmd, timeout=timeout_s, capture_output=True, text=True)
-    except Exception:
+    except subprocess.TimeoutExpired:
+        print(f'bench child timed out after {timeout_s}s', file=sys.stderr, flush=True)
+        return None
+    except Exception as e:
+        print(f'bench child failed to launch: {e!r}', file=sys.stderr, flush=True)
         return None
     for line in reversed((r.stdout or '').strip().splitlines()):
         try:
@@ -135,6 +157,10 @@ def _run_child(args, timeout_s: int) -> dict | None:
                 return d
         except Exception:
             continue
+    # no parseable result: surface the child's diagnostics to the driver log
+    tail = '\n'.join((r.stderr or '').strip().splitlines()[-15:])
+    print(f'bench child rc={r.returncode}, no result line; stderr tail:\n{tail}',
+          file=sys.stderr, flush=True)
     return None
 
 
@@ -166,15 +192,14 @@ def main():
     if not args.no_probe:
         probed_ok = _probe_with_backoff()
 
-    # Even if every probe failed, still attempt the real run: the probe
-    # process itself may have wedged where a fresh process would not.
-    attempts = 2 if probed_ok else 1
+    # Even if every probe failed, still attempt the real run (twice): the
+    # probe process itself may have wedged where a fresh process would not.
     result = None
-    for i in range(attempts):
+    for i in range(2):
         result = _run_child(args, child_timeout)
         if result is not None and result.get('value', 0) > 0:
             break
-        if i + 1 < attempts:
+        if i == 0:
             time.sleep(60)
 
     if result is not None and result.get('value', 0) > 0:
@@ -185,10 +210,18 @@ def main():
                            'result': result}, f, indent=1)
         raise SystemExit(0)
 
-    reason = ('TPU unreachable: probes failed over ~10min backoff window and a fresh-process '
-              'bench attempt also failed' if not probed_ok else
-              'bench subprocess failed/timed out twice despite a live probe')
-    raise SystemExit(_replay_self_result(reason))
+    if not probed_ok:
+        # Device provably unreachable: replay is honest here (and exits 3).
+        raise SystemExit(_replay_self_result(
+            'TPU unreachable: probes failed over ~10min backoff window and two '
+            'fresh-process bench attempts also failed'))
+    # Probes succeeded but the bench failed twice: a genuine regression.
+    # Never mask it with a stale replay — report 0.0 and fail.
+    print(json.dumps({
+        'metric': 'benchmark FAILED: bench subprocess failed/timed out twice despite a '
+                  'live device probe (likely code regression; see stderr)',
+        'value': 0.0, 'unit': 'img/s/chip', 'vs_baseline': None}), flush=True)
+    raise SystemExit(2)
 
 
 def _measure(args) -> int:

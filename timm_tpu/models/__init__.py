@@ -13,7 +13,9 @@ from ._registry import (
     list_pretrained, model_entrypoint, register_model, split_model_name_tag,
 )
 
+from .beit import Beit
 from .byobnet import ByoBlockCfg, ByoModelCfg, ByobNet
+from .cait import Cait
 from .convnext import ConvNeXt
 from .deit import VisionTransformerDistilled
 from .densenet import DenseNet
@@ -27,5 +29,6 @@ from .regnet import RegNet
 from .resnet import ResNet
 from .resnetv2 import ResNetV2
 from .swin_transformer import SwinTransformer
+from .swin_transformer_v2 import SwinTransformerV2
 from .vgg import VGG
 from .vision_transformer import VisionTransformer

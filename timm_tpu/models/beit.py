@@ -1,0 +1,519 @@
+"""BEiT: BERT Pre-Training of Image Transformers, TPU-native
+(reference: timm/models/beit.py:1-1065).
+
+BEiT v1/v2 share one trunk: a ViT with NO absolute position embedding,
+per-block (or shared) relative position bias with three extra cls-token
+buckets, decomposed q/v biases (k bias fixed at zero), and layer-scale
+residuals. TPU-first notes: the rel-pos gather index is a trace-time numpy
+constant (see layers/pos_embed_rel.py), so each block's bias is one static
+gather fused into the attention logits; blocks are rematerialisable via
+checkpoint_seq.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    DropPath, Dropout, LayerNorm, Mlp, PatchEmbed, SwiGLU,
+    calculate_drop_path_rates, get_norm_layer, global_pool_nlc, to_2tuple,
+    trunc_normal_, zeros_,
+)
+from ..layers.attention import scaled_dot_product_attention
+from ..layers.drop import dropout_rng_key
+from ..layers.pos_embed_rel import RelPosBias
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['Beit', 'BeitBlock', 'BeitAttention']
+
+
+class BeitAttention(nnx.Module):
+    """MHSA with decomposed q/v bias and optional windowed rel-pos bias
+    (reference beit.py:108-275)."""
+
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int = 8,
+            qkv_bias: bool = False,
+            attn_drop: float = 0.0,
+            proj_drop: float = 0.0,
+            window_size: Optional[Tuple[int, int]] = None,
+            attn_head_dim: Optional[int] = None,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.num_heads = num_heads
+        head_dim = attn_head_dim if attn_head_dim is not None else dim // num_heads
+        all_head_dim = head_dim * num_heads
+        self.head_dim = head_dim
+        self.scale = head_dim ** -0.5
+
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.qkv = linear(dim, all_head_dim * 3, use_bias=False)
+        if qkv_bias:
+            self.q_bias = nnx.Param(jnp.zeros((all_head_dim,), param_dtype))
+            self.v_bias = nnx.Param(jnp.zeros((all_head_dim,), param_dtype))
+        else:
+            self.q_bias = None
+            self.v_bias = None
+
+        if window_size:
+            # per-block rel-pos bias incl. cls buckets; table zero-init as in
+            # the reference so pretraining parity holds at init
+            self.rel_pos_bias = RelPosBias(
+                window_size=to_2tuple(window_size), num_heads=num_heads, prefix_tokens=1,
+                param_dtype=param_dtype, rngs=rngs)
+            self.rel_pos_bias.relative_position_bias_table[...] = jnp.zeros_like(
+                self.rel_pos_bias.relative_position_bias_table[...])
+        else:
+            self.rel_pos_bias = None
+
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = linear(all_head_dim, dim)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x, shared_rel_pos_bias=None):
+        B, N, C = x.shape
+        qkv = self.qkv(x)
+        if self.q_bias is not None:
+            bias = jnp.concatenate([
+                self.q_bias[...], jnp.zeros_like(self.q_bias[...]), self.v_bias[...]])
+            qkv = qkv + bias.astype(qkv.dtype)
+        qkv = qkv.reshape(B, N, 3, self.num_heads, self.head_dim).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+
+        attn_bias = None
+        if self.rel_pos_bias is not None:
+            attn_bias = self.rel_pos_bias.get_bias()
+            if shared_rel_pos_bias is not None:
+                attn_bias = attn_bias + shared_rel_pos_bias
+        elif shared_rel_pos_bias is not None:
+            attn_bias = shared_rel_pos_bias
+
+        if attn_bias is not None:
+            attn_bias = jnp.broadcast_to(
+                attn_bias.astype(jnp.float32), (B, self.num_heads, N, N))
+        dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop.rate
+        dropout_key = dropout_rng_key(self.attn_drop) if dropout_p > 0.0 else None
+        x = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_bias, dropout_p=dropout_p, dropout_key=dropout_key,
+            scale=self.scale, fused=False)
+        x = x.transpose(0, 2, 1, 3).reshape(B, N, -1)
+        x = self.proj(x)
+        return self.proj_drop(x)
+
+
+class BeitBlock(nnx.Module):
+    """Pre-norm block w/ named gamma_1/gamma_2 layer scale (reference beit.py:277-391)."""
+
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int,
+            qkv_bias: bool = False,
+            mlp_ratio: float = 4.0,
+            scale_mlp: bool = False,
+            swiglu_mlp: bool = False,
+            proj_drop: float = 0.0,
+            attn_drop: float = 0.0,
+            drop_path: float = 0.0,
+            init_values: Optional[float] = None,
+            act_layer: Union[str, Callable] = 'gelu',
+            norm_layer: Callable = LayerNorm,
+            window_size: Optional[Tuple[int, int]] = None,
+            attn_head_dim: Optional[int] = None,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.attn = BeitAttention(
+            dim, num_heads=num_heads, qkv_bias=qkv_bias, attn_drop=attn_drop,
+            proj_drop=proj_drop, window_size=window_size, attn_head_dim=attn_head_dim,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        if swiglu_mlp:
+            self.mlp = SwiGLU(
+                dim, hidden_features=int(dim * mlp_ratio),
+                norm_layer=norm_layer if scale_mlp else None, drop=proj_drop,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        else:
+            self.mlp = Mlp(
+                dim, hidden_features=int(dim * mlp_ratio), act_layer=act_layer,
+                norm_layer=norm_layer if scale_mlp else None, drop=proj_drop,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+        if init_values:
+            self.gamma_1 = nnx.Param(jnp.full((dim,), init_values, param_dtype))
+            self.gamma_2 = nnx.Param(jnp.full((dim,), init_values, param_dtype))
+        else:
+            self.gamma_1 = None
+            self.gamma_2 = None
+
+    def __call__(self, x, shared_rel_pos_bias=None):
+        y = self.attn(self.norm1(x), shared_rel_pos_bias=shared_rel_pos_bias)
+        if self.gamma_1 is not None:
+            y = y * self.gamma_1[...].astype(y.dtype)
+        x = x + self.drop_path1(y)
+        y = self.mlp(self.norm2(x))
+        if self.gamma_2 is not None:
+            y = y * self.gamma_2[...].astype(y.dtype)
+        x = x + self.drop_path2(y)
+        return x
+
+
+class Beit(nnx.Module):
+    """BEiT with the reference's full model contract (reference beit.py:448-905)."""
+
+    def __init__(
+            self,
+            img_size: Union[int, Tuple[int, int]] = 224,
+            patch_size: Union[int, Tuple[int, int]] = 16,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            embed_dim: int = 768,
+            depth: int = 12,
+            num_heads: int = 12,
+            qkv_bias: bool = True,
+            mlp_ratio: float = 4.0,
+            swiglu_mlp: bool = False,
+            scale_mlp: bool = False,
+            drop_rate: float = 0.0,
+            pos_drop_rate: float = 0.0,
+            proj_drop_rate: float = 0.0,
+            attn_drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            norm_layer: Optional[Union[str, Callable]] = None,
+            init_values: Optional[float] = None,
+            use_abs_pos_emb: bool = True,
+            use_rel_pos_bias: bool = False,
+            use_shared_rel_pos_bias: bool = False,
+            head_init_scale: float = 0.001,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        norm_layer = get_norm_layer(norm_layer) or LayerNorm
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.num_features = self.head_hidden_size = self.embed_dim = embed_dim
+        self.num_prefix_tokens = 1
+        self.grad_checkpointing = False
+
+        self.patch_embed = PatchEmbed(
+            img_size=img_size, patch_size=patch_size, in_chans=in_chans,
+            embed_dim=embed_dim, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        num_patches = self.patch_embed.num_patches
+        r = self.patch_embed.patch_size[0]
+
+        self.cls_token = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (1, 1, embed_dim), param_dtype))
+        self.pos_embed = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (1, num_patches + 1, embed_dim), param_dtype)) \
+            if use_abs_pos_emb else None
+        self.pos_drop = Dropout(pos_drop_rate, rngs=rngs)
+
+        if use_shared_rel_pos_bias:
+            self.rel_pos_bias = RelPosBias(
+                window_size=self.patch_embed.grid_size, num_heads=num_heads, prefix_tokens=1,
+                param_dtype=param_dtype, rngs=rngs)
+            self.rel_pos_bias.relative_position_bias_table[...] = jnp.zeros_like(
+                self.rel_pos_bias.relative_position_bias_table[...])
+        else:
+            self.rel_pos_bias = None
+
+        dpr = calculate_drop_path_rates(drop_path_rate, depth)
+        self.blocks = nnx.List([
+            BeitBlock(
+                dim=embed_dim,
+                num_heads=num_heads,
+                qkv_bias=qkv_bias,
+                mlp_ratio=mlp_ratio,
+                scale_mlp=scale_mlp,
+                swiglu_mlp=swiglu_mlp,
+                proj_drop=proj_drop_rate,
+                attn_drop=attn_drop_rate,
+                drop_path=dpr[i],
+                norm_layer=norm_layer,
+                init_values=init_values,
+                window_size=self.patch_embed.grid_size if use_rel_pos_bias else None,
+                dtype=dtype,
+                param_dtype=param_dtype,
+                rngs=rngs,
+            )
+            for i in range(depth)
+        ])
+        self.feature_info = [
+            dict(module=f'blocks.{i}', num_chs=embed_dim, reduction=r) for i in range(depth)]
+
+        use_fc_norm = global_pool == 'avg'
+        self.norm = None if use_fc_norm else norm_layer(embed_dim, rngs=rngs)
+        self.fc_norm = norm_layer(embed_dim, rngs=rngs) if use_fc_norm else None
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        if num_classes > 0:
+            self.head = nnx.Linear(
+                embed_dim, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            if head_init_scale:
+                self.head.kernel[...] = self.head.kernel[...] * head_init_scale
+                self.head.bias[...] = self.head.bias[...] * head_init_scale
+        else:
+            self.head = None
+
+        # BEiT depth-rescaled init
+        for layer_id, block in enumerate(self.blocks):
+            scale = math.sqrt(2.0 * (layer_id + 1))
+            block.attn.proj.kernel[...] = block.attn.proj.kernel[...] / scale
+            block.mlp.fc2.kernel[...] = block.mlp.fc2.kernel[...] / scale
+
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'pos_embed', 'cls_token', 'relative_position_bias_table'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^cls_token|pos_embed|patch_embed|rel_pos_bias',
+            blocks=[(r'^blocks\.(\d+)', None), (r'^norm', (99999,))],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = nnx.Linear(
+            self.embed_dim, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs,
+        ) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.patch_embed(x)
+        B = x.shape[0]
+        cls = jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (B, 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1)
+        if self.pos_embed is not None:
+            x = x + self.pos_embed[...].astype(x.dtype)
+        x = self.pos_drop(x)
+
+        shared_bias = self.rel_pos_bias.get_bias() if self.rel_pos_bias is not None else None
+        if self.grad_checkpointing:
+            if shared_bias is None:
+                x = checkpoint_seq(self.blocks, x)
+            else:
+                # remat per block with the shared bias as a traced arg so nnx
+                # graph handling sees the module directly (not via a partial)
+                remat_block = nnx.remat(lambda blk, x_, b: blk(x_, shared_rel_pos_bias=b))
+                for blk in self.blocks:
+                    x = remat_block(blk, x, shared_bias)
+        else:
+            for blk in self.blocks:
+                x = blk(x, shared_rel_pos_bias=shared_bias)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = global_pool_nlc(x, pool_type=self.global_pool, num_prefix_tokens=self.num_prefix_tokens)
+        if self.fc_norm is not None:
+            x = self.fc_norm(x)
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, return_prefix_tokens: bool = False, norm: bool = False,
+            stop_early: bool = False, output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt in ('NHWC', 'NLC')
+        reshape = output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        B, H, W, _ = x.shape
+        grid = self.patch_embed.grid_size
+        x = self.patch_embed(x)
+        cls = jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (B, 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1)
+        if self.pos_embed is not None:
+            x = x + self.pos_embed[...].astype(x.dtype)
+        x = self.pos_drop(x)
+        shared_bias = self.rel_pos_bias.get_bias() if self.rel_pos_bias is not None else None
+
+        intermediates = []
+        blocks = self.blocks if not stop_early else list(self.blocks)[:max_index + 1]
+        for i, blk in enumerate(blocks):
+            x = blk(x, shared_rel_pos_bias=shared_bias)
+            if i in take_indices:
+                intermediates.append(self.norm(x) if (norm and self.norm is not None) else x)
+
+        prefix_tokens = [y[:, 0:self.num_prefix_tokens] for y in intermediates]
+        intermediates = [y[:, self.num_prefix_tokens:] for y in intermediates]
+        if reshape:
+            intermediates = [y.reshape(B, grid[0], grid[1], -1) for y in intermediates]
+        if return_prefix_tokens:
+            intermediates = list(zip(intermediates, prefix_tokens))
+        if intermediates_only:
+            return intermediates
+        if self.norm is not None:
+            x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.fc_norm = None
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': None,
+        'crop_pct': 0.9,
+        'interpolation': 'bicubic',
+        'fixed_input_size': True,
+        'mean': (0.5, 0.5, 0.5),
+        'std': (0.5, 0.5, 0.5),
+        'first_conv': 'patch_embed.proj',
+        'classifier': 'head',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'beit_base_patch16_224.in22k_ft_in22k_in1k': _cfg(hf_hub_id='timm/'),
+    'beit_base_patch16_384.in22k_ft_in22k_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'beit_large_patch16_224.in22k_ft_in22k_in1k': _cfg(hf_hub_id='timm/'),
+    'beit_large_patch16_384.in22k_ft_in22k_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'beit_large_patch16_512.in22k_ft_in22k_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 512, 512), crop_pct=1.0),
+    'beitv2_base_patch16_224.in1k_ft_in22k_in1k': _cfg(
+        hf_hub_id='timm/', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'beitv2_large_patch16_224.in1k_ft_in22k_in1k': _cfg(
+        hf_hub_id='timm/', crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'test_beit.untrained': _cfg(input_size=(3, 96, 96)),
+})
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    out = {}
+    for k, v in state_dict.items():
+        if 'relative_position_index' in k or k in ('mask_token',):
+            continue
+        # torch keeps per-attn tables at attn.relative_position_bias_table;
+        # ours nest inside attn.rel_pos_bias
+        k = k.replace('attn.relative_position_bias_table', 'attn.rel_pos_bias.relative_position_bias_table')
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_beit(variant: str, pretrained: bool = False, **kwargs) -> Beit:
+    out_indices = kwargs.pop('out_indices', 3)
+    return build_model_with_cfg(
+        Beit, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices),
+        **kwargs,
+    )
+
+
+@register_model
+def beit_base_patch16_224(pretrained=False, **kwargs) -> Beit:
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, mlp_ratio=4,
+        use_abs_pos_emb=False, use_rel_pos_bias=True, init_values=0.1)
+    return _create_beit('beit_base_patch16_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def beit_base_patch16_384(pretrained=False, **kwargs) -> Beit:
+    model_args = dict(
+        img_size=384, patch_size=16, embed_dim=768, depth=12, num_heads=12,
+        use_abs_pos_emb=False, use_rel_pos_bias=True, init_values=0.1)
+    return _create_beit('beit_base_patch16_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def beit_large_patch16_224(pretrained=False, **kwargs) -> Beit:
+    model_args = dict(
+        patch_size=16, embed_dim=1024, depth=24, num_heads=16,
+        use_abs_pos_emb=False, use_rel_pos_bias=True, init_values=1e-5)
+    return _create_beit('beit_large_patch16_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def beit_large_patch16_384(pretrained=False, **kwargs) -> Beit:
+    model_args = dict(
+        img_size=384, patch_size=16, embed_dim=1024, depth=24, num_heads=16,
+        use_abs_pos_emb=False, use_rel_pos_bias=True, init_values=1e-5)
+    return _create_beit('beit_large_patch16_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def beit_large_patch16_512(pretrained=False, **kwargs) -> Beit:
+    model_args = dict(
+        img_size=512, patch_size=16, embed_dim=1024, depth=24, num_heads=16,
+        use_abs_pos_emb=False, use_rel_pos_bias=True, init_values=1e-5)
+    return _create_beit('beit_large_patch16_512', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def beitv2_base_patch16_224(pretrained=False, **kwargs) -> Beit:
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, mlp_ratio=4,
+        use_abs_pos_emb=False, use_rel_pos_bias=True, init_values=1e-5)
+    return _create_beit('beitv2_base_patch16_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def beitv2_large_patch16_224(pretrained=False, **kwargs) -> Beit:
+    model_args = dict(
+        patch_size=16, embed_dim=1024, depth=24, num_heads=16,
+        use_abs_pos_emb=False, use_rel_pos_bias=True, init_values=1e-5)
+    return _create_beit('beitv2_large_patch16_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_beit(pretrained=False, **kwargs) -> Beit:
+    model_args = dict(
+        img_size=96, patch_size=16, embed_dim=64, depth=2, num_heads=2, mlp_ratio=3,
+        use_abs_pos_emb=False, use_rel_pos_bias=True, init_values=1e-5)
+    return _create_beit('test_beit', pretrained=pretrained, **dict(model_args, **kwargs))

@@ -1,0 +1,453 @@
+"""CaiT: Class-Attention in Image Transformers, TPU-native
+(reference: timm/models/cait.py:1-632; Touvron et al., 'Going deeper with
+Image Transformers').
+
+Two-phase trunk: `depth` self-attention blocks with Talking-Heads attention
+over patch tokens only (no cls token), then `depth_token_only` class-attention
+blocks where a cls token cross-attends the frozen patch sequence. TPU-first
+notes: talking-heads' cross-head mixes are expressed as einsums over the head
+axis (two tiny (H, H) matmuls XLA fuses around the softmax), and the
+class-attention query is a rank-3 slice so the second phase is O(N) not O(N²).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    DropPath, Dropout, LayerNorm, Mlp, PatchEmbed,
+    get_norm_layer, trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['Cait', 'ClassAttn', 'TalkingHeadAttn']
+
+
+class ClassAttn(nnx.Module):
+    """Cls-token-query cross attention (reference cait.py:27-79)."""
+
+    def __init__(self, dim: int, num_heads: int = 8, qkv_bias: bool = False,
+                 attn_drop: float = 0.0, proj_drop: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.scale = self.head_dim ** -0.5
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.q = linear(dim, dim, use_bias=qkv_bias)
+        self.k = linear(dim, dim, use_bias=qkv_bias)
+        self.v = linear(dim, dim, use_bias=qkv_bias)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = linear(dim, dim)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x):
+        B, N, C = x.shape
+        q = self.q(x[:, 0:1]).reshape(B, 1, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        k = self.k(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        v = self.v(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        attn = jnp.einsum('bhqd,bhkd->bhqk', q * self.scale, k)
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = self.attn_drop(attn)
+        x_cls = jnp.einsum('bhqk,bhkd->bhqd', attn, v)
+        x_cls = x_cls.transpose(0, 2, 1, 3).reshape(B, 1, C)
+        x_cls = self.proj(x_cls)
+        return self.proj_drop(x_cls)
+
+
+class TalkingHeadAttn(nnx.Module):
+    """MHSA with pre/post-softmax head mixing (reference cait.py:132-182;
+    Shazeer et al., 'Talking-Heads Attention')."""
+
+    def __init__(self, dim: int, num_heads: int = 8, qkv_bias: bool = False,
+                 attn_drop: float = 0.0, proj_drop: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.scale = self.head_dim ** -0.5
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.qkv = linear(dim, dim * 3, use_bias=qkv_bias)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = linear(dim, dim)
+        self.proj_l = linear(num_heads, num_heads)
+        self.proj_w = linear(num_heads, num_heads)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x):
+        B, N, C = x.shape
+        qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, self.head_dim).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0] * self.scale, qkv[1], qkv[2]
+        attn = jnp.einsum('bhnd,bhmd->bhnm', q, k)
+        # head-mixing linears act on the head axis: move it last, matmul, move back
+        attn = self.proj_l(attn.transpose(0, 2, 3, 1)).transpose(0, 3, 1, 2)
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = self.proj_w(attn.transpose(0, 2, 3, 1)).transpose(0, 3, 1, 2)
+        attn = self.attn_drop(attn)
+        x = jnp.einsum('bhnm,bhmd->bhnd', attn, v)
+        x = x.transpose(0, 2, 1, 3).reshape(B, N, C)
+        x = self.proj(x)
+        return self.proj_drop(x)
+
+
+class LayerScaleBlock(nnx.Module):
+    """Self-attn block w/ named gamma layer scale (reference cait.py:184-231)."""
+
+    def __init__(self, dim: int, num_heads: int, mlp_ratio: float = 4.0, qkv_bias: bool = False,
+                 proj_drop: float = 0.0, attn_drop: float = 0.0, drop_path: float = 0.0,
+                 act_layer: Union[str, Callable] = 'gelu', norm_layer: Callable = LayerNorm,
+                 attn_block: Callable = TalkingHeadAttn, mlp_block: Callable = Mlp,
+                 init_values: float = 1e-4,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.attn = attn_block(
+            dim, num_heads=num_heads, qkv_bias=qkv_bias, attn_drop=attn_drop,
+            proj_drop=proj_drop, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp = mlp_block(dim, hidden_features=int(dim * mlp_ratio), act_layer=act_layer,
+                             drop=proj_drop, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.gamma_1 = nnx.Param(jnp.full((dim,), init_values, param_dtype))
+        self.gamma_2 = nnx.Param(jnp.full((dim,), init_values, param_dtype))
+
+    def __call__(self, x):
+        x = x + self.drop_path(self.gamma_1[...].astype(x.dtype) * self.attn(self.norm1(x)))
+        x = x + self.drop_path(self.gamma_2[...].astype(x.dtype) * self.mlp(self.norm2(x)))
+        return x
+
+
+class LayerScaleBlockClassAttn(nnx.Module):
+    """Class-attention block: cls token attends [cls; patches]
+    (reference cait.py:81-130)."""
+
+    def __init__(self, dim: int, num_heads: int, mlp_ratio: float = 4.0, qkv_bias: bool = False,
+                 proj_drop: float = 0.0, attn_drop: float = 0.0, drop_path: float = 0.0,
+                 act_layer: Union[str, Callable] = 'gelu', norm_layer: Callable = LayerNorm,
+                 attn_block: Callable = ClassAttn, mlp_block: Callable = Mlp,
+                 init_values: float = 1e-4,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.attn = attn_block(
+            dim, num_heads=num_heads, qkv_bias=qkv_bias, attn_drop=attn_drop,
+            proj_drop=proj_drop, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp = mlp_block(dim, hidden_features=int(dim * mlp_ratio), act_layer=act_layer,
+                             drop=proj_drop, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.gamma_1 = nnx.Param(jnp.full((dim,), init_values, param_dtype))
+        self.gamma_2 = nnx.Param(jnp.full((dim,), init_values, param_dtype))
+
+    def __call__(self, x, x_cls):
+        u = jnp.concatenate([x_cls, x], axis=1)
+        x_cls = x_cls + self.drop_path(self.gamma_1[...].astype(u.dtype) * self.attn(self.norm1(u)))
+        x_cls = x_cls + self.drop_path(self.gamma_2[...].astype(u.dtype) * self.mlp(self.norm2(x_cls)))
+        return x_cls
+
+
+class Cait(nnx.Module):
+    """CaiT with the reference's full model contract (reference cait.py:234-480)."""
+
+    def __init__(
+            self,
+            img_size: int = 224,
+            patch_size: int = 16,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'token',
+            embed_dim: int = 768,
+            depth: int = 12,
+            num_heads: int = 12,
+            mlp_ratio: float = 4.0,
+            qkv_bias: bool = True,
+            drop_rate: float = 0.0,
+            pos_drop_rate: float = 0.0,
+            proj_drop_rate: float = 0.0,
+            attn_drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            norm_layer: Optional[Union[str, Callable]] = None,
+            act_layer: Union[str, Callable] = 'gelu',
+            init_values: float = 1e-4,
+            depth_token_only: int = 2,
+            mlp_ratio_token_only: float = 4.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert global_pool in ('', 'token', 'avg')
+        norm_layer = get_norm_layer(norm_layer) or partial(LayerNorm, eps=1e-6)
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.num_features = self.head_hidden_size = self.embed_dim = embed_dim
+        self.grad_checkpointing = False
+
+        self.patch_embed = PatchEmbed(
+            img_size=img_size, patch_size=patch_size, in_chans=in_chans,
+            embed_dim=embed_dim, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        num_patches = self.patch_embed.num_patches
+        r = self.patch_embed.patch_size[0]
+
+        self.cls_token = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (1, 1, embed_dim), param_dtype))
+        self.pos_embed = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (1, num_patches, embed_dim), param_dtype))
+        self.pos_drop = Dropout(pos_drop_rate, rngs=rngs)
+
+        self.blocks = nnx.List([
+            LayerScaleBlock(
+                dim=embed_dim, num_heads=num_heads, mlp_ratio=mlp_ratio, qkv_bias=qkv_bias,
+                proj_drop=proj_drop_rate, attn_drop=attn_drop_rate, drop_path=drop_path_rate,
+                norm_layer=norm_layer, act_layer=act_layer, init_values=init_values,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            for _ in range(depth)
+        ])
+        self.feature_info = [
+            dict(num_chs=embed_dim, reduction=r, module=f'blocks.{i}') for i in range(depth)]
+
+        self.blocks_token_only = nnx.List([
+            LayerScaleBlockClassAttn(
+                dim=embed_dim, num_heads=num_heads, mlp_ratio=mlp_ratio_token_only,
+                qkv_bias=qkv_bias, norm_layer=norm_layer, act_layer=act_layer,
+                init_values=init_values, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            for _ in range(depth_token_only)
+        ])
+
+        self.norm = norm_layer(embed_dim, rngs=rngs)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.head = nnx.Linear(
+            embed_dim, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'pos_embed', 'cls_token'}
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def group_matcher(self, coarse: bool = False):
+        def _matcher(name):
+            if any(name.startswith(n) for n in ('cls_token', 'pos_embed', 'patch_embed')):
+                return 0
+            elif name.startswith('blocks.'):
+                return int(name.split('.')[1]) + 1
+            elif name.startswith('blocks_token_only.'):
+                to_offset = len(self.blocks) - len(self.blocks_token_only) + 1
+                return int(name.split('.')[1]) + to_offset
+            elif name.startswith('norm.'):
+                return len(self.blocks)
+            return float('inf')
+        return _matcher
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            assert global_pool in ('', 'token', 'avg')
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs,
+        ) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.patch_embed(x)
+        x = x + self.pos_embed[...].astype(x.dtype)
+        x = self.pos_drop(x)
+        if self.grad_checkpointing:
+            x = checkpoint_seq(self.blocks, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
+        cls_tokens = jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (x.shape[0], 1, x.shape[-1]))
+        for blk in self.blocks_token_only:
+            cls_tokens = blk(x, cls_tokens)
+        x = jnp.concatenate([cls_tokens, x], axis=1)
+        return self.norm(x) if self.norm is not None else x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        if self.global_pool:
+            x = x[:, 1:].mean(axis=1) if self.global_pool == 'avg' else x[:, 0]
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt in ('NHWC', 'NLC')
+        reshape = output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        B, H, W, _ = x.shape
+        grid = self.patch_embed.grid_size
+        x = self.patch_embed(x)
+        x = x + self.pos_embed[...].astype(x.dtype)
+        x = self.pos_drop(x)
+
+        intermediates = []
+        blocks = self.blocks if not stop_early else list(self.blocks)[:max_index + 1]
+        for i, blk in enumerate(blocks):
+            x = blk(x)
+            if i in take_indices:
+                intermediates.append(self.norm(x) if (norm and self.norm is not None) else x)
+        if reshape:
+            intermediates = [y.reshape(B, grid[0], grid[1], -1) for y in intermediates]
+        if intermediates_only:
+            return intermediates
+
+        cls_tokens = jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (x.shape[0], 1, x.shape[-1]))
+        for blk in self.blocks_token_only:
+            cls_tokens = blk(x, cls_tokens)
+        x = jnp.concatenate([cls_tokens, x], axis=1)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.blocks_token_only = nnx.List([])
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model=None):
+    from ._torch_convert import convert_torch_state_dict
+    if 'model' in state_dict:
+        state_dict = state_dict['model']
+    out = {k.replace('module.', ''): v for k, v in state_dict.items()}
+    return convert_torch_state_dict(out, model)
+
+
+def _create_cait(variant, pretrained=False, **kwargs):
+    out_indices = kwargs.pop('out_indices', 3)
+    return build_model_with_cfg(
+        Cait, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices),
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 384, 384),
+        'pool_size': None,
+        'crop_pct': 1.0,
+        'interpolation': 'bicubic',
+        'fixed_input_size': True,
+        'mean': (0.485, 0.456, 0.406),
+        'std': (0.229, 0.224, 0.225),
+        'first_conv': 'patch_embed.proj',
+        'classifier': 'head',
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'cait_xxs24_224.fb_dist_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224)),
+    'cait_xxs24_384.fb_dist_in1k': _cfg(hf_hub_id='timm/'),
+    'cait_xxs36_224.fb_dist_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224)),
+    'cait_xxs36_384.fb_dist_in1k': _cfg(hf_hub_id='timm/'),
+    'cait_xs24_384.fb_dist_in1k': _cfg(hf_hub_id='timm/'),
+    'cait_s24_224.fb_dist_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224)),
+    'cait_s24_384.fb_dist_in1k': _cfg(hf_hub_id='timm/'),
+    'cait_s36_384.fb_dist_in1k': _cfg(hf_hub_id='timm/'),
+    'cait_m36_384.fb_dist_in1k': _cfg(hf_hub_id='timm/'),
+    'cait_m48_448.fb_dist_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 448, 448)),
+    'test_cait.untrained': _cfg(input_size=(3, 96, 96)),
+})
+
+
+@register_model
+def cait_xxs24_224(pretrained=False, **kwargs) -> Cait:
+    model_args = dict(patch_size=16, embed_dim=192, depth=24, num_heads=4, init_values=1e-5)
+    return _create_cait('cait_xxs24_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def cait_xxs24_384(pretrained=False, **kwargs) -> Cait:
+    model_args = dict(patch_size=16, embed_dim=192, depth=24, num_heads=4, init_values=1e-5)
+    return _create_cait('cait_xxs24_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def cait_xxs36_224(pretrained=False, **kwargs) -> Cait:
+    model_args = dict(patch_size=16, embed_dim=192, depth=36, num_heads=4, init_values=1e-5)
+    return _create_cait('cait_xxs36_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def cait_xxs36_384(pretrained=False, **kwargs) -> Cait:
+    model_args = dict(patch_size=16, embed_dim=192, depth=36, num_heads=4, init_values=1e-5)
+    return _create_cait('cait_xxs36_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def cait_xs24_384(pretrained=False, **kwargs) -> Cait:
+    model_args = dict(patch_size=16, embed_dim=288, depth=24, num_heads=6, init_values=1e-5)
+    return _create_cait('cait_xs24_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def cait_s24_224(pretrained=False, **kwargs) -> Cait:
+    model_args = dict(patch_size=16, embed_dim=384, depth=24, num_heads=8, init_values=1e-5)
+    return _create_cait('cait_s24_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def cait_s24_384(pretrained=False, **kwargs) -> Cait:
+    model_args = dict(patch_size=16, embed_dim=384, depth=24, num_heads=8, init_values=1e-5)
+    return _create_cait('cait_s24_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def cait_s36_384(pretrained=False, **kwargs) -> Cait:
+    model_args = dict(patch_size=16, embed_dim=384, depth=36, num_heads=8, init_values=1e-6)
+    return _create_cait('cait_s36_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def cait_m36_384(pretrained=False, **kwargs) -> Cait:
+    model_args = dict(patch_size=16, embed_dim=768, depth=36, num_heads=16, init_values=1e-6)
+    return _create_cait('cait_m36_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def cait_m48_448(pretrained=False, **kwargs) -> Cait:
+    model_args = dict(patch_size=16, embed_dim=768, depth=48, num_heads=16, init_values=1e-6)
+    return _create_cait('cait_m48_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_cait(pretrained=False, **kwargs) -> Cait:
+    model_args = dict(
+        img_size=96, patch_size=16, embed_dim=64, depth=2, num_heads=2, mlp_ratio=3,
+        init_values=1e-5, depth_token_only=1)
+    return _create_cait('test_cait', pretrained=pretrained, **dict(model_args, **kwargs))

@@ -21,6 +21,7 @@ from .deit import VisionTransformerDistilled
 from .densenet import DenseNet
 from .efficientnet import EfficientNet
 from .eva import Eva
+from .levit import Levit, LevitDistilled
 from .mlp_mixer import MlpMixer
 from .mobilenetv3 import MobileNetV3
 from .naflexvit import NaFlexVit
@@ -31,4 +32,6 @@ from .resnetv2 import ResNetV2
 from .swin_transformer import SwinTransformer
 from .swin_transformer_v2 import SwinTransformerV2
 from .vgg import VGG
+from .volo import VOLO
+from .xcit import Xcit
 from .vision_transformer import VisionTransformer

@@ -22,6 +22,8 @@ from ..layers import (
     DropPath, Dropout, LayerNorm, Mlp, PatchEmbed,
     get_norm_layer, trunc_normal_, zeros_,
 )
+from ..layers.attention import scaled_dot_product_attention
+from ..layers.drop import dropout_rng_key
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
 from ._manipulate import checkpoint_seq
@@ -54,10 +56,10 @@ class ClassAttn(nnx.Module):
         q = self.q(x[:, 0:1]).reshape(B, 1, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
         k = self.k(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
         v = self.v(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
-        attn = jnp.einsum('bhqd,bhkd->bhqk', q * self.scale, k)
-        attn = jax.nn.softmax(attn, axis=-1)
-        attn = self.attn_drop(attn)
-        x_cls = jnp.einsum('bhqk,bhkd->bhqd', attn, v)
+        dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop.rate
+        dropout_key = dropout_rng_key(self.attn_drop) if dropout_p > 0.0 else None
+        x_cls = scaled_dot_product_attention(
+            q, k, v, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale, fused=False)
         x_cls = x_cls.transpose(0, 2, 1, 3).reshape(B, 1, C)
         x_cls = self.proj(x_cls)
         return self.proj_drop(x_cls)

@@ -1,0 +1,687 @@
+"""LeViT, TPU-native (reference: timm/models/levit.py:1-1152; Graham et al.
+2021, 'LeViT: a Vision Transformer in ConvNet's Clothing').
+
+Hybrid conv-stem + attention pyramid where every linear is fused with a
+BatchNorm (train-time BN folds into the matmul at inference) and attention
+adds a learned per-head relative bias gathered from a static index table.
+
+TPU-first notes: the reference maintains parallel `levit_*` (linear, NLC) and
+`levit_conv_*` (1×1 conv, NCHW) module trees purely for torch memory-layout
+reasons. In NHWC/XLA a 1×1 conv IS a matmul, so one token implementation
+serves both registries (checkpoints for either load through the same
+converter). Attention bias indices are trace-time numpy constants; the
+subsample downsample is a static strided slice on the token grid.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from ..layers import (
+    BatchNorm2d, Dropout, DropPath, get_act_fn, to_2tuple, to_ntuple,
+    trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['Levit', 'LevitDistilled']
+
+
+class ConvNorm(nnx.Module):
+    """Conv (no bias) + BN, NHWC (reference levit.py:43-78)."""
+
+    def __init__(self, in_chs, out_chs, kernel_size=1, stride=1, padding=0,
+                 groups=1, bn_weight_init=1.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.linear = nnx.Conv(
+            in_chs, out_chs, kernel_size=(kernel_size, kernel_size), strides=stride,
+            padding=[(padding, padding), (padding, padding)], feature_group_count=groups,
+            use_bias=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn = BatchNorm2d(out_chs, rngs=rngs)
+        if bn_weight_init != 1.0:
+            self.bn.scale[...] = jnp.full_like(self.bn.scale[...], bn_weight_init)
+
+    def __call__(self, x):
+        return self.bn(self.linear(x))
+
+
+class LinearNorm(nnx.Module):
+    """Linear (no bias) + BN over (B*N) tokens (reference levit.py:81-110)."""
+
+    def __init__(self, in_features, out_features, bn_weight_init=1.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.linear = nnx.Linear(
+            in_features, out_features, use_bias=False, kernel_init=trunc_normal_(std=0.02),
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn = BatchNorm2d(out_features, rngs=rngs)
+        if bn_weight_init != 1.0:
+            self.bn.scale[...] = jnp.full_like(self.bn.scale[...], bn_weight_init)
+
+    def __call__(self, x):
+        x = self.linear(x)
+        B, N, C = x.shape
+        return self.bn(x.reshape(B, N, 1, C)).reshape(B, N, C)
+
+
+class NormLinear(nnx.Module):
+    """BN + dropout + linear classifier head (reference levit.py:113-151)."""
+
+    def __init__(self, in_features, out_features, bias=True, std=0.02, drop=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.bn = BatchNorm2d(in_features, rngs=rngs)
+        self.drop = Dropout(drop, rngs=rngs)
+        self.linear = nnx.Linear(
+            in_features, out_features, use_bias=bias, kernel_init=trunc_normal_(std=std),
+            bias_init=zeros_, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        B, C = x.shape
+        x = self.bn(x.reshape(B, 1, 1, C)).reshape(B, C)
+        return self.linear(self.drop(x))
+
+
+class Stem(nnx.Module):
+    """Strided ConvNorm stack, s8 or s16 (reference levit.py:153-192)."""
+
+    def __init__(self, in_chs, out_chs, act_layer, stem_type='s16',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.act = get_act_fn(act_layer)
+        if stem_type == 's16':
+            self.stride = 16
+            chs = [out_chs // 8, out_chs // 4, out_chs // 2, out_chs]
+        else:
+            self.stride = 8
+            chs = [out_chs // 4, out_chs // 2, out_chs]
+        convs = []
+        c_in = in_chs
+        for c in chs:
+            convs.append(ConvNorm(c_in, c, 3, stride=2, padding=1, **kw))
+            c_in = c
+        self.convs = nnx.List(convs)
+
+    def __call__(self, x):
+        for i, conv in enumerate(self.convs):
+            if i:
+                x = self.act(x)
+            x = conv(x)
+        return x
+
+
+def _attention_bias_idxs(resolution: Tuple[int, int], stride: int = 1) -> np.ndarray:
+    """Static (N_q, N_k) index into the per-head bias table (reference
+    levit.py:286-296, 395-407)."""
+    H, W = resolution
+    k_pos = np.stack(np.meshgrid(np.arange(H), np.arange(W), indexing='ij')).reshape(2, -1)
+    q_pos = np.stack(np.meshgrid(
+        np.arange(0, H, step=stride), np.arange(0, W, step=stride), indexing='ij')).reshape(2, -1)
+    rel = np.abs(q_pos[:, :, None] - k_pos[:, None, :])
+    return rel[0] * W + rel[1]
+
+
+class LevitAttention(nnx.Module):
+    """MHSA w/ learned relative bias table (reference levit.py:219-328)."""
+
+    def __init__(self, dim, key_dim, num_heads=8, attn_ratio=4.0, resolution=14,
+                 act_layer='hard_swish',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        resolution = to_2tuple(resolution)
+        self.num_heads = num_heads
+        self.scale = key_dim ** -0.5
+        self.key_dim = key_dim
+        self.val_dim = int(attn_ratio * key_dim)
+        self.val_attn_dim = self.val_dim * num_heads
+
+        self.qkv = LinearNorm(dim, self.val_attn_dim + key_dim * num_heads * 2, **kw)
+        self.proj_act = get_act_fn(act_layer)
+        self.proj_ln = LinearNorm(self.val_attn_dim, dim, bn_weight_init=0, **kw)
+
+        N = resolution[0] * resolution[1]
+        self.attention_biases = nnx.Param(jnp.zeros((num_heads, N), param_dtype))
+        self._bias_idxs = jnp.asarray(_attention_bias_idxs(resolution))
+
+    def _bias(self):
+        return self.attention_biases[...][:, self._bias_idxs]  # (H, N, N)
+
+    def __call__(self, x):
+        B, N, C = x.shape
+        qkv = self.qkv(x).reshape(B, N, self.num_heads, -1)
+        q, k, v = jnp.split(qkv, [self.key_dim, self.key_dim * 2], axis=3)
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        attn = jnp.einsum('bhnd,bhmd->bhnm', q, k) * self.scale + self._bias().astype(q.dtype)
+        attn = jax.nn.softmax(attn, axis=-1)
+        x = jnp.einsum('bhnm,bhmd->bhnd', attn, v)
+        x = x.transpose(0, 2, 1, 3).reshape(B, N, self.val_attn_dim)
+        return self.proj_ln(self.proj_act(x))
+
+
+class LevitAttentionDownsample(nnx.Module):
+    """Attention with stride-subsampled queries (reference levit.py:330-459)."""
+
+    def __init__(self, in_dim, out_dim, key_dim, num_heads=8, attn_ratio=2.0,
+                 stride=2, resolution=14, act_layer='hard_swish',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        resolution = to_2tuple(resolution)
+        self.resolution = resolution
+        self.stride = stride
+        self.num_heads = num_heads
+        self.key_dim = key_dim
+        self.val_dim = int(attn_ratio * key_dim)
+        self.val_attn_dim = self.val_dim * num_heads
+        self.scale = key_dim ** -0.5
+
+        self.kv = LinearNorm(in_dim, self.val_attn_dim + key_dim * num_heads, **kw)
+        self.q_ln = LinearNorm(in_dim, key_dim * num_heads, **kw)
+        self.proj_act = get_act_fn(act_layer)
+        self.proj_ln = LinearNorm(self.val_attn_dim, out_dim, **kw)
+
+        N_k = resolution[0] * resolution[1]
+        self.attention_biases = nnx.Param(jnp.zeros((num_heads, N_k), param_dtype))
+        self._bias_idxs = jnp.asarray(_attention_bias_idxs(resolution, stride=stride))
+
+    def _bias(self):
+        return self.attention_biases[...][:, self._bias_idxs]  # (H, N_q, N_k)
+
+    def __call__(self, x):
+        B, N, C = x.shape
+        H, W = self.resolution
+        kv = self.kv(x).reshape(B, N, self.num_heads, -1)
+        k, v = jnp.split(kv, [self.key_dim], axis=3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        # subsample queries on the static token grid
+        xq = x.reshape(B, H, W, C)[:, ::self.stride, ::self.stride].reshape(B, -1, C)
+        q = self.q_ln(xq).reshape(B, -1, self.num_heads, self.key_dim).transpose(0, 2, 1, 3)
+        attn = jnp.einsum('bhnd,bhmd->bhnm', q, k) * self.scale + self._bias().astype(q.dtype)
+        attn = jax.nn.softmax(attn, axis=-1)
+        x = jnp.einsum('bhnm,bhmd->bhnd', attn, v)
+        x = x.transpose(0, 2, 1, 3).reshape(B, -1, self.val_attn_dim)
+        return self.proj_ln(self.proj_act(x))
+
+
+class LevitMlp(nnx.Module):
+    """LinearNorm MLP (reference levit.py:461-491)."""
+
+    def __init__(self, in_features, hidden_features=None, out_features=None,
+                 act_layer='hard_swish', drop=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        out_features = out_features or in_features
+        hidden_features = hidden_features or in_features
+        self.ln1 = LinearNorm(in_features, hidden_features, **kw)
+        self.act = get_act_fn(act_layer)
+        self.drop = Dropout(drop, rngs=rngs)
+        self.ln2 = LinearNorm(hidden_features, out_features, bn_weight_init=0, **kw)
+
+    def __call__(self, x):
+        return self.ln2(self.drop(self.act(self.ln1(x))))
+
+
+class LevitDownsample(nnx.Module):
+    """Attention downsample + residual MLP (reference levit.py:494-541)."""
+
+    def __init__(self, in_dim, out_dim, key_dim, num_heads=8, attn_ratio=4.0,
+                 mlp_ratio=2.0, act_layer='hard_swish', attn_act_layer=None,
+                 resolution=14, drop_path=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.attn_downsample = LevitAttentionDownsample(
+            in_dim, out_dim, key_dim=key_dim, num_heads=num_heads, attn_ratio=attn_ratio,
+            act_layer=attn_act_layer or act_layer, resolution=resolution, **kw)
+        self.mlp = LevitMlp(out_dim, int(out_dim * mlp_ratio), act_layer=act_layer, **kw)
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        x = self.attn_downsample(x)
+        return x + self.drop_path(self.mlp(x))
+
+
+class LevitBlock(nnx.Module):
+    """Attention + MLP residual block (reference levit.py:544-589)."""
+
+    def __init__(self, dim, key_dim, num_heads=8, attn_ratio=4.0, mlp_ratio=2.0,
+                 resolution=14, act_layer='hard_swish', attn_act_layer=None, drop_path=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.attn = LevitAttention(
+            dim, key_dim, num_heads=num_heads, attn_ratio=attn_ratio,
+            resolution=resolution, act_layer=attn_act_layer or act_layer, **kw)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.mlp = LevitMlp(dim, int(dim * mlp_ratio), act_layer=act_layer, **kw)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        x = x + self.drop_path1(self.attn(x))
+        x = x + self.drop_path2(self.mlp(x))
+        return x
+
+
+class LevitStage(nnx.Module):
+    """Optional downsample + block stack (reference levit.py:591-655)."""
+
+    def __init__(self, in_dim, out_dim, key_dim, depth=4, num_heads=8, attn_ratio=4.0,
+                 mlp_ratio=4.0, act_layer='hard_swish', attn_act_layer=None,
+                 resolution=14, downsample='', drop_path=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        resolution = to_2tuple(resolution)
+        if downsample:
+            self.downsample = LevitDownsample(
+                in_dim, out_dim, key_dim=key_dim, num_heads=in_dim // key_dim,
+                attn_ratio=4.0, mlp_ratio=2.0, act_layer=act_layer,
+                attn_act_layer=attn_act_layer, resolution=resolution, drop_path=drop_path, **kw)
+            resolution = tuple((r - 1) // 2 + 1 for r in resolution)
+        else:
+            assert in_dim == out_dim
+            self.downsample = None
+        self.resolution = resolution
+        self.blocks = nnx.List([
+            LevitBlock(
+                out_dim, key_dim, num_heads=num_heads, attn_ratio=attn_ratio,
+                mlp_ratio=mlp_ratio, act_layer=act_layer, attn_act_layer=attn_act_layer,
+                resolution=resolution, drop_path=drop_path, **kw)
+            for _ in range(depth)
+        ])
+
+    def __call__(self, x):
+        if self.downsample is not None:
+            x = self.downsample(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return x
+
+
+class Levit(nnx.Module):
+    """LeViT with the reference's model contract (reference levit.py:657-873)."""
+
+    def __init__(
+            self,
+            img_size: Union[int, Tuple[int, int]] = 224,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            embed_dim: Tuple[int, ...] = (192,),
+            key_dim: int = 64,
+            depth: Tuple[int, ...] = (12,),
+            num_heads: Union[int, Tuple[int, ...]] = (3,),
+            attn_ratio: Union[float, Tuple[float, ...]] = 2.0,
+            mlp_ratio: Union[float, Tuple[float, ...]] = 2.0,
+            stem_type: str = 's16',
+            down_op: str = 'subsample',
+            act_layer: str = 'hard_swish',
+            attn_act_layer: Optional[str] = None,
+            use_conv: bool = False,  # accepted for cfg parity; NHWC path is identical
+            global_pool: str = 'avg',
+            drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.num_features = self.head_hidden_size = embed_dim[-1]
+        self.embed_dim = embed_dim
+        self.drop_rate = drop_rate
+        self.grad_checkpointing = False
+        self.feature_info = []
+
+        num_stages = len(embed_dim)
+        assert len(depth) == num_stages
+        num_heads = to_ntuple(num_stages)(num_heads)
+        attn_ratio = to_ntuple(num_stages)(attn_ratio)
+        mlp_ratio = to_ntuple(num_stages)(mlp_ratio)
+
+        self.stem = Stem(in_chans, embed_dim[0], act_layer=act_layer, stem_type=stem_type, **kw)
+        stride = self.stem.stride
+        resolution = tuple(i // stride for i in to_2tuple(img_size))
+
+        in_dim = embed_dim[0]
+        stages = []
+        for i in range(num_stages):
+            stage_stride = 2 if i > 0 else 1
+            stages.append(LevitStage(
+                in_dim, embed_dim[i], key_dim, depth=depth[i], num_heads=num_heads[i],
+                attn_ratio=attn_ratio[i], mlp_ratio=mlp_ratio[i], act_layer=act_layer,
+                attn_act_layer=attn_act_layer, resolution=resolution,
+                downsample=down_op if stage_stride == 2 else '', drop_path=drop_path_rate, **kw))
+            stride *= stage_stride
+            resolution = tuple((r - 1) // stage_stride + 1 for r in resolution)
+            self.feature_info += [dict(num_chs=embed_dim[i], reduction=stride, module=f'stages.{i}')]
+            in_dim = embed_dim[i]
+        self.stages = nnx.List(stages)
+
+        self.head = NormLinear(embed_dim[-1], num_classes, drop=drop_rate, **kw) \
+            if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+        self._kw = dict(dtype=dtype, param_dtype=param_dtype)
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self):
+        return {'attention_biases'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^stem',
+            blocks=[(r'^stages\.(\d+)', None)],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = NormLinear(
+            self.num_features, num_classes, drop=self.drop_rate,
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        from ._manipulate import checkpoint_seq
+        x = self.stem(x)
+        B, H, W, C = x.shape
+        x = x.reshape(B, H * W, C)
+        if self.grad_checkpointing:
+            x = checkpoint_seq(self.stages, x)
+        else:
+            for stage in self.stages:
+                x = stage(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        if self.global_pool == 'avg':
+            x = x.mean(axis=1)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        x = self.stem(x)
+        B, H, W, C = x.shape
+        x = x.reshape(B, H * W, C)
+        intermediates = []
+        stages = self.stages if not stop_early else list(self.stages)[:max_index + 1]
+        for i, stage in enumerate(stages):
+            x = stage(x)
+            if i in take_indices:
+                h, w = stage.resolution
+                intermediates.append(x.reshape(B, h, w, -1))
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        self.stages = nnx.List(list(self.stages)[:max_index + 1])
+        self.num_features = self.stages[-1].blocks[-1].mlp.ln2.linear.out_features \
+            if self.stages[-1].blocks else self.num_features
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+class LevitDistilled(Levit):
+    """LeViT w/ distillation head (reference levit.py:875-910)."""
+
+    def __init__(self, *args, rngs: nnx.Rngs, **kwargs):
+        super().__init__(*args, rngs=rngs, **kwargs)
+        self.head_dist = NormLinear(
+            self.num_features, self.num_classes, dtype=self._dtype,
+            param_dtype=self._param_dtype, rngs=rngs) if self.num_classes > 0 else None
+        self.distilled_training = False
+
+    def get_classifier(self):
+        return self.head, self.head_dist
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        kw = dict(dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs)
+        self.head = NormLinear(self.num_features, num_classes, drop=self.drop_rate, **kw) \
+            if num_classes > 0 else None
+        self.head_dist = NormLinear(self.num_features, num_classes, **kw) if num_classes > 0 else None
+
+    def set_distilled_training(self, enable: bool = True):
+        self.distilled_training = enable
+
+    def forward_head(self, x, pre_logits: bool = False):
+        if self.global_pool == 'avg':
+            x = x.mean(axis=1)
+        if pre_logits or self.head is None:
+            return x
+        out, out_dist = self.head(x), self.head_dist(x)
+        if self.distilled_training and not self.head.drop.deterministic:
+            return out, out_dist
+        return (out + out_dist) / 2
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    import re
+    if 'model' in state_dict:
+        state_dict = state_dict['model']
+    out = {}
+    for k, v in state_dict.items():
+        if 'attention_bias_idxs' in k:
+            continue
+        # torch stem Sequential conv{1..4} → convs.{0..3}
+        m = re.match(r'^stem\.conv(\d)\.(.*)$', k)
+        if m:
+            k = f'stem.convs.{int(m.group(1)) - 1}.{m.group(2)}'
+        # torch proj Sequential ('act','ln') and q Sequential ('down','ln')
+        k = k.replace('.proj.ln.', '.proj_ln.').replace('.q.ln.', '.q_ln.')
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+model_cfgs = dict(
+    levit_128s=dict(embed_dim=(128, 256, 384), key_dim=16, num_heads=(4, 6, 8), depth=(2, 3, 4)),
+    levit_128=dict(embed_dim=(128, 256, 384), key_dim=16, num_heads=(4, 8, 12), depth=(4, 4, 4)),
+    levit_192=dict(embed_dim=(192, 288, 384), key_dim=32, num_heads=(3, 5, 6), depth=(4, 4, 4)),
+    levit_256=dict(embed_dim=(256, 384, 512), key_dim=32, num_heads=(4, 6, 8), depth=(4, 4, 4)),
+    levit_384=dict(embed_dim=(384, 512, 768), key_dim=32, num_heads=(6, 9, 12), depth=(4, 4, 4)),
+    levit_384_s8=dict(embed_dim=(384, 512, 768), key_dim=32, num_heads=(6, 9, 12), depth=(4, 4, 4),
+                      act_layer='silu', stem_type='s8'),
+    levit_512_s8=dict(embed_dim=(512, 640, 896), key_dim=64, num_heads=(8, 10, 14), depth=(4, 4, 4),
+                      act_layer='silu', stem_type='s8'),
+    levit_512=dict(embed_dim=(512, 768, 1024), key_dim=64, num_heads=(8, 12, 16), depth=(4, 4, 4),
+                   act_layer='silu'),
+    levit_256d=dict(embed_dim=(256, 384, 512), key_dim=32, num_heads=(4, 6, 8), depth=(4, 8, 6),
+                    act_layer='silu'),
+    levit_512d=dict(embed_dim=(512, 640, 768), key_dim=64, num_heads=(8, 10, 12), depth=(4, 8, 6),
+                    act_layer='silu'),
+    test_levit=dict(embed_dim=(32, 48), key_dim=16, num_heads=(2, 3), depth=(1, 1), stem_type='s8'),
+)
+
+
+def create_levit(variant, cfg_variant=None, pretrained=False, distilled=True, **kwargs):
+    out_indices = kwargs.pop('out_indices', (0, 1, 2))
+    if cfg_variant is None:
+        if variant in model_cfgs:
+            cfg_variant = variant
+        elif '_conv' in variant:
+            cfg_variant = variant.replace('_conv', '')
+    model_cfg = dict(model_cfgs[cfg_variant], **kwargs)
+    return build_model_with_cfg(
+        LevitDistilled if distilled else Levit,
+        variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices),
+        **model_cfg,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': None,
+        'crop_pct': 0.9,
+        'interpolation': 'bicubic',
+        'fixed_input_size': True,
+        'mean': (0.485, 0.456, 0.406),
+        'std': (0.229, 0.224, 0.225),
+        'first_conv': 'stem.convs.0.linear',
+        'classifier': ('head.linear', 'head_dist.linear'),
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'levit_128s.fb_dist_in1k': _cfg(hf_hub_id='timm/'),
+    'levit_128.fb_dist_in1k': _cfg(hf_hub_id='timm/'),
+    'levit_192.fb_dist_in1k': _cfg(hf_hub_id='timm/'),
+    'levit_256.fb_dist_in1k': _cfg(hf_hub_id='timm/'),
+    'levit_384.fb_dist_in1k': _cfg(hf_hub_id='timm/'),
+    'levit_conv_128s.fb_dist_in1k': _cfg(hf_hub_id='timm/', pool_size=(4, 4)),
+    'levit_conv_128.fb_dist_in1k': _cfg(hf_hub_id='timm/', pool_size=(4, 4)),
+    'levit_conv_192.fb_dist_in1k': _cfg(hf_hub_id='timm/', pool_size=(4, 4)),
+    'levit_conv_256.fb_dist_in1k': _cfg(hf_hub_id='timm/', pool_size=(4, 4)),
+    'levit_conv_384.fb_dist_in1k': _cfg(hf_hub_id='timm/', pool_size=(4, 4)),
+    'levit_384_s8.untrained': _cfg(classifier='head.linear'),
+    'levit_512_s8.untrained': _cfg(classifier='head.linear'),
+    'levit_512.untrained': _cfg(classifier='head.linear'),
+    'levit_256d.untrained': _cfg(classifier='head.linear'),
+    'levit_512d.untrained': _cfg(classifier='head.linear'),
+    'levit_conv_384_s8.untrained': _cfg(classifier='head.linear'),
+    'levit_conv_512_s8.untrained': _cfg(classifier='head.linear'),
+    'levit_conv_512.untrained': _cfg(classifier='head.linear'),
+    'levit_conv_256d.untrained': _cfg(classifier='head.linear'),
+    'levit_conv_512d.untrained': _cfg(classifier='head.linear'),
+    'test_levit.untrained': _cfg(input_size=(3, 96, 96), classifier='head.linear'),
+})
+
+
+@register_model
+def levit_128s(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_128s', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def levit_128(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_128', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def levit_192(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_192', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def levit_256(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_256', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def levit_384(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_384', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def levit_384_s8(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_384_s8', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def levit_512_s8(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_512_s8', pretrained=pretrained, distilled=False, **kwargs)
+
+
+@register_model
+def levit_512(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_512', pretrained=pretrained, distilled=False, **kwargs)
+
+
+@register_model
+def levit_256d(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_256d', pretrained=pretrained, distilled=False, **kwargs)
+
+
+@register_model
+def levit_512d(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_512d', pretrained=pretrained, distilled=False, **kwargs)
+
+
+@register_model
+def levit_conv_128s(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_conv_128s', pretrained=pretrained, use_conv=True, **kwargs)
+
+
+@register_model
+def levit_conv_128(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_conv_128', pretrained=pretrained, use_conv=True, **kwargs)
+
+
+@register_model
+def levit_conv_192(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_conv_192', pretrained=pretrained, use_conv=True, **kwargs)
+
+
+@register_model
+def levit_conv_256(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_conv_256', pretrained=pretrained, use_conv=True, **kwargs)
+
+
+@register_model
+def levit_conv_384(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_conv_384', pretrained=pretrained, use_conv=True, **kwargs)
+
+
+@register_model
+def levit_conv_384_s8(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_conv_384_s8', pretrained=pretrained, use_conv=True, **kwargs)
+
+
+@register_model
+def levit_conv_512_s8(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_conv_512_s8', pretrained=pretrained, use_conv=True, distilled=False, **kwargs)
+
+
+@register_model
+def levit_conv_512(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_conv_512', pretrained=pretrained, use_conv=True, distilled=False, **kwargs)
+
+
+@register_model
+def levit_conv_256d(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_conv_256d', pretrained=pretrained, use_conv=True, distilled=False, **kwargs)
+
+
+@register_model
+def levit_conv_512d(pretrained=False, **kwargs) -> Levit:
+    return create_levit('levit_conv_512d', pretrained=pretrained, use_conv=True, distilled=False, **kwargs)
+
+
+@register_model
+def test_levit(pretrained=False, **kwargs) -> Levit:
+    return create_levit('test_levit', pretrained=pretrained, distilled=False, **kwargs)

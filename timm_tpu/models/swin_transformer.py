@@ -334,7 +334,8 @@ class SwinTransformer(nnx.Module):
             param_dtype=jnp.float32,
             rngs: nnx.Rngs,
     ):
-        norm_layer = get_norm_layer(norm_layer) or LayerNorm
+        # reference uses torch nn.LayerNorm default eps (1e-5)
+        norm_layer = get_norm_layer(norm_layer) or partial(LayerNorm, eps=1e-5)
         self.num_classes = num_classes
         num_layers = len(depths)
         self.num_features = self.head_hidden_size = int(embed_dim * 2 ** (num_layers - 1))

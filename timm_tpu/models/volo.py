@@ -1,0 +1,691 @@
+"""VOLO: Vision Outlooker, TPU-native
+(reference: timm/models/volo.py:1-1460; Yuan et al. 2021).
+
+Outlook attention predicts per-window k×k→k×k mixing weights from pooled
+features and applies them to unfolded value windows, then folds overlapping
+results back. TPU-first notes: torch's `nn.Unfold`/`F.fold` (im2col + its
+scatter-add adjoint) are replaced by k² static shifted SLICES (unfold) and k²
+static `.at[].add` updates (fold) — fixed-shape ops XLA fuses into the
+attention einsums, no gather/scatter with dynamic indices. k=3 everywhere in
+published configs, so this is 9 slices each way.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    BatchNorm2d, DropPath, Dropout, LayerNorm, Mlp, to_2tuple, to_ntuple,
+    trunc_normal_, zeros_,
+)
+from ..layers.attention import scaled_dot_product_attention
+from ..layers.drop import dropout_rng_key
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['VOLO', 'OutlookAttention', 'Outlooker']
+
+
+def _unfold_nhwc(v, kernel_size: int, padding: int, stride: int):
+    """(B, H, W, C) → (B, h, w, k*k, C) of overlapping windows via static
+    shifted slices (torch nn.Unfold equivalent, NHWC)."""
+    B, H, W, C = v.shape
+    h = (H + 2 * padding - kernel_size) // stride + 1
+    w = (W + 2 * padding - kernel_size) // stride + 1
+    vp = jnp.pad(v, ((0, 0), (padding, padding), (padding, padding), (0, 0)))
+    patches = []
+    for i in range(kernel_size):
+        for j in range(kernel_size):
+            patches.append(vp[:, i:i + stride * (h - 1) + 1:stride, j:j + stride * (w - 1) + 1:stride, :])
+    return jnp.stack(patches, axis=3)  # (B, h, w, k*k, C)
+
+
+def _fold_nhwc(y, out_size: Tuple[int, int], kernel_size: int, padding: int, stride: int):
+    """(B, h, w, k*k, C) → (B, H, W, C) summing overlapping windows
+    (torch F.fold equivalent, NHWC)."""
+    B, h, w, kk, C = y.shape
+    H, W = out_size
+    out = jnp.zeros((B, H + 2 * padding, W + 2 * padding, C), y.dtype)
+    idx = 0
+    for i in range(kernel_size):
+        for j in range(kernel_size):
+            out = out.at[:, i:i + stride * (h - 1) + 1:stride, j:j + stride * (w - 1) + 1:stride, :].add(
+                y[:, :, :, idx, :])
+            idx += 1
+    return out[:, padding:padding + H, padding:padding + W, :]
+
+
+class OutlookAttention(nnx.Module):
+    """Outlook attention (reference volo.py:39-119)."""
+
+    def __init__(self, dim: int, num_heads: int, kernel_size: int = 3, padding: int = 1,
+                 stride: int = 1, qkv_bias: bool = False, attn_drop: float = 0.0,
+                 proj_drop: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.num_heads = num_heads
+        self.kernel_size = kernel_size
+        self.padding = padding
+        self.stride = stride
+        head_dim = dim // num_heads
+        self.scale = head_dim ** -0.5
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.v = linear(dim, dim, use_bias=qkv_bias)
+        self.attn = linear(dim, kernel_size ** 4 * num_heads)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = linear(dim, dim)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        k = self.kernel_size
+        nh = self.num_heads
+        d = C // nh
+        h, w = math.ceil(H / self.stride), math.ceil(W / self.stride)
+
+        v = self.v(x)  # (B, H, W, C)
+        v = _unfold_nhwc(v, k, self.padding, self.stride)  # (B, h, w, k*k, C)
+        v = v.reshape(B, h * w, k * k, nh, d).transpose(0, 3, 1, 2, 4)  # (B, nh, N, kk, d)
+
+        # attention weights from stride-pooled features (ceil-mode avg pool:
+        # zero-pad to a stride multiple, sum, divide by VALID element count)
+        if self.stride > 1:
+            ph, pw = h * self.stride - H, w * self.stride - W
+            xp = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)))
+            sums = xp.reshape(B, h, self.stride, w, self.stride, C).sum(axis=(2, 4))
+            cnt_h = jnp.minimum(jnp.arange(h) * self.stride + self.stride, H) - jnp.arange(h) * self.stride
+            cnt_w = jnp.minimum(jnp.arange(w) * self.stride + self.stride, W) - jnp.arange(w) * self.stride
+            counts = (cnt_h[:, None] * cnt_w[None, :]).astype(x.dtype)
+            pooled = sums / counts[None, :, :, None]
+        else:
+            pooled = x
+        attn = self.attn(pooled).reshape(B, h * w, nh, k * k, k * k).transpose(0, 2, 1, 3, 4)
+        attn = attn * self.scale
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = self.attn_drop(attn)
+
+        y = jnp.einsum('bhnpq,bhnqd->bhnpd', attn, v)  # (B, nh, N, kk, d)
+        y = y.transpose(0, 2, 3, 1, 4).reshape(B, h, w, k * k, C)
+        x = _fold_nhwc(y, (H, W), k, self.padding, self.stride)
+        x = self.proj(x)
+        return self.proj_drop(x)
+
+
+class Outlooker(nnx.Module):
+    """Outlook attention block (reference volo.py:121-191)."""
+
+    def __init__(self, dim: int, kernel_size: int, padding: int, stride: int = 1,
+                 num_heads: int = 1, mlp_ratio: float = 3.0, attn_drop: float = 0.0,
+                 drop_path: float = 0.0, act_layer: Union[str, Callable] = 'gelu',
+                 norm_layer: Callable = LayerNorm, qkv_bias: bool = False,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.attn = OutlookAttention(
+            dim, num_heads, kernel_size=kernel_size, padding=padding, stride=stride,
+            qkv_bias=qkv_bias, attn_drop=attn_drop, **kw)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp = Mlp(dim, hidden_features=int(dim * mlp_ratio), act_layer=act_layer, **kw)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        x = x + self.drop_path1(self.attn(self.norm1(x)))
+        x = x + self.drop_path2(self.mlp(self.norm2(x)))
+        return x
+
+
+class VoloAttention(nnx.Module):
+    """Standard MHSA over an NHWC grid (reference volo.py:193-258)."""
+
+    def __init__(self, dim: int, num_heads: int = 8, qkv_bias: bool = False,
+                 attn_drop: float = 0.0, proj_drop: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.scale = self.head_dim ** -0.5
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.qkv = linear(dim, dim * 3, use_bias=qkv_bias)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = linear(dim, dim)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        N = H * W
+        qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, self.head_dim).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop.rate
+        dropout_key = dropout_rng_key(self.attn_drop) if dropout_p > 0.0 else None
+        x = scaled_dot_product_attention(
+            q, k, v, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale, fused=False)
+        x = x.transpose(0, 2, 1, 3).reshape(B, H, W, C)
+        x = self.proj(x)
+        return self.proj_drop(x)
+
+
+class Transformer(nnx.Module):
+    """Transformer block on NHWC grid (reference volo.py:261-311)."""
+
+    def __init__(self, dim: int, num_heads: int, mlp_ratio: float = 4.0, qkv_bias: bool = False,
+                 attn_drop: float = 0.0, drop_path: float = 0.0,
+                 act_layer: Union[str, Callable] = 'gelu', norm_layer: Callable = LayerNorm,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.attn = VoloAttention(dim, num_heads=num_heads, qkv_bias=qkv_bias, attn_drop=attn_drop, **kw)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp = Mlp(dim, hidden_features=int(dim * mlp_ratio), act_layer=act_layer, **kw)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        x = x + self.drop_path1(self.attn(self.norm1(x)))
+        x = x + self.drop_path2(self.mlp(self.norm2(x)))
+        return x
+
+
+class ClassAttention(nnx.Module):
+    """VOLO class attention w/ fused kv (reference volo.py:313-376)."""
+
+    def __init__(self, dim: int, num_heads: int = 8, head_dim: Optional[int] = None,
+                 qkv_bias: bool = False, attn_drop: float = 0.0, proj_drop: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.num_heads = num_heads
+        self.head_dim = head_dim if head_dim is not None else dim // num_heads
+        self.scale = self.head_dim ** -0.5
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.kv = linear(dim, self.head_dim * num_heads * 2, use_bias=qkv_bias)
+        self.q = linear(dim, self.head_dim * num_heads, use_bias=qkv_bias)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = linear(self.head_dim * num_heads, dim)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x):
+        B, N, C = x.shape
+        kv = self.kv(x).reshape(B, N, 2, self.num_heads, self.head_dim).transpose(2, 0, 3, 1, 4)
+        k, v = kv[0], kv[1]
+        q = self.q(x[:, 0:1]).reshape(B, 1, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop.rate
+        dropout_key = dropout_rng_key(self.attn_drop) if dropout_p > 0.0 else None
+        cls_embed = scaled_dot_product_attention(
+            q, k, v, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale, fused=False)
+        cls_embed = cls_embed.transpose(0, 2, 1, 3).reshape(B, 1, self.head_dim * self.num_heads)
+        cls_embed = self.proj(cls_embed)
+        return self.proj_drop(cls_embed)
+
+
+class ClassBlock(nnx.Module):
+    """Class-attention block updating only the cls token (reference volo.py:378-443)."""
+
+    def __init__(self, dim: int, num_heads: int, head_dim: Optional[int] = None,
+                 mlp_ratio: float = 4.0, qkv_bias: bool = False, drop: float = 0.0,
+                 attn_drop: float = 0.0, drop_path: float = 0.0,
+                 act_layer: Union[str, Callable] = 'gelu', norm_layer: Callable = LayerNorm,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.attn = ClassAttention(
+            dim, num_heads=num_heads, head_dim=head_dim, qkv_bias=qkv_bias,
+            attn_drop=attn_drop, proj_drop=drop, **kw)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp = Mlp(dim, hidden_features=int(dim * mlp_ratio), act_layer=act_layer, drop=drop, **kw)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        cls_embed = x[:, :1]
+        cls_embed = cls_embed + self.drop_path1(self.attn(self.norm1(x)))
+        cls_embed = cls_embed + self.drop_path2(self.mlp(self.norm2(cls_embed)))
+        return jnp.concatenate([cls_embed, x[:, 1:]], axis=1)
+
+
+class _StemConvBnRelu(nnx.Module):
+    def __init__(self, in_chs, out_chs, kernel_size, stride, padding,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.conv = nnx.Conv(
+            in_chs, out_chs, kernel_size=(kernel_size, kernel_size), strides=stride,
+            padding=[(padding, padding), (padding, padding)], use_bias=False,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn = BatchNorm2d(out_chs, rngs=rngs)
+
+    def __call__(self, x):
+        return nnx.relu(self.bn(self.conv(x)))
+
+
+class VoloPatchEmbed(nnx.Module):
+    """Multi-conv stem + strided patch projection (reference volo.py:498-566)."""
+
+    def __init__(self, img_size: int = 224, stem_conv: bool = False, stem_stride: int = 1,
+                 patch_size: int = 8, in_chans: int = 3, hidden_dim: int = 64,
+                 embed_dim: int = 384,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        assert patch_size in (4, 8, 16)
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        if stem_conv:
+            self.convs = nnx.List([
+                _StemConvBnRelu(in_chans, hidden_dim, 7, stem_stride, 3, **kw),
+                _StemConvBnRelu(hidden_dim, hidden_dim, 3, 1, 1, **kw),
+                _StemConvBnRelu(hidden_dim, hidden_dim, 3, 1, 1, **kw),
+            ])
+        else:
+            self.convs = None
+        ps = patch_size // stem_stride
+        self.proj = nnx.Conv(
+            hidden_dim if stem_conv else in_chans, embed_dim, kernel_size=(ps, ps), strides=ps,
+            padding='VALID', dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.num_patches = (img_size // patch_size) ** 2
+
+    def __call__(self, x):
+        if self.convs is not None:
+            for c in self.convs:
+                x = c(x)
+        return self.proj(x)  # (B, H', W', embed_dim)
+
+
+class Downsample(nnx.Module):
+    """Strided-conv downsample between stages (reference volo.py:568-603)."""
+
+    def __init__(self, in_embed_dim: int, out_embed_dim: int, patch_size: int = 2,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.proj = nnx.Conv(
+            in_embed_dim, out_embed_dim, kernel_size=(patch_size, patch_size),
+            strides=patch_size, padding='VALID',
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        return self.proj(x)
+
+
+class VOLO(nnx.Module):
+    """VOLO with the reference's model contract (reference volo.py:708-1213).
+
+    `use_mix_token` training (token-labeling bbox mixing, reference
+    forward_train) is not implemented; standard classification fwd only.
+    """
+
+    def __init__(
+            self,
+            layers: Tuple[int, ...],
+            img_size: int = 224,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'token',
+            patch_size: int = 8,
+            stem_hidden_dim: int = 64,
+            embed_dims: Optional[Tuple[int, ...]] = None,
+            num_heads: Optional[Tuple[int, ...]] = None,
+            downsamples: Tuple[bool, ...] = (True, False, False, False),
+            outlook_attention: Tuple[bool, ...] = (True, False, False, False),
+            mlp_ratio: float = 3.0,
+            qkv_bias: bool = False,
+            drop_rate: float = 0.0,
+            pos_drop_rate: float = 0.0,
+            attn_drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            norm_layer: Optional[Callable] = None,
+            post_layers: Optional[Tuple[str, ...]] = ('ca', 'ca'),
+            use_aux_head: bool = True,
+            pooling_scale: int = 2,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        # reference uses torch nn.LayerNorm default eps (1e-5)
+        norm_layer = norm_layer or partial(LayerNorm, eps=1e-5)
+        num_layers = len(layers)
+        mlp_ratio = to_ntuple(num_layers)(mlp_ratio)
+        img_size = to_2tuple(img_size)
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.pooling_scale = pooling_scale
+        self.num_features = self.head_hidden_size = embed_dims[-1]
+        self.grad_checkpointing = False
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        self.patch_embed = VoloPatchEmbed(
+            img_size=img_size[0], stem_conv=True, stem_stride=2, patch_size=patch_size,
+            in_chans=in_chans, hidden_dim=stem_hidden_dim, embed_dim=embed_dims[0], **kw)
+        r = patch_size
+
+        patch_grid = (img_size[0] // patch_size // pooling_scale, img_size[1] // patch_size // pooling_scale)
+        self.pos_embed = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (1, patch_grid[0], patch_grid[1], embed_dims[-1]), param_dtype))
+        self.pos_drop = Dropout(pos_drop_rate, rngs=rngs)
+
+        self.stage_ends = []
+        self.feature_info = []
+        network = []
+        block_idx = 0
+        total = sum(layers)
+        for i in range(num_layers):
+            blocks = []
+            for bi in range(layers[i]):
+                dpr = drop_path_rate * (bi + sum(layers[:i])) / max(total - 1, 1)
+                if outlook_attention[i]:
+                    blocks.append(Outlooker(
+                        embed_dims[i], kernel_size=3, padding=1, stride=2,
+                        num_heads=num_heads[i], mlp_ratio=mlp_ratio[i], qkv_bias=qkv_bias,
+                        attn_drop=attn_drop_rate, drop_path=dpr, norm_layer=norm_layer, **kw))
+                else:
+                    blocks.append(Transformer(
+                        embed_dims[i], num_heads[i], mlp_ratio=mlp_ratio[i], qkv_bias=qkv_bias,
+                        attn_drop=attn_drop_rate, drop_path=dpr, norm_layer=norm_layer, **kw))
+            network.append(nnx.List(blocks))
+            self.stage_ends.append(block_idx)
+            self.feature_info.append(dict(num_chs=embed_dims[i], reduction=r, module=f'network.{block_idx}'))
+            block_idx += 1
+            if downsamples[i]:
+                network.append(Downsample(embed_dims[i], embed_dims[i + 1], 2, **kw))
+                r *= 2
+                block_idx += 1
+        self.network = nnx.List(network)
+
+        if post_layers is not None:
+            assert all(p == 'ca' for p in post_layers)
+            self.post_network = nnx.List([
+                ClassBlock(
+                    dim=embed_dims[-1], num_heads=num_heads[-1], mlp_ratio=mlp_ratio[-1],
+                    qkv_bias=qkv_bias, attn_drop=attn_drop_rate, norm_layer=norm_layer, **kw)
+                for _ in post_layers
+            ])
+            self.cls_token = nnx.Param(
+                trunc_normal_(std=0.02)(rngs.params(), (1, 1, embed_dims[-1]), param_dtype))
+        else:
+            self.post_network = None
+            self.cls_token = None
+
+        if use_aux_head:
+            self.aux_head = nnx.Linear(
+                self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        else:
+            self.aux_head = None
+        self.norm = norm_layer(self.num_features, rngs=rngs)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.head = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'pos_embed', 'cls_token'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^cls_token|pos_embed|patch_embed',
+            blocks=[(r'^network\.(\d+)\.(\d+)', None), (r'^network\.(\d+)', (0,))],
+            blocks2=[(r'^cls_token', (0,)), (r'^post_network\.(\d+)', None), (r'^norm', (99999,))],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        mk = lambda: nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs)
+        self.head = mk() if num_classes > 0 else None
+        if self.aux_head is not None:
+            self.aux_head = mk() if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def forward_tokens(self, x):
+        from ._manipulate import checkpoint_seq
+        for idx, block in enumerate(self.network):
+            if idx == 2:  # pos embed after the outlooker stage + downsample
+                x = x + self.pos_embed[...].astype(x.dtype)
+                x = self.pos_drop(x)
+            if isinstance(block, nnx.List):
+                if self.grad_checkpointing:
+                    x = checkpoint_seq(block, x)
+                else:
+                    for blk in block:
+                        x = blk(x)
+            else:
+                x = block(x)
+        B, H, W, C = x.shape
+        return x.reshape(B, -1, C)
+
+    def forward_cls(self, x):
+        B = x.shape[0]
+        cls_tokens = jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (B, 1, x.shape[-1]))
+        x = jnp.concatenate([cls_tokens, x], axis=1)
+        for block in self.post_network:
+            x = block(x)
+        return x
+
+    def forward_features(self, x):
+        x = self.patch_embed(x)
+        x = self.forward_tokens(x)
+        if self.post_network is not None:
+            x = self.forward_cls(x)
+        return self.norm(x) if self.norm is not None else x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        if self.global_pool == 'avg':
+            out = x.mean(axis=1)
+        elif self.global_pool == 'token':
+            out = x[:, 0]
+        else:
+            out = x
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return out
+        out = self.head(out)
+        if self.aux_head is not None:
+            aux = self.aux_head(x[:, 1:])
+            out = out + 0.5 * aux.max(axis=1)
+        return out
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stage_ends), indices)
+        take_blocks = {self.stage_ends[i]: i for i in take_indices}
+        max_block = self.stage_ends[max_index]
+
+        x = self.patch_embed(x)
+        intermediates = []
+        for idx, block in enumerate(self.network):
+            if stop_early and idx > max_block:
+                break
+            if idx == 2:
+                x = x + self.pos_embed[...].astype(x.dtype)
+                x = self.pos_drop(x)
+            if isinstance(block, nnx.List):
+                for blk in block:
+                    x = blk(x)
+            else:
+                x = block(x)
+            if idx in take_blocks:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+
+        B, H, W, C = x.shape
+        x = x.reshape(B, -1, C)
+        if self.post_network is not None:
+            x = self.forward_cls(x)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stage_ends), indices)
+        max_block = self.stage_ends[max_index]
+        self.network = nnx.List(list(self.network)[:max_block + 1])
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            if self.post_network is not None:
+                self.post_network = nnx.List([])
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    import re
+    out = {}
+    for k, v in state_dict.items():
+        # torch stem Sequential conv.{0,1,3,4,6,7} → convs.{i}.{conv,bn}
+        m = re.match(r'^patch_embed\.conv\.(\d+)\.(.*)$', k)
+        if m:
+            i = int(m.group(1))
+            stage, part = divmod(i, 3)
+            name = 'conv' if part == 0 else 'bn'
+            k = f'patch_embed.convs.{stage}.{name}.{m.group(2)}'
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_volo(variant, pretrained=False, **kwargs):
+    out_indices = kwargs.pop('out_indices', 3)
+    return build_model_with_cfg(
+        VOLO, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices),
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': None,
+        'crop_pct': 0.96,
+        'interpolation': 'bicubic',
+        'fixed_input_size': True,
+        'mean': (0.485, 0.456, 0.406),
+        'std': (0.229, 0.224, 0.225),
+        'first_conv': 'patch_embed.convs.0.conv',
+        'classifier': ('head', 'aux_head'),
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'volo_d1_224.sail_in1k': _cfg(hf_hub_id='timm/'),
+    'volo_d1_384.sail_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, input_size=(3, 384, 384)),
+    'volo_d2_224.sail_in1k': _cfg(hf_hub_id='timm/'),
+    'volo_d2_384.sail_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, input_size=(3, 384, 384)),
+    'volo_d3_224.sail_in1k': _cfg(hf_hub_id='timm/'),
+    'volo_d3_448.sail_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, input_size=(3, 448, 448)),
+    'volo_d4_224.sail_in1k': _cfg(hf_hub_id='timm/'),
+    'volo_d4_448.sail_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.15, input_size=(3, 448, 448)),
+    'volo_d5_224.sail_in1k': _cfg(hf_hub_id='timm/'),
+    'volo_d5_448.sail_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.15, input_size=(3, 448, 448)),
+    'volo_d5_512.sail_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.15, input_size=(3, 512, 512)),
+    'test_volo.untrained': _cfg(input_size=(3, 96, 96)),
+})
+
+
+@register_model
+def volo_d1_224(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(layers=(4, 4, 8, 2), embed_dims=(192, 384, 384, 384), num_heads=(6, 12, 12, 12))
+    return _create_volo('volo_d1_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def volo_d1_384(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(img_size=384, layers=(4, 4, 8, 2), embed_dims=(192, 384, 384, 384), num_heads=(6, 12, 12, 12))
+    return _create_volo('volo_d1_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def volo_d2_224(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(layers=(6, 4, 10, 4), embed_dims=(256, 512, 512, 512), num_heads=(8, 16, 16, 16))
+    return _create_volo('volo_d2_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def volo_d2_384(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(img_size=384, layers=(6, 4, 10, 4), embed_dims=(256, 512, 512, 512), num_heads=(8, 16, 16, 16))
+    return _create_volo('volo_d2_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def volo_d3_224(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(layers=(8, 8, 16, 4), embed_dims=(256, 512, 512, 512), num_heads=(8, 16, 16, 16))
+    return _create_volo('volo_d3_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def volo_d3_448(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(img_size=448, layers=(8, 8, 16, 4), embed_dims=(256, 512, 512, 512), num_heads=(8, 16, 16, 16))
+    return _create_volo('volo_d3_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def volo_d4_224(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(layers=(8, 8, 16, 4), embed_dims=(384, 768, 768, 768), num_heads=(12, 16, 16, 16))
+    return _create_volo('volo_d4_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def volo_d4_448(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(img_size=448, layers=(8, 8, 16, 4), embed_dims=(384, 768, 768, 768), num_heads=(12, 16, 16, 16))
+    return _create_volo('volo_d4_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def volo_d5_224(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(
+        layers=(12, 12, 20, 4), embed_dims=(384, 768, 768, 768), num_heads=(12, 16, 16, 16),
+        mlp_ratio=4, stem_hidden_dim=128)
+    return _create_volo('volo_d5_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def volo_d5_448(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(
+        img_size=448, layers=(12, 12, 20, 4), embed_dims=(384, 768, 768, 768), num_heads=(12, 16, 16, 16),
+        mlp_ratio=4, stem_hidden_dim=128)
+    return _create_volo('volo_d5_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def volo_d5_512(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(
+        img_size=512, layers=(12, 12, 20, 4), embed_dims=(384, 768, 768, 768), num_heads=(12, 16, 16, 16),
+        mlp_ratio=4, stem_hidden_dim=128)
+    return _create_volo('volo_d5_512', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def test_volo(pretrained=False, **kwargs) -> VOLO:
+    model_args = dict(
+        img_size=96, patch_size=8, layers=(1, 1, 1), embed_dims=(32, 64, 64), num_heads=(2, 4, 4),
+        downsamples=(True, False, False), outlook_attention=(True, False, False),
+        post_layers=('ca',), stem_hidden_dim=16)
+    return _create_volo('test_volo', pretrained=pretrained, **dict(model_args, **kwargs))

@@ -1,0 +1,529 @@
+"""XCiT: Cross-Covariance Image Transformer, TPU-native
+(reference: timm/models/xcit.py:1-1085; El-Nouby et al. 2021).
+
+Attention operates on the CHANNEL axis: the d×d cross-covariance of
+l2-normalised q/k replaces the N×N token gram, so cost is linear in sequence
+length. Each block adds a depthwise-conv Local Patch Interaction (LPI) for
+spatial mixing, and classification runs CaiT-style class-attention layers on
+top. TPU-first notes: XCA is two einsums over a (heads, d, d) core — tiny,
+MXU-friendly matmuls at any resolution; the Fourier positional encoding is a
+trace-time jnp computation (static H, W) feeding one 1×1 conv.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    BatchNorm2d, DropPath, Dropout, LayerNorm, Mlp, to_2tuple, trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+from .cait import ClassAttn
+
+__all__ = ['Xcit', 'XCA', 'XCABlock']
+
+
+class PositionalEncodingFourier(nnx.Module):
+    """Fourier (sine/cosine) positional encoding w/ learned 1x1 projection
+    (reference xcit.py:34-73)."""
+
+    def __init__(self, hidden_dim: int = 32, dim: int = 768, temperature: float = 10000,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.token_projection = nnx.Conv(
+            hidden_dim * 2, dim, kernel_size=(1, 1), dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.scale = 2 * math.pi
+        self.temperature = temperature
+        self.hidden_dim = hidden_dim
+        self.dim = dim
+        self.eps = 1e-6
+
+    def __call__(self, H: int, W: int):
+        # static H/W at trace time → whole grid is a constant-folded computation
+        y = jnp.arange(1, H + 1, dtype=jnp.float32)[:, None]
+        y = jnp.broadcast_to(y, (H, W))
+        x = jnp.arange(1, W + 1, dtype=jnp.float32)[None, :]
+        x = jnp.broadcast_to(x, (H, W))
+        y = y / (y[-1:, :] + self.eps) * self.scale
+        x = x / (x[:, -1:] + self.eps) * self.scale
+        dim_t = jnp.arange(self.hidden_dim, dtype=jnp.float32)
+        dim_t = self.temperature ** (2 * (dim_t // 2) / self.hidden_dim)
+        pos_x = x[:, :, None] / dim_t
+        pos_y = y[:, :, None] / dim_t
+        pos_x = jnp.stack([jnp.sin(pos_x[:, :, 0::2]), jnp.cos(pos_x[:, :, 1::2])], axis=3).reshape(H, W, -1)
+        pos_y = jnp.stack([jnp.sin(pos_y[:, :, 0::2]), jnp.cos(pos_y[:, :, 1::2])], axis=3).reshape(H, W, -1)
+        pos = jnp.concatenate([pos_y, pos_x], axis=2)[None]  # (1, H, W, 2*hidden)
+        return self.token_projection(pos)  # (1, H, W, dim)
+
+
+class _ConvBn(nnx.Module):
+    """3x3 stride-s conv + BN (reference xcit.py conv3x3)."""
+
+    def __init__(self, in_chs: int, out_chs: int, stride: int = 1,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.conv = nnx.Conv(
+            in_chs, out_chs, kernel_size=(3, 3), strides=stride, padding=[(1, 1), (1, 1)],
+            use_bias=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn = BatchNorm2d(out_chs, rngs=rngs)
+
+    def __call__(self, x):
+        return self.bn(self.conv(x))
+
+
+class ConvPatchEmbed(nnx.Module):
+    """Multi-conv patch embedding (reference xcit.py:85-131)."""
+
+    def __init__(self, img_size=224, patch_size: int = 16, in_chans: int = 3,
+                 embed_dim: int = 768, act_layer: Union[str, Callable] = 'gelu',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        from ..layers import get_act_fn
+        img_size = to_2tuple(img_size)
+        self.img_size = img_size
+        self.patch_size = patch_size
+        self.grid_size = (img_size[0] // patch_size, img_size[1] // patch_size)
+        self.num_patches = self.grid_size[0] * self.grid_size[1]
+        self.act = get_act_fn(act_layer)
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        if patch_size == 16:
+            chs = [embed_dim // 8, embed_dim // 4, embed_dim // 2, embed_dim]
+        elif patch_size == 8:
+            chs = [embed_dim // 4, embed_dim // 2, embed_dim]
+        else:
+            raise ValueError('patch_size must be 8 or 16 for conv patch embed')
+        stages = []
+        in_c = in_chans
+        for c in chs:
+            stages.append(_ConvBn(in_c, c, stride=2, **kw))
+            in_c = c
+        self.stages = nnx.List(stages)
+
+    def __call__(self, x):
+        for i, stage in enumerate(self.stages):
+            if i:
+                x = self.act(x)
+            x = stage(x)
+        B, Hp, Wp, C = x.shape
+        return x.reshape(B, Hp * Wp, C), (Hp, Wp)
+
+
+class LPI(nnx.Module):
+    """Local Patch Interaction: two depthwise 3x3 convs w/ BN
+    (reference xcit.py:134-170)."""
+
+    def __init__(self, in_features: int, act_layer: Union[str, Callable] = 'gelu',
+                 kernel_size: int = 3, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        from ..layers import get_act_fn
+        pad = kernel_size // 2
+        self.conv1 = nnx.Conv(
+            in_features, in_features, kernel_size=(kernel_size, kernel_size),
+            padding=[(pad, pad), (pad, pad)], feature_group_count=in_features,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.act = get_act_fn(act_layer)
+        self.bn = BatchNorm2d(in_features, rngs=rngs)
+        self.conv2 = nnx.Conv(
+            in_features, in_features, kernel_size=(kernel_size, kernel_size),
+            padding=[(pad, pad), (pad, pad)], feature_group_count=in_features,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x, H: int, W: int):
+        B, N, C = x.shape
+        x = x.reshape(B, H, W, C)
+        x = self.conv2(self.bn(self.act(self.conv1(x))))
+        return x.reshape(B, N, C)
+
+
+class XCA(nnx.Module):
+    """Cross-covariance attention over channels (reference xcit.py:241-295)."""
+
+    def __init__(self, dim: int, num_heads: int = 8, qkv_bias: bool = False,
+                 attn_drop: float = 0.0, proj_drop: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.num_heads = num_heads
+        self.temperature = nnx.Param(jnp.ones((num_heads, 1, 1), param_dtype))
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.qkv = linear(dim, dim * 3, use_bias=qkv_bias)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = linear(dim, dim)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x):
+        B, N, C = x.shape
+        d = C // self.num_heads
+        # (B, h, d, N): channels are the attention axis
+        qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, d).transpose(2, 0, 3, 4, 1)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True), 1e-12)
+        k = k / jnp.maximum(jnp.linalg.norm(k, axis=-1, keepdims=True), 1e-12)
+        attn = jnp.einsum('bhdn,bhen->bhde', q, k) * self.temperature[...].astype(q.dtype)
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = self.attn_drop(attn)
+        x = jnp.einsum('bhde,bhen->bhdn', attn, v)
+        x = x.transpose(0, 3, 1, 2).reshape(B, N, C)
+        x = self.proj(x)
+        return self.proj_drop(x)
+
+    def no_weight_decay(self):
+        return {'temperature'}
+
+
+class XCABlock(nnx.Module):
+    """XCA + LPI + MLP block (reference xcit.py:297-351)."""
+
+    def __init__(self, dim: int, num_heads: int, mlp_ratio: float = 4.0, qkv_bias: bool = False,
+                 proj_drop: float = 0.0, attn_drop: float = 0.0, drop_path: float = 0.0,
+                 act_layer: Union[str, Callable] = 'gelu', norm_layer: Callable = LayerNorm,
+                 eta: float = 1.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.attn = XCA(dim, num_heads=num_heads, qkv_bias=qkv_bias,
+                        attn_drop=attn_drop, proj_drop=proj_drop, **kw)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.norm3 = norm_layer(dim, rngs=rngs)
+        self.local_mp = LPI(dim, act_layer=act_layer, **kw)
+        self.drop_path3 = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp = Mlp(dim, hidden_features=int(dim * mlp_ratio), act_layer=act_layer,
+                       drop=proj_drop, **kw)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+        self.gamma1 = nnx.Param(jnp.full((dim,), eta, param_dtype))
+        self.gamma3 = nnx.Param(jnp.full((dim,), eta, param_dtype))
+        self.gamma2 = nnx.Param(jnp.full((dim,), eta, param_dtype))
+
+    def __call__(self, x, H: int, W: int):
+        x = x + self.drop_path1(self.gamma1[...].astype(x.dtype) * self.attn(self.norm1(x)))
+        # reference applies 3 (LPI) before 2 (MLP) to match released weights
+        x = x + self.drop_path3(self.gamma3[...].astype(x.dtype) * self.local_mp(self.norm3(x), H, W))
+        x = x + self.drop_path2(self.gamma2[...].astype(x.dtype) * self.mlp(self.norm2(x)))
+        return x
+
+
+class ClassAttentionBlock(nnx.Module):
+    """CaiT-style class-attention block w/ optional full-token norm
+    (reference xcit.py:173-238)."""
+
+    def __init__(self, dim: int, num_heads: int, mlp_ratio: float = 4.0, qkv_bias: bool = False,
+                 proj_drop: float = 0.0, attn_drop: float = 0.0, drop_path: float = 0.0,
+                 act_layer: Union[str, Callable] = 'gelu', norm_layer: Callable = LayerNorm,
+                 eta: Optional[float] = 1.0, tokens_norm: bool = False,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.attn = ClassAttn(dim, num_heads=num_heads, qkv_bias=qkv_bias,
+                              attn_drop=attn_drop, proj_drop=proj_drop, **kw)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp = Mlp(dim, hidden_features=int(dim * mlp_ratio), act_layer=act_layer,
+                       drop=proj_drop, **kw)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+        if eta is not None:
+            self.gamma1 = nnx.Param(jnp.full((dim,), eta, param_dtype))
+            self.gamma2 = nnx.Param(jnp.full((dim,), eta, param_dtype))
+        else:
+            self.gamma1 = None
+            self.gamma2 = None
+        self.tokens_norm = tokens_norm
+
+    def _g(self, gamma, y):
+        return y if gamma is None else gamma[...].astype(y.dtype) * y
+
+    def __call__(self, x):
+        x_norm1 = self.norm1(x)
+        x_attn = jnp.concatenate([self.attn(x_norm1), x_norm1[:, 1:]], axis=1)
+        x = x + self.drop_path1(self._g(self.gamma1, x_attn))
+        if self.tokens_norm:
+            x = self.norm2(x)
+        else:
+            x = jnp.concatenate([self.norm2(x[:, 0:1]), x[:, 1:]], axis=1)
+        x_res = x
+        cls_token = self._g(self.gamma2, self.mlp(x[:, 0:1]))
+        x = jnp.concatenate([cls_token, x[:, 1:]], axis=1)
+        return x_res + self.drop_path2(x)
+
+
+class Xcit(nnx.Module):
+    """XCiT with the reference's full model contract (reference xcit.py:353-643)."""
+
+    def __init__(
+            self,
+            img_size: Union[int, Tuple[int, int]] = 224,
+            patch_size: int = 16,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'token',
+            embed_dim: int = 768,
+            depth: int = 12,
+            num_heads: int = 12,
+            mlp_ratio: float = 4.0,
+            qkv_bias: bool = True,
+            drop_rate: float = 0.0,
+            pos_drop_rate: float = 0.0,
+            proj_drop_rate: float = 0.0,
+            attn_drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            act_layer: Union[str, Callable] = 'gelu',
+            norm_layer: Optional[Callable] = None,
+            cls_attn_layers: int = 2,
+            use_pos_embed: bool = True,
+            eta: float = 1.0,
+            tokens_norm: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert global_pool in ('', 'avg', 'token')
+        img_size = to_2tuple(img_size)
+        assert img_size[0] % patch_size == 0 and img_size[1] % patch_size == 0
+        norm_layer = norm_layer or partial(LayerNorm, eps=1e-6)
+        self.num_classes = num_classes
+        self.num_features = self.head_hidden_size = self.embed_dim = embed_dim
+        self.global_pool = global_pool
+        self.grad_checkpointing = False
+
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.patch_embed = ConvPatchEmbed(
+            img_size=img_size, patch_size=patch_size, in_chans=in_chans,
+            embed_dim=embed_dim, act_layer=act_layer, **kw)
+
+        self.cls_token = nnx.Param(
+            trunc_normal_(std=0.02)(rngs.params(), (1, 1, embed_dim), param_dtype))
+        self.pos_embed = PositionalEncodingFourier(dim=embed_dim, **kw) if use_pos_embed else None
+        self.pos_drop = Dropout(pos_drop_rate, rngs=rngs)
+
+        self.blocks = nnx.List([
+            XCABlock(
+                dim=embed_dim, num_heads=num_heads, mlp_ratio=mlp_ratio, qkv_bias=qkv_bias,
+                proj_drop=proj_drop_rate, attn_drop=attn_drop_rate, drop_path=drop_path_rate,
+                act_layer=act_layer, norm_layer=norm_layer, eta=eta, **kw)
+            for _ in range(depth)
+        ])
+        self.feature_info = [
+            dict(num_chs=embed_dim, reduction=patch_size, module=f'blocks.{i}') for i in range(depth)]
+
+        self.cls_attn_blocks = nnx.List([
+            ClassAttentionBlock(
+                dim=embed_dim, num_heads=num_heads, mlp_ratio=mlp_ratio, qkv_bias=qkv_bias,
+                proj_drop=drop_rate, attn_drop=attn_drop_rate, act_layer=act_layer,
+                norm_layer=norm_layer, eta=eta, tokens_norm=tokens_norm, **kw)
+            for _ in range(cls_attn_layers)
+        ])
+
+        self.norm = norm_layer(embed_dim, rngs=rngs)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.head = nnx.Linear(
+            embed_dim, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'pos_embed', 'cls_token', 'temperature'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^cls_token|pos_embed|patch_embed',
+            blocks=r'^blocks\.(\d+)',
+            cls_attn_blocks=[(r'^cls_attn_blocks\.(\d+)', None), (r'^norm', (99999,))],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            assert global_pool in ('', 'avg', 'token')
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs,
+        ) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        B = x.shape[0]
+        x, (Hp, Wp) = self.patch_embed(x)
+        if self.pos_embed is not None:
+            pos = self.pos_embed(Hp, Wp).reshape(1, -1, x.shape[-1])
+            x = x + pos.astype(x.dtype)
+        x = self.pos_drop(x)
+        if self.grad_checkpointing:
+            # remat per block; H/W are static python ints closed over safely
+            remat_block = nnx.remat(lambda blk, x_, h, w: blk(x_, h, w), static_argnums=(2, 3))
+            for blk in self.blocks:
+                x = remat_block(blk, x, Hp, Wp)
+        else:
+            for blk in self.blocks:
+                x = blk(x, Hp, Wp)
+        cls = jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (B, 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1)
+        for blk in self.cls_attn_blocks:
+            x = blk(x)
+        return self.norm(x) if self.norm is not None else x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        if self.global_pool:
+            x = x[:, 1:].mean(axis=1) if self.global_pool == 'avg' else x[:, 0]
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt in ('NHWC', 'NLC')
+        reshape = output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        B = x.shape[0]
+        x, (Hp, Wp) = self.patch_embed(x)
+        if self.pos_embed is not None:
+            pos = self.pos_embed(Hp, Wp).reshape(1, -1, x.shape[-1])
+            x = x + pos.astype(x.dtype)
+        x = self.pos_drop(x)
+
+        intermediates = []
+        blocks = self.blocks if not stop_early else list(self.blocks)[:max_index + 1]
+        for i, blk in enumerate(blocks):
+            x = blk(x, Hp, Wp)
+            if i in take_indices:
+                intermediates.append(self.norm(x) if (norm and self.norm is not None) else x)
+        if reshape:
+            intermediates = [y.reshape(B, Hp, Wp, -1) for y in intermediates]
+        if intermediates_only:
+            return intermediates
+
+        cls = jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (B, 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1)
+        for blk in self.cls_attn_blocks:
+            x = blk(x)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.cls_attn_blocks = nnx.List([])
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    import re
+    if 'model' in state_dict:
+        state_dict = state_dict['model']
+    out = {}
+    for k, v in state_dict.items():
+        k = k.replace('pos_embeder.', 'pos_embed.')
+        # torch nested Sequential (proj.{i}.{conv|bn}) → stages list (conv/bn named)
+        m = re.match(r'^patch_embed\.proj\.(\d+)\.(\d+)\.(.*)$', k)
+        if m:
+            stage = int(m.group(1)) // 2
+            part = 'conv' if m.group(2) == '0' else 'bn'
+            k = f'patch_embed.stages.{stage}.{part}.{m.group(3)}'
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_xcit(variant, pretrained=False, **kwargs):
+    out_indices = kwargs.pop('out_indices', 3)
+    return build_model_with_cfg(
+        Xcit, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices),
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': None,
+        'crop_pct': 1.0,
+        'interpolation': 'bicubic',
+        'fixed_input_size': True,
+        'mean': (0.485, 0.456, 0.406),
+        'std': (0.229, 0.224, 0.225),
+        'first_conv': 'patch_embed.stages.0.conv',
+        'classifier': 'head',
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+_sizes = {
+    'nano_12': dict(embed_dim=128, depth=12, num_heads=4),
+    'tiny_12': dict(embed_dim=192, depth=12, num_heads=4),
+    'small_12': dict(embed_dim=384, depth=12, num_heads=8),
+    'tiny_24': dict(embed_dim=192, depth=24, num_heads=4),
+    'small_24': dict(embed_dim=384, depth=24, num_heads=8),
+    'medium_24': dict(embed_dim=512, depth=24, num_heads=8),
+    'large_24': dict(embed_dim=768, depth=24, num_heads=16),
+}
+
+default_cfgs = generate_default_cfgs({
+    **{f'xcit_{s}_p{p}_224.fb_in1k': _cfg(hf_hub_id='timm/')
+       for s in _sizes for p in (16, 8)},
+    **{f'xcit_{s}_p{p}_224.fb_dist_in1k': _cfg(hf_hub_id='timm/')
+       for s in _sizes for p in (16, 8)},
+    **{f'xcit_{s}_p{p}_384.fb_dist_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384))
+       for s in _sizes for p in (16, 8)},
+    'test_xcit.untrained': _cfg(input_size=(3, 96, 96)),
+})
+
+
+def _make_entrypoint(size_key: str, patch: int, res: int):
+    args = _sizes[size_key]
+    # nano uses eta=1.0 tokens_norm=False; 12-deep non-nano eta=1.0; 24-deep eta=1e-5
+    eta = 1.0 if args['depth'] == 12 else 1e-5
+    tokens_norm = not size_key.startswith('nano')
+    name = f'xcit_{size_key}_p{patch}_{res}'
+
+    def entrypoint(pretrained=False, **kwargs):
+        model_args = dict(patch_size=patch, eta=eta, tokens_norm=tokens_norm, **args)
+        if res != 224:
+            model_args['img_size'] = res
+        return _create_xcit(name, pretrained=pretrained, **dict(model_args, **kwargs))
+
+    entrypoint.__name__ = name
+    entrypoint.__doc__ = f'XCiT {size_key} p{patch} @{res} (reference xcit.py entrypoints)'
+    return register_model(entrypoint)
+
+
+for _s in _sizes:
+    for _p in (16, 8):
+        for _r in (224, 384):
+            _make_entrypoint(_s, _p, _r)
+
+
+@register_model
+def test_xcit(pretrained=False, **kwargs) -> Xcit:
+    model_args = dict(
+        img_size=96, patch_size=16, embed_dim=64, depth=2, num_heads=2, mlp_ratio=3,
+        eta=1.0, tokens_norm=True, cls_attn_layers=1)
+    return _create_xcit('test_xcit', pretrained=pretrained, **dict(model_args, **kwargs))

@@ -35,10 +35,10 @@ from .norm_act import (
 )
 from .patch_dropout import PatchDropout
 from .patch_embed import PatchEmbed, resample_patch_embed
-from .pool import SelectAdaptivePool2d, adaptive_pool_feat_mult, global_pool_nlc
+from .pool import Pool2d, SelectAdaptivePool2d, adaptive_pool_feat_mult, create_pool2d, global_pool_nlc
 from .pos_embed import resample_abs_pos_embed, resample_abs_pos_embed_nhwc
 from .pos_embed_rel import (
-    RelPosBias, RelPosMlp, gen_relative_log_coords, gen_relative_position_index,
+    RelPosBias, RelPosBiasTf, RelPosMlp, gen_relative_log_coords, gen_relative_position_index,
     resize_rel_pos_bias_table_simple,
 )
 from .selective_kernel import SelectiveKernel, SelectiveKernelAttn

@@ -8,7 +8,64 @@ from typing import Optional
 import jax.numpy as jnp
 from flax import nnx
 
-__all__ = ['global_pool_nlc', 'SelectAdaptivePool2d', 'adaptive_pool_feat_mult']
+__all__ = ['Pool2d', 'SelectAdaptivePool2d', 'adaptive_pool_feat_mult', 'create_pool2d', 'global_pool_nlc']
+
+
+class Pool2d:
+    """Static NHWC max/avg pool with explicit torch-style padding
+    (reference layers/create_pool2d — XLA reduce_window under the hood).
+    Avg pool uses count_include_pad=False semantics (divides by valid count)."""
+
+    def __init__(self, pool_type: str, kernel_size, stride=None, padding=0):
+        from .helpers import to_2tuple
+        self.pool_type = pool_type
+        self.kernel = to_2tuple(kernel_size)
+        self.stride = to_2tuple(stride if stride is not None else kernel_size)
+        self.same = isinstance(padding, str) and padding.lower() == 'same'
+        self.padding = (0, 0) if self.same else to_2tuple(padding)
+
+    def _pads(self, H: int, W: int):
+        if not self.same:
+            ph, pw = self.padding
+            return ((ph, ph), (pw, pw))
+        # TF-SAME: possibly asymmetric, low = total // 2
+        out = []
+        for size, k, s in zip((H, W), self.kernel, self.stride):
+            total = max((-(-size // s) - 1) * s + k - size, 0)
+            out.append((total // 2, total - total // 2))
+        return tuple(out)
+
+    def __call__(self, x):
+        import jax
+        import jax.numpy as jnp
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        (pht, phb), (pwl, pwr) = self._pads(x.shape[1], x.shape[2])
+        pads = ((0, 0), (pht, phb), (pwl, pwr), (0, 0))
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        if self.pool_type == 'max':
+            xp = jnp.pad(x, pads, constant_values=-jnp.inf)
+            return jax.lax.reduce_window(xp, -jnp.inf, jax.lax.max, window, strides, 'VALID')
+        xp = jnp.pad(x, pads)
+        sums = jax.lax.reduce_window(xp, 0.0, jax.lax.add, window, strides, 'VALID')
+        if pht == 0 and phb == 0 and pwl == 0 and pwr == 0:
+            return sums / (kh * kw)
+        ones = jnp.pad(jnp.ones(x.shape[1:3], x.dtype), ((pht, phb), (pwl, pwr)))
+        counts = jax.lax.reduce_window(ones[None, :, :, None], 0.0, jax.lax.add, window, strides, 'VALID')
+        return sums / counts
+
+
+def create_pool2d(pool_type: str, kernel_size, stride=None, padding=0, count_include_pad: bool = False):
+    """Factory matching the reference create_pool2d surface for max/avg.
+
+    Only count_include_pad=False avg semantics are implemented (every shipped
+    caller uses it); requesting True raises rather than silently diverging.
+    """
+    assert pool_type in ('max', 'avg')
+    if count_include_pad:
+        raise NotImplementedError('count_include_pad=True avg pooling not supported')
+    return Pool2d(pool_type, kernel_size, stride=stride, padding=padding)
 
 
 def global_pool_nlc(

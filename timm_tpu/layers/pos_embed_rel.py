@@ -21,8 +21,8 @@ from .mlp import Mlp
 from .weight_init import trunc_normal_
 
 __all__ = [
-    'gen_relative_position_index', 'gen_relative_log_coords', 'RelPosBias', 'RelPosMlp',
-    'resize_rel_pos_bias_table_simple',
+    'gen_relative_position_index', 'gen_relative_log_coords', 'RelPosBias', 'RelPosBiasTf',
+    'RelPosMlp', 'resize_rel_pos_bias_table_simple',
 ]
 
 
@@ -99,6 +99,48 @@ class RelPosBias(nnx.Module):
     def get_bias(self) -> jax.Array:
         bias = self.relative_position_bias_table[...][self._index]
         bias = bias.reshape(self.bias_shape).transpose(2, 0, 1)  # (H, N, N)
+        return bias[None]
+
+    def __call__(self, attn, shared_rel_pos=None):
+        return attn + self.get_bias().astype(attn.dtype)
+
+
+class RelPosBiasTf(nnx.Module):
+    """TF-MaxViT-compatible relative position bias: a (heads, 2H-1, 2W-1)
+    table indexed by decomposed row/col offsets (reference
+    pos_embed_rel.py:467-527). The reference materialises one-hot lookup
+    tensors and einsums; here the gather indices are trace-time numpy
+    constants so the bias is two static takes."""
+
+    def __init__(
+            self,
+            window_size: Tuple[int, int],
+            num_heads: int,
+            prefix_tokens: int = 0,
+            *,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert prefix_tokens <= 1
+        self.window_size = window_size
+        self.window_area = window_size[0] * window_size[1]
+        self.num_heads = num_heads
+        h, w = window_size
+        self.bias_shape = (num_heads, 2 * h - 1, 2 * w - 1)
+        self.relative_position_bias_table = nnx.Param(
+            jax.random.normal(rngs.params(), self.bias_shape, param_dtype) * 0.02)
+        idx_h = np.arange(h)[:, None] - np.arange(h)[None, :] + (h - 1)  # (qh, kh)
+        idx_w = np.arange(w)[:, None] - np.arange(w)[None, :] + (w - 1)  # (qw, kw)
+        self._idx_h = jnp.asarray(idx_h)
+        self._idx_w = jnp.asarray(idx_w)
+
+    def get_bias(self) -> jax.Array:
+        h, w = self.window_size
+        table = self.relative_position_bias_table[...]
+        bias = table[:, self._idx_h]            # (nh, qh, kh, 2w-1)
+        bias = bias[..., self._idx_w]           # (nh, qh, kh, qw, kw)
+        bias = bias.transpose(0, 1, 3, 2, 4)    # (nh, qh, qw, kh, kw)
+        bias = bias.reshape(self.num_heads, self.window_area, self.window_area)
         return bias[None]
 
     def __call__(self, attn, shared_rel_pos=None):

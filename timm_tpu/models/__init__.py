@@ -22,8 +22,10 @@ from .densenet import DenseNet
 from .efficientnet import EfficientNet
 from .eva import Eva
 from .levit import Levit, LevitDistilled
+from .maxxvit import MaxxVit, MaxxVitCfg
 from .mlp_mixer import MlpMixer
 from .mobilenetv3 import MobileNetV3
+from .mvitv2 import MultiScaleVit, MultiScaleVitCfg
 from .naflexvit import NaFlexVit
 from .nfnet import NfCfg, NormFreeNet
 from .regnet import RegNet

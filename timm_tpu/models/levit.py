@@ -366,7 +366,6 @@ class Levit(nnx.Module):
             if num_classes > 0 else None
         self._dtype = dtype
         self._param_dtype = param_dtype
-        self._kw = dict(dtype=dtype, param_dtype=param_dtype)
 
     # -- contract ------------------------------------------------------------
     def no_weight_decay(self):

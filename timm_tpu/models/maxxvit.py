@@ -1,0 +1,1242 @@
+"""MaxxViT: CoAtNet + MaxViT meta-architecture, TPU-native
+(reference: timm/models/maxxvit.py:1-2711; Tu et al. 'MaxViT', Dai et al.
+'CoAtNet', plus timm 'rw' experimental variants).
+
+One configurable trunk covers CoAtNet ('C'/'T' stages: MBConv + full-grid
+transformer blocks), MaxViT ('M' blocks: MBConv → window attention → grid
+attention), parallel-partition ('PM') and ConvNeXt-conv ('maxxvit') hybrids.
+
+TPU-first notes: the reference maintains parallel NCHW (`Attention2d`,
+`PartitionAttention2d`) and channels-last (`AttentionCl`) code paths purely
+for torch memory-format performance; in NHWC/XLA there is one layout, so a
+single attention/partition implementation serves every config (`use_nchw_attn`
+is accepted and ignored). Window/grid partitions are reshape+transpose pairs
+XLA folds into the attention matmuls; rel-pos bias tables gather with
+trace-time constant indices (bias / mlp / tf-bias types).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    ClassifierHead, ConvMlp, DropPath, Dropout, LayerNorm, LayerScale,
+    LayerScale2d, Mlp, NormMlpClassifierHead, RelPosBias, RelPosBiasTf,
+    RelPosMlp, calculate_drop_path_rates, create_attn, create_conv2d,
+    create_pool2d, extend_tuple, get_act_fn, get_norm_act_layer, get_norm_layer,
+    make_divisible, to_2tuple, trunc_normal_tf_, zeros_,
+)
+from ..layers.attention import scaled_dot_product_attention
+from ..layers.drop import dropout_rng_key
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+from .swin_transformer import window_partition, window_reverse
+
+__all__ = ['MaxxVit', 'MaxxVitCfg', 'MaxxVitConvCfg', 'MaxxVitTransformerCfg']
+
+
+@dataclass
+class MaxxVitTransformerCfg:
+    """Field-compatible with reference maxxvit.py:85-116."""
+    dim_head: int = 32
+    head_first: bool = True
+    expand_ratio: float = 4.0
+    expand_first: bool = True
+    shortcut_bias: bool = True
+    attn_bias: bool = True
+    attn_drop: float = 0.0
+    proj_drop: float = 0.0
+    pool_type: str = 'avg2'
+    rel_pos_type: str = 'bias'
+    rel_pos_dim: int = 512
+    partition_ratio: int = 32
+    window_size: Optional[Tuple[int, int]] = None
+    grid_size: Optional[Tuple[int, int]] = None
+    no_block_attn: bool = False
+    use_nchw_attn: bool = False  # accepted for cfg parity; NHWC path is identical
+    init_values: Optional[float] = None
+    act_layer: str = 'gelu'
+    norm_layer: str = 'layernorm2d'
+    norm_layer_cl: str = 'layernorm'
+    norm_eps: float = 1e-6
+
+    def __post_init__(self):
+        if self.grid_size is not None:
+            self.grid_size = to_2tuple(self.grid_size)
+        if self.window_size is not None:
+            self.window_size = to_2tuple(self.window_size)
+            if self.grid_size is None:
+                self.grid_size = self.window_size
+
+
+@dataclass
+class MaxxVitConvCfg:
+    """Field-compatible with reference maxxvit.py:119-153."""
+    block_type: str = 'mbconv'
+    expand_ratio: float = 4.0
+    expand_output: bool = True
+    kernel_size: int = 3
+    group_size: int = 1
+    pre_norm_act: bool = False
+    output_bias: bool = True
+    stride_mode: str = 'dw'
+    pool_type: str = 'avg2'
+    downsample_pool_type: str = 'avg2'
+    padding: str = ''
+    attn_early: bool = False
+    attn_layer: str = 'se'
+    attn_act_layer: str = 'silu'
+    attn_ratio: float = 0.25
+    init_values: Optional[float] = 1e-6
+    act_layer: str = 'gelu'
+    norm_layer: str = ''
+    norm_layer_cl: str = ''
+    norm_eps: Optional[float] = None
+
+    def __post_init__(self):
+        assert self.block_type in ('mbconv', 'convnext')
+        use_mbconv = self.block_type == 'mbconv'
+        if not self.norm_layer:
+            self.norm_layer = 'batchnorm2d' if use_mbconv else 'layernorm2d'
+        if not self.norm_layer_cl and not use_mbconv:
+            self.norm_layer_cl = 'layernorm'
+        if self.norm_eps is None:
+            self.norm_eps = 1e-5 if use_mbconv else 1e-6
+        self.downsample_pool_type = self.downsample_pool_type or self.pool_type
+
+
+@dataclass
+class MaxxVitCfg:
+    """Field-compatible with reference maxxvit.py:156-166."""
+    embed_dim: Tuple[int, ...] = (96, 192, 384, 768)
+    depths: Tuple[int, ...] = (2, 3, 5, 2)
+    block_type: Tuple[Union[str, Tuple[str, ...]], ...] = ('C', 'C', 'T', 'T')
+    stem_width: Union[int, Tuple[int, int]] = 64
+    stem_bias: bool = False
+    conv_cfg: MaxxVitConvCfg = field(default_factory=MaxxVitConvCfg)
+    transformer_cfg: MaxxVitTransformerCfg = field(default_factory=MaxxVitTransformerCfg)
+    head_hidden_size: Optional[int] = None
+    weight_init: str = 'vit_eff'
+
+
+def grid_partition(x, grid_size: Tuple[int, int]):
+    """(B, H, W, C) → (B*nW, gh*gw, C), dilated grid windows (reference
+    maxxvit.py:762-771)."""
+    B, H, W, C = x.shape
+    gh, gw = grid_size
+    x = x.reshape(B, gh, H // gh, gw, W // gw, C)
+    return x.transpose(0, 2, 4, 1, 3, 5).reshape(-1, gh * gw, C)
+
+
+def grid_reverse(windows, grid_size: Tuple[int, int], H: int, W: int):
+    gh, gw = grid_size
+    C = windows.shape[-1]
+    x = windows.reshape(-1, H // gh, W // gw, gh, gw, C)
+    return x.transpose(0, 3, 1, 4, 2, 5).reshape(-1, H, W, C)
+
+
+def get_rel_pos_cls(cfg: MaxxVitTransformerCfg, window_size) -> Optional[Callable]:
+    if cfg.rel_pos_type == 'mlp':
+        return partial(RelPosMlp, window_size=window_size, hidden_dim=cfg.rel_pos_dim, mode='cr')
+    if cfg.rel_pos_type == 'bias':
+        return partial(RelPosBias, window_size=window_size)
+    if cfg.rel_pos_type == 'bias_tf':
+        return partial(RelPosBiasTf, window_size=window_size)
+    return None
+
+
+class MaxxAttention(nnx.Module):
+    """Unified NHWC attention over flattened (B, N, C) tokens, serving both the
+    reference's Attention2d (NCHW, 1x1-conv qkv) and AttentionCl (linear qkv)
+    — identical math in channels-last (reference maxxvit.py:169-336)."""
+
+    def __init__(
+            self, dim: int, dim_out: Optional[int] = None, dim_head: int = 32,
+            bias: bool = True, expand_first: bool = True, head_first: bool = True,
+            rel_pos_cls: Optional[Callable] = None, attn_drop: float = 0.0, proj_drop: float = 0.0,
+            *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        dim_out = dim_out or dim
+        dim_attn = dim_out if expand_first and dim_out > dim else dim
+        assert dim_attn % dim_head == 0
+        self.num_heads = dim_attn // dim_head
+        self.dim_head = dim_head
+        self.dim_attn = dim_attn
+        self.head_first = head_first
+        self.scale = dim_head ** -0.5
+
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_tf_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.qkv = linear(dim, dim_attn * 3, use_bias=bias)
+        self.rel_pos = rel_pos_cls(num_heads=self.num_heads, param_dtype=param_dtype, rngs=rngs) \
+            if rel_pos_cls else None
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = linear(dim_attn, dim_out, use_bias=bias)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x, shared_rel_pos=None):
+        B, N, C = x.shape
+        qkv = self.qkv(x)
+        if self.head_first:
+            # channel layout (nh, 3, dh)
+            qkv = qkv.reshape(B, N, self.num_heads, 3, self.dim_head)
+            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        else:
+            # channel layout (3, nh, dh)
+            qkv = qkv.reshape(B, N, 3, self.num_heads, self.dim_head)
+            q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+
+        attn_bias = None
+        if self.rel_pos is not None:
+            attn_bias = self.rel_pos.get_bias()
+        elif shared_rel_pos is not None:
+            attn_bias = shared_rel_pos
+        if attn_bias is not None:
+            attn_bias = jnp.broadcast_to(
+                attn_bias.astype(jnp.float32), (B, self.num_heads, N, N))
+        dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop.rate
+        dropout_key = dropout_rng_key(self.attn_drop) if dropout_p > 0.0 else None
+        x = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_bias, dropout_p=dropout_p, dropout_key=dropout_key,
+            scale=self.scale, fused=False)
+        x = x.transpose(0, 2, 1, 3).reshape(B, N, self.dim_attn)
+        x = self.proj(x)
+        return self.proj_drop(x)
+
+
+class Downsample2d(nnx.Module):
+    """Pool (+ optional 1x1 expand) downsample (reference maxxvit.py:338-386)."""
+
+    def __init__(self, dim: int, dim_out: int, pool_type: str = 'avg2', padding: str = '',
+                 bias: bool = True, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        assert pool_type in ('max', 'max2', 'avg', 'avg2')
+        if pool_type == 'max':
+            self.pool = create_pool2d('max', kernel_size=3, stride=2, padding=padding or 1)
+        elif pool_type == 'max2':
+            self.pool = create_pool2d('max', 2, padding=padding or 0)
+        elif pool_type == 'avg':
+            self.pool = create_pool2d('avg', kernel_size=3, stride=2, padding=padding or 1)
+        else:
+            self.pool = create_pool2d('avg', 2, padding=padding or 0)
+        if dim != dim_out:
+            self.expand = nnx.Conv(
+                dim, dim_out, kernel_size=(1, 1), use_bias=bias,
+                kernel_init=trunc_normal_tf_(std=0.02), bias_init=zeros_,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        else:
+            self.expand = None
+
+    def __call__(self, x):
+        x = self.pool(x)
+        if self.expand is not None:
+            x = self.expand(x)
+        return x
+
+
+class _NormDown(nnx.Module):
+    """norm1 = Sequential(norm, down) container matching torch key layout."""
+
+    def __init__(self, norm, down):
+        self.norm = norm
+        self.down = down
+
+    def __call__(self, x):
+        return self.down(self.norm(x))
+
+
+class TransformerBlock2d(nnx.Module):
+    """Full-grid transformer block for CoAtNet 'T' stages
+    (reference maxxvit.py:413-492)."""
+
+    def __init__(self, dim: int, dim_out: int, stride: int = 1,
+                 rel_pos_cls: Optional[Callable] = None,
+                 cfg: MaxxVitTransformerCfg = MaxxVitTransformerCfg(), drop_path: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        norm_layer = partial(get_norm_layer(cfg.norm_layer), eps=cfg.norm_eps)
+        act_layer = cfg.act_layer
+
+        if stride == 2:
+            self.shortcut = Downsample2d(dim, dim_out, pool_type=cfg.pool_type, bias=cfg.shortcut_bias, **kw)
+            self.norm1 = _NormDown(
+                norm_layer(dim, rngs=rngs),
+                Downsample2d(dim, dim, pool_type=cfg.pool_type, **kw))
+        else:
+            assert dim == dim_out
+            self.shortcut = None
+            self.norm1 = norm_layer(dim, rngs=rngs)
+
+        self.attn = MaxxAttention(
+            dim, dim_out, dim_head=cfg.dim_head, expand_first=cfg.expand_first,
+            bias=cfg.attn_bias, head_first=cfg.head_first, rel_pos_cls=rel_pos_cls,
+            attn_drop=cfg.attn_drop, proj_drop=cfg.proj_drop, **kw)
+        self.ls1 = LayerScale2d(dim_out, init_values=cfg.init_values, param_dtype=param_dtype, rngs=rngs) \
+            if cfg.init_values else None
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+
+        self.norm2 = norm_layer(dim_out, rngs=rngs)
+        self.mlp = ConvMlp(
+            dim_out, hidden_features=int(dim_out * cfg.expand_ratio), act_layer=act_layer,
+            drop=cfg.proj_drop, **kw)
+        self.ls2 = LayerScale2d(dim_out, init_values=cfg.init_values, param_dtype=param_dtype, rngs=rngs) \
+            if cfg.init_values else None
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def _attn(self, x):
+        B, H, W, C = x.shape
+        y = self.attn(x.reshape(B, H * W, C))
+        return y.reshape(B, H, W, -1)
+
+    def __call__(self, x, shared_rel_pos=None):
+        shortcut = self.shortcut(x) if self.shortcut is not None else x
+        y = self._attn(self.norm1(x))
+        if self.ls1 is not None:
+            y = self.ls1(y)
+        x = shortcut + self.drop_path1(y)
+        y = self.mlp(self.norm2(x))
+        if self.ls2 is not None:
+            y = self.ls2(y)
+        x = x + self.drop_path2(y)
+        return x
+
+
+def num_groups(group_size: Optional[int], channels: int) -> int:
+    if not group_size:
+        return 1
+    assert channels % group_size == 0
+    return channels // group_size
+
+
+class MbConvBlock(nnx.Module):
+    """Pre-norm inverted-bottleneck conv block (reference maxxvit.py:528-637)."""
+
+    def __init__(self, in_chs: int, out_chs: int, stride: int = 1,
+                 dilation: Tuple[int, int] = (1, 1),
+                 cfg: MaxxVitConvCfg = MaxxVitConvCfg(), drop_path: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        norm_act_layer = partial(get_norm_act_layer(cfg.norm_layer, cfg.act_layer), eps=cfg.norm_eps)
+        mid_chs = make_divisible((out_chs if cfg.expand_output else in_chs) * cfg.expand_ratio)
+        groups = num_groups(cfg.group_size, mid_chs)
+
+        if stride == 2:
+            self.shortcut = Downsample2d(
+                in_chs, out_chs, pool_type=cfg.pool_type, bias=cfg.output_bias, padding=cfg.padding, **kw)
+        else:
+            self.shortcut = None
+
+        assert cfg.stride_mode in ('pool', '1x1', 'dw')
+        stride_pool, stride_1, stride_2 = 1, 1, 1
+        dilation_2 = dilation[1]
+        if cfg.stride_mode == 'pool':
+            stride_pool = stride
+        elif cfg.stride_mode == '1x1':
+            stride_1 = stride
+        else:
+            stride_2, dilation_2 = stride, dilation[0]
+
+        self.pre_norm = norm_act_layer(in_chs, apply_act=cfg.pre_norm_act, rngs=rngs)
+        if stride_pool > 1:
+            self.down = Downsample2d(in_chs, in_chs, pool_type=cfg.downsample_pool_type,
+                                     padding=cfg.padding, **kw)
+        else:
+            self.down = None
+        self.conv1_1x1 = create_conv2d(in_chs, mid_chs, 1, stride=stride_1, **kw)
+        self.norm1 = norm_act_layer(mid_chs, rngs=rngs)
+        self.conv2_kxk = create_conv2d(
+            mid_chs, mid_chs, cfg.kernel_size, stride=stride_2, dilation=dilation_2,
+            groups=groups, padding=cfg.padding, **kw)
+
+        attn_kwargs = {}
+        if cfg.attn_layer in ('se', 'eca'):
+            attn_kwargs['act_layer'] = cfg.attn_act_layer
+            attn_kwargs['rd_channels'] = int(cfg.attn_ratio * (out_chs if cfg.expand_output else mid_chs))
+        if cfg.attn_early:
+            self.se_early = create_attn(cfg.attn_layer, mid_chs, rngs=rngs, **attn_kwargs)
+            self.norm2 = norm_act_layer(mid_chs, rngs=rngs)
+            self.se = None
+        else:
+            self.se_early = None
+            self.norm2 = norm_act_layer(mid_chs, rngs=rngs)
+            self.se = create_attn(cfg.attn_layer, mid_chs, rngs=rngs, **attn_kwargs)
+
+        self.conv3_1x1 = create_conv2d(mid_chs, out_chs, 1, bias=cfg.output_bias, **kw)
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        shortcut = self.shortcut(x) if self.shortcut is not None else x
+        x = self.pre_norm(x)
+        if self.down is not None:
+            x = self.down(x)
+        x = self.conv1_1x1(x)
+        x = self.norm1(x)
+        x = self.conv2_kxk(x)
+        if self.se_early is not None:
+            x = self.se_early(x)
+        x = self.norm2(x)
+        if self.se is not None:
+            x = self.se(x)
+        x = self.conv3_1x1(x)
+        return self.drop_path(x) + shortcut
+
+
+class ConvNeXtBlock(nnx.Module):
+    """ConvNeXt block for 'maxxvit'/'coatnext' configs (reference
+    maxxvit.py:639-739, conv_mlp path; NHWC makes conv_mlp/mlp identical)."""
+
+    def __init__(self, in_chs: int, out_chs: Optional[int] = None, kernel_size: int = 7,
+                 stride: int = 1, dilation: Tuple[int, int] = (1, 1),
+                 cfg: MaxxVitConvCfg = MaxxVitConvCfg(), drop_path: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        out_chs = out_chs or in_chs
+        norm_layer = partial(get_norm_layer(cfg.norm_layer), eps=cfg.norm_eps)
+
+        if stride == 2:
+            self.shortcut = Downsample2d(in_chs, out_chs, **kw)
+        elif in_chs != out_chs:
+            self.shortcut = nnx.Conv(
+                in_chs, out_chs, kernel_size=(1, 1), use_bias=cfg.output_bias,
+                kernel_init=trunc_normal_tf_(std=0.02), bias_init=zeros_,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        else:
+            self.shortcut = None
+
+        assert cfg.stride_mode in ('pool', 'dw')
+        stride_pool, stride_dw = 1, 1
+        if cfg.stride_mode == 'pool':
+            stride_pool = stride
+        else:
+            stride_dw = stride
+        if stride_pool == 2:
+            self.down = Downsample2d(in_chs, in_chs, pool_type=cfg.downsample_pool_type, **kw)
+        else:
+            self.down = None
+
+        self.conv_dw = create_conv2d(
+            in_chs, out_chs, kernel_size=kernel_size, stride=stride_dw, dilation=dilation[1],
+            depthwise=True, bias=cfg.output_bias, **kw)
+        self.norm = norm_layer(out_chs, rngs=rngs)
+        self.mlp = ConvMlp(
+            out_chs, int(cfg.expand_ratio * out_chs), bias=cfg.output_bias,
+            act_layer=cfg.act_layer, **kw)
+        self.ls = LayerScale2d(out_chs, cfg.init_values, param_dtype=param_dtype, rngs=rngs) \
+            if cfg.init_values else None
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        shortcut = self.shortcut(x) if self.shortcut is not None else x
+        if self.down is not None:
+            x = self.down(x)
+        x = self.conv_dw(x)
+        x = self.norm(x)
+        x = self.mlp(x)
+        if self.ls is not None:
+            x = self.ls(x)
+        return self.drop_path(x) + shortcut
+
+
+class PartitionAttention(nnx.Module):
+    """Window or grid partition + attention + FFN (serves both
+    PartitionAttentionCl and PartitionAttention2d — reference
+    maxxvit.py:794-862, 992-1068)."""
+
+    def __init__(self, dim: int, partition_type: str = 'block',
+                 cfg: MaxxVitTransformerCfg = MaxxVitTransformerCfg(), drop_path: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        norm_layer = partial(get_norm_layer(cfg.norm_layer_cl), eps=cfg.norm_eps)
+        self.partition_block = partition_type == 'block'
+        self.partition_size = to_2tuple(cfg.window_size if self.partition_block else cfg.grid_size)
+        rel_pos_cls = get_rel_pos_cls(cfg, self.partition_size)
+
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.attn = MaxxAttention(
+            dim, dim, dim_head=cfg.dim_head, bias=cfg.attn_bias, head_first=cfg.head_first,
+            rel_pos_cls=rel_pos_cls, attn_drop=cfg.attn_drop, proj_drop=cfg.proj_drop, **kw)
+        self.ls1 = LayerScale(dim, init_values=cfg.init_values, param_dtype=param_dtype, rngs=rngs) \
+            if cfg.init_values else None
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp = Mlp(dim, hidden_features=int(dim * cfg.expand_ratio), act_layer=cfg.act_layer,
+                       drop=cfg.proj_drop, **kw)
+        self.ls2 = LayerScale(dim, init_values=cfg.init_values, param_dtype=param_dtype, rngs=rngs) \
+            if cfg.init_values else None
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def _partition_attn(self, x):
+        B, H, W, C = x.shape
+        if self.partition_block:
+            part = window_partition(x, self.partition_size)
+            part = self.attn(part)
+            return window_reverse(part, self.partition_size, H, W)
+        part = grid_partition(x, self.partition_size)
+        part = self.attn(part)
+        return grid_reverse(part, self.partition_size, H, W)
+
+    def __call__(self, x):
+        y = self._partition_attn(self.norm1(x))
+        if self.ls1 is not None:
+            y = self.ls1(y)
+        x = x + self.drop_path1(y)
+        y = self.mlp(self.norm2(x))
+        if self.ls2 is not None:
+            y = self.ls2(y)
+        x = x + self.drop_path2(y)
+        return x
+
+
+class ParallelPartitionAttention(nnx.Module):
+    """Parallel window+grid halves, one FFN (reference maxxvit.py:865-949)."""
+
+    def __init__(self, dim: int, cfg: MaxxVitTransformerCfg = MaxxVitTransformerCfg(),
+                 drop_path: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        assert dim % 2 == 0
+        norm_layer = partial(get_norm_layer(cfg.norm_layer_cl), eps=cfg.norm_eps)
+        assert cfg.window_size == cfg.grid_size
+        self.partition_size = to_2tuple(cfg.window_size)
+        rel_pos_cls = get_rel_pos_cls(cfg, self.partition_size)
+
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        attn_kw = dict(
+            dim_head=cfg.dim_head, bias=cfg.attn_bias, head_first=cfg.head_first,
+            rel_pos_cls=rel_pos_cls, attn_drop=cfg.attn_drop, proj_drop=cfg.proj_drop, **kw)
+        self.attn_block = MaxxAttention(dim, dim // 2, **attn_kw)
+        self.attn_grid = MaxxAttention(dim, dim // 2, **attn_kw)
+        self.ls1 = LayerScale(dim, init_values=cfg.init_values, param_dtype=param_dtype, rngs=rngs) \
+            if cfg.init_values else None
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp = Mlp(dim, hidden_features=int(dim * cfg.expand_ratio), out_features=dim,
+                       act_layer=cfg.act_layer, drop=cfg.proj_drop, **kw)
+        self.ls2 = LayerScale(dim, init_values=cfg.init_values, param_dtype=param_dtype, rngs=rngs) \
+            if cfg.init_values else None
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def _partition_attn(self, x):
+        B, H, W, C = x.shape
+        pb = window_partition(x, self.partition_size)
+        pb = self.attn_block(pb)
+        xw = window_reverse(pb, self.partition_size, H, W)
+        pg = grid_partition(x, self.partition_size)
+        pg = self.attn_grid(pg)
+        xg = grid_reverse(pg, self.partition_size, H, W)
+        return jnp.concatenate([xw, xg], axis=-1)
+
+    def __call__(self, x):
+        y = self._partition_attn(self.norm1(x))
+        if self.ls1 is not None:
+            y = self.ls1(y)
+        x = x + self.drop_path1(y)
+        y = self.mlp(self.norm2(x))
+        if self.ls2 is not None:
+            y = self.ls2(y)
+        x = x + self.drop_path2(y)
+        return x
+
+
+class MaxxVitBlock(nnx.Module):
+    """MBConv (or ConvNeXt) + window attn + grid attn (reference
+    maxxvit.py:1070-1124)."""
+
+    def __init__(self, dim: int, dim_out: int, stride: int = 1,
+                 conv_cfg: MaxxVitConvCfg = MaxxVitConvCfg(),
+                 transformer_cfg: MaxxVitTransformerCfg = MaxxVitTransformerCfg(),
+                 drop_path: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        conv_cls = ConvNeXtBlock if conv_cfg.block_type == 'convnext' else MbConvBlock
+        self.conv = conv_cls(dim, dim_out, stride=stride, cfg=conv_cfg, drop_path=drop_path, **kw)
+        attn_kw = dict(dim=dim_out, cfg=transformer_cfg, drop_path=drop_path, **kw)
+        self.attn_block = None if transformer_cfg.no_block_attn else PartitionAttention(**attn_kw)
+        self.attn_grid = PartitionAttention(partition_type='grid', **attn_kw)
+
+    def __call__(self, x):
+        x = self.conv(x)
+        if self.attn_block is not None:
+            x = self.attn_block(x)
+        return self.attn_grid(x)
+
+
+class ParallelMaxxVitBlock(nnx.Module):
+    """Convs + parallel window/grid attention (reference maxxvit.py:1126-1176)."""
+
+    def __init__(self, dim, dim_out, stride=1, num_conv=2,
+                 conv_cfg: MaxxVitConvCfg = MaxxVitConvCfg(),
+                 transformer_cfg: MaxxVitTransformerCfg = MaxxVitTransformerCfg(),
+                 drop_path: float = 0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        conv_cls = ConvNeXtBlock if conv_cfg.block_type == 'convnext' else MbConvBlock
+        if num_conv > 1:
+            convs = [conv_cls(dim, dim_out, stride=stride, cfg=conv_cfg, drop_path=drop_path, **kw)]
+            convs += [conv_cls(dim_out, dim_out, cfg=conv_cfg, drop_path=drop_path, **kw)
+                      for _ in range(num_conv - 1)]
+            self.conv = nnx.List(convs)
+        else:
+            self.conv = conv_cls(dim, dim_out, stride=stride, cfg=conv_cfg, drop_path=drop_path, **kw)
+        self.attn = ParallelPartitionAttention(dim=dim_out, cfg=transformer_cfg, drop_path=drop_path, **kw)
+
+    def __call__(self, x):
+        if isinstance(self.conv, nnx.List):
+            for c in self.conv:
+                x = c(x)
+        else:
+            x = self.conv(x)
+        return self.attn(x)
+
+
+class MaxxVitStage(nnx.Module):
+    """Mixed conv/transformer stage (reference maxxvit.py:1178-1266)."""
+
+    def __init__(
+            self, in_chs: int, out_chs: int, stride: int = 2, depth: int = 4,
+            feat_size: Tuple[int, int] = (14, 14), block_types: Union[str, Tuple[str, ...]] = 'C',
+            transformer_cfg: MaxxVitTransformerCfg = MaxxVitTransformerCfg(),
+            conv_cfg: MaxxVitConvCfg = MaxxVitConvCfg(),
+            drop_path: Union[float, List[float]] = 0.0,
+            *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.grad_checkpointing = False
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        block_types = extend_tuple(block_types, depth)
+        blocks = []
+        for i, t in enumerate(block_types):
+            block_stride = stride if i == 0 else 1
+            assert t in ('C', 'T', 'M', 'PM')
+            dp = drop_path[i] if isinstance(drop_path, (list, tuple)) else drop_path
+            if t == 'C':
+                conv_cls = ConvNeXtBlock if conv_cfg.block_type == 'convnext' else MbConvBlock
+                blocks.append(conv_cls(in_chs, out_chs, stride=block_stride, cfg=conv_cfg, drop_path=dp, **kw))
+            elif t == 'T':
+                rel_pos_cls = get_rel_pos_cls(transformer_cfg, feat_size)
+                blocks.append(TransformerBlock2d(
+                    in_chs, out_chs, stride=block_stride, rel_pos_cls=rel_pos_cls,
+                    cfg=transformer_cfg, drop_path=dp, **kw))
+            elif t == 'M':
+                blocks.append(MaxxVitBlock(
+                    in_chs, out_chs, stride=block_stride, conv_cfg=conv_cfg,
+                    transformer_cfg=transformer_cfg, drop_path=dp, **kw))
+            else:  # 'PM'
+                blocks.append(ParallelMaxxVitBlock(
+                    in_chs, out_chs, stride=block_stride, conv_cfg=conv_cfg,
+                    transformer_cfg=transformer_cfg, drop_path=dp, **kw))
+            in_chs = out_chs
+        self.blocks = nnx.List(blocks)
+
+    def __call__(self, x):
+        if self.grad_checkpointing:
+            x = checkpoint_seq(self.blocks, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
+        return x
+
+
+class Stem(nnx.Module):
+    """Two-conv stride-2 stem (reference maxxvit.py:1268-1316)."""
+
+    def __init__(self, in_chs: int, out_chs, kernel_size: int = 3, padding: str = '',
+                 bias: bool = False, act_layer: str = 'gelu', norm_layer: str = 'batchnorm2d',
+                 norm_eps: float = 1e-5,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        out_chs = to_2tuple(out_chs)
+        norm_act_layer = partial(get_norm_act_layer(norm_layer, act_layer), eps=norm_eps)
+        self.out_chs = out_chs[-1]
+        self.stride = 2
+        self.conv1 = create_conv2d(in_chs, out_chs[0], kernel_size, stride=2, padding=padding, bias=bias, **kw)
+        self.norm1 = norm_act_layer(out_chs[0], rngs=rngs)
+        self.conv2 = create_conv2d(out_chs[0], out_chs[1], kernel_size, stride=1, padding=padding, bias=bias, **kw)
+
+    def __call__(self, x):
+        return self.conv2(self.norm1(self.conv1(x)))
+
+
+def cfg_window_size(cfg: MaxxVitTransformerCfg, img_size: Tuple[int, int]) -> MaxxVitTransformerCfg:
+    if cfg.window_size is not None:
+        assert cfg.grid_size
+        return cfg
+    partition_size = img_size[0] // cfg.partition_ratio, img_size[1] // cfg.partition_ratio
+    return replace(cfg, window_size=partition_size, grid_size=partition_size)
+
+
+def _overlay_kwargs(cfg: MaxxVitCfg, **kwargs):
+    transformer_kwargs, conv_kwargs, base_kwargs = {}, {}, {}
+    for k, v in kwargs.items():
+        if k.startswith('transformer_'):
+            transformer_kwargs[k.replace('transformer_', '')] = v
+        elif k.startswith('conv_'):
+            conv_kwargs[k.replace('conv_', '')] = v
+        else:
+            base_kwargs[k] = v
+    return replace(
+        cfg,
+        transformer_cfg=replace(cfg.transformer_cfg, **transformer_kwargs),
+        conv_cfg=replace(cfg.conv_cfg, **conv_kwargs),
+        **base_kwargs,
+    )
+
+
+class MaxxVit(nnx.Module):
+    """CoAtNet + MaxViT trunk with the reference's model contract
+    (reference maxxvit.py:1349-1577)."""
+
+    def __init__(
+            self,
+            cfg: MaxxVitCfg,
+            img_size: Union[int, Tuple[int, int]] = 224,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+            **kwargs,
+    ):
+        img_size = to_2tuple(img_size)
+        if kwargs:
+            cfg = _overlay_kwargs(cfg, **kwargs)
+        transformer_cfg = cfg_window_size(cfg.transformer_cfg, img_size)
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.num_features = self.embed_dim = cfg.embed_dim[-1]
+        self.drop_rate = drop_rate
+        self.grad_checkpointing = False
+        self.feature_info = []
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        self.stem = Stem(
+            in_chs=in_chans, out_chs=cfg.stem_width, padding=cfg.conv_cfg.padding,
+            bias=cfg.stem_bias, act_layer=cfg.conv_cfg.act_layer,
+            norm_layer=cfg.conv_cfg.norm_layer, norm_eps=cfg.conv_cfg.norm_eps, **kw)
+        stride = self.stem.stride
+        self.feature_info += [dict(num_chs=self.stem.out_chs, reduction=2, module='stem')]
+        feat_size = tuple(i // s for i, s in zip(img_size, to_2tuple(stride)))
+
+        num_stages = len(cfg.embed_dim)
+        assert len(cfg.depths) == num_stages
+        dpr = calculate_drop_path_rates(drop_path_rate, list(cfg.depths), stagewise=True)
+        in_chs = self.stem.out_chs
+        stages = []
+        for i in range(num_stages):
+            stage_stride = 2
+            out_chs = cfg.embed_dim[i]
+            feat_size = tuple((r - 1) // stage_stride + 1 for r in feat_size)
+            stages.append(MaxxVitStage(
+                in_chs, out_chs, depth=cfg.depths[i], block_types=cfg.block_type[i],
+                conv_cfg=cfg.conv_cfg, transformer_cfg=transformer_cfg,
+                feat_size=feat_size, drop_path=dpr[i], **kw))
+            stride *= stage_stride
+            in_chs = out_chs
+            self.feature_info += [dict(num_chs=out_chs, reduction=stride, module=f'stages.{i}')]
+        self.stages = nnx.List(stages)
+
+        final_norm_layer = partial(get_norm_layer(cfg.transformer_cfg.norm_layer),
+                                   eps=cfg.transformer_cfg.norm_eps)
+        if cfg.head_hidden_size:
+            self.norm = None
+            self.head_hidden_size = cfg.head_hidden_size
+            self.head = NormMlpClassifierHead(
+                self.num_features, num_classes, hidden_size=self.head_hidden_size,
+                pool_type=global_pool, drop_rate=drop_rate, norm_layer=final_norm_layer, **kw)
+        else:
+            self.head_hidden_size = self.num_features
+            self.norm = final_norm_layer(self.num_features, rngs=rngs)
+            self.head = ClassifierHead(
+                self.num_features, num_classes, pool_type=global_pool, drop_rate=drop_rate, **kw)
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self):
+        return {'relative_position_bias_table', 'rel_pos.mlp'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^stem',
+            blocks=[(r'^stages\.(\d+)', None), (r'^norm', (99999,))],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        for s in self.stages:
+            s.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        self.head.reset(num_classes, global_pool, rngs=rngs)
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.stem(x)
+        for stage in self.stages:
+            x = stage(x)
+        return self.norm(x) if self.norm is not None else x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        return self.head(x, pre_logits=pre_logits)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages) + 1, indices)
+        intermediates = []
+        feat_idx = 0
+        x = self.stem(x)
+        if feat_idx in take_indices:
+            intermediates.append(x)
+        last_idx = len(self.stages)
+        stages = self.stages if not stop_early else list(self.stages)[:max_index]
+        for stage in stages:
+            feat_idx += 1
+            x = stage(x)
+            if feat_idx in take_indices:
+                x_inter = self.norm(x) if (norm and self.norm is not None and feat_idx == last_idx) else x
+                intermediates.append(x_inter)
+        if intermediates_only:
+            return intermediates
+        if feat_idx == last_idx and self.norm is not None:
+            x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stages) + 1, indices)
+        self.stages = nnx.List(list(self.stages)[:max_index])
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    out = {}
+    for k, v in state_dict.items():
+        if k.endswith(('relative_position_index', 'height_lookup', 'width_lookup')):
+            continue
+        # torch NormMlpClassifierHead nests pre_logits as Sequential('fc','act')
+        k = k.replace('head.pre_logits.fc.', 'head.pre_logits_fc.')
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+# ---------------------------------------------------------------------------
+# config constructors — values mirror reference maxxvit.py:1580-1747 exactly
+# (recipe data, kept verbatim so released checkpoints/configs transfer)
+# ---------------------------------------------------------------------------
+
+def _rw_coat_cfg(
+        stride_mode='pool', pool_type='avg2', conv_output_bias=False, conv_attn_early=False,
+        conv_attn_act_layer='relu', conv_norm_layer='', transformer_shortcut_bias=True,
+        transformer_norm_layer='layernorm2d', transformer_norm_layer_cl='layernorm',
+        init_values=None, rel_pos_type='bias', rel_pos_dim=512):
+    return dict(
+        conv_cfg=MaxxVitConvCfg(
+            stride_mode=stride_mode, pool_type=pool_type, pre_norm_act=True,
+            expand_output=False, output_bias=conv_output_bias, attn_early=conv_attn_early,
+            attn_act_layer=conv_attn_act_layer, act_layer='silu', norm_layer=conv_norm_layer),
+        transformer_cfg=MaxxVitTransformerCfg(
+            expand_first=False, shortcut_bias=transformer_shortcut_bias, pool_type=pool_type,
+            init_values=init_values, norm_layer=transformer_norm_layer,
+            norm_layer_cl=transformer_norm_layer_cl, rel_pos_type=rel_pos_type,
+            rel_pos_dim=rel_pos_dim),
+    )
+
+
+def _rw_max_cfg(
+        stride_mode='dw', pool_type='avg2', conv_output_bias=False, conv_attn_ratio=1 / 16,
+        conv_norm_layer='', transformer_norm_layer='layernorm2d',
+        transformer_norm_layer_cl='layernorm', window_size=None, dim_head=32,
+        init_values=None, rel_pos_type='bias', rel_pos_dim=512):
+    return dict(
+        conv_cfg=MaxxVitConvCfg(
+            stride_mode=stride_mode, pool_type=pool_type, expand_output=False,
+            output_bias=conv_output_bias, attn_ratio=conv_attn_ratio, act_layer='silu',
+            norm_layer=conv_norm_layer),
+        transformer_cfg=MaxxVitTransformerCfg(
+            expand_first=False, pool_type=pool_type, dim_head=dim_head, window_size=window_size,
+            init_values=init_values, norm_layer=transformer_norm_layer,
+            norm_layer_cl=transformer_norm_layer_cl, rel_pos_type=rel_pos_type,
+            rel_pos_dim=rel_pos_dim),
+    )
+
+
+def _next_cfg(
+        stride_mode='dw', pool_type='avg2', conv_norm_layer='layernorm2d',
+        conv_norm_layer_cl='layernorm', transformer_norm_layer='layernorm2d',
+        transformer_norm_layer_cl='layernorm', window_size=None, no_block_attn=False,
+        init_values=1e-6, rel_pos_type='mlp', rel_pos_dim=512):
+    init_values = to_2tuple(init_values)
+    return dict(
+        conv_cfg=MaxxVitConvCfg(
+            block_type='convnext', stride_mode=stride_mode, pool_type=pool_type,
+            expand_output=False, init_values=init_values[0], norm_layer=conv_norm_layer,
+            norm_layer_cl=conv_norm_layer_cl),
+        transformer_cfg=MaxxVitTransformerCfg(
+            expand_first=False, pool_type=pool_type, window_size=window_size,
+            no_block_attn=no_block_attn, init_values=init_values[1],
+            norm_layer=transformer_norm_layer, norm_layer_cl=transformer_norm_layer_cl,
+            rel_pos_type=rel_pos_type, rel_pos_dim=rel_pos_dim),
+    )
+
+
+def _tf_cfg():
+    return dict(
+        conv_cfg=MaxxVitConvCfg(norm_eps=1e-3, act_layer='gelu_tanh', padding='same'),
+        transformer_cfg=MaxxVitTransformerCfg(
+            norm_eps=1e-5, act_layer='gelu_tanh', head_first=False, rel_pos_type='bias_tf'),
+    )
+
+
+model_cfgs = dict(
+    # timm-specific CoAtNet configs
+    coatnet_pico_rw=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(2, 3, 5, 2), stem_width=(32, 64),
+        **_rw_max_cfg(conv_output_bias=True, conv_attn_ratio=0.25)),
+    coatnet_nano_rw=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(3, 4, 6, 3), stem_width=(32, 64),
+        **_rw_max_cfg(stride_mode='pool', conv_output_bias=True, conv_attn_ratio=0.25)),
+    coatnet_0_rw=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(2, 3, 7, 2), stem_width=(32, 64),
+        **_rw_coat_cfg(conv_attn_early=True, transformer_shortcut_bias=False)),
+    coatnet_1_rw=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(2, 6, 14, 2), stem_width=(32, 64),
+        **_rw_coat_cfg(stride_mode='dw', conv_attn_early=True, transformer_shortcut_bias=False)),
+    coatnet_2_rw=MaxxVitCfg(
+        embed_dim=(128, 256, 512, 1024), depths=(2, 6, 14, 2), stem_width=(64, 128),
+        **_rw_coat_cfg(stride_mode='dw', conv_attn_act_layer='silu')),
+    coatnet_3_rw=MaxxVitCfg(
+        embed_dim=(192, 384, 768, 1536), depths=(2, 6, 14, 2), stem_width=(96, 192),
+        **_rw_coat_cfg(stride_mode='dw', conv_attn_act_layer='silu', init_values=1e-6)),
+    coatnet_bn_0_rw=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(2, 3, 7, 2), stem_width=(32, 64),
+        **_rw_coat_cfg(stride_mode='dw', conv_attn_early=True, transformer_shortcut_bias=False,
+                       transformer_norm_layer='batchnorm2d')),
+    coatnet_rmlp_nano_rw=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(3, 4, 6, 3), stem_width=(32, 64),
+        **_rw_max_cfg(conv_output_bias=True, conv_attn_ratio=0.25, rel_pos_type='mlp',
+                      rel_pos_dim=384)),
+    coatnet_rmlp_0_rw=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(2, 3, 7, 2), stem_width=(32, 64),
+        **_rw_coat_cfg(stride_mode='dw', rel_pos_type='mlp')),
+    coatnet_rmlp_1_rw=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(2, 6, 14, 2), stem_width=(32, 64),
+        **_rw_coat_cfg(pool_type='max', conv_attn_early=True, transformer_shortcut_bias=False,
+                       rel_pos_type='mlp', rel_pos_dim=384)),
+    coatnet_rmlp_1_rw2=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(2, 6, 14, 2), stem_width=(32, 64),
+        **_rw_coat_cfg(stride_mode='dw', rel_pos_type='mlp', rel_pos_dim=512)),
+    coatnet_rmlp_2_rw=MaxxVitCfg(
+        embed_dim=(128, 256, 512, 1024), depths=(2, 6, 14, 2), stem_width=(64, 128),
+        **_rw_coat_cfg(stride_mode='dw', conv_attn_act_layer='silu', init_values=1e-6,
+                       rel_pos_type='mlp')),
+    coatnet_rmlp_3_rw=MaxxVitCfg(
+        embed_dim=(192, 384, 768, 1536), depths=(2, 6, 14, 2), stem_width=(96, 192),
+        **_rw_coat_cfg(stride_mode='dw', conv_attn_act_layer='silu', init_values=1e-6,
+                       rel_pos_type='mlp')),
+    coatnet_nano_cc=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(3, 4, 6, 3), stem_width=(32, 64),
+        block_type=('C', 'C', ('C', 'T'), ('C', 'T')), **_rw_coat_cfg()),
+    coatnext_nano_rw=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(3, 4, 6, 3), stem_width=(32, 64),
+        weight_init='normal', **_next_cfg(rel_pos_type='bias', init_values=(1e-5, None))),
+
+    # CoAtNet paper-like configs
+    coatnet_0=MaxxVitCfg(embed_dim=(96, 192, 384, 768), depths=(2, 3, 5, 2),
+                         stem_width=64, head_hidden_size=768),
+    coatnet_1=MaxxVitCfg(embed_dim=(96, 192, 384, 768), depths=(2, 6, 14, 2),
+                         stem_width=64, head_hidden_size=768),
+    coatnet_2=MaxxVitCfg(embed_dim=(128, 256, 512, 1024), depths=(2, 6, 14, 2),
+                         stem_width=128, head_hidden_size=1024),
+    coatnet_3=MaxxVitCfg(embed_dim=(192, 384, 768, 1536), depths=(2, 6, 14, 2),
+                         stem_width=192, head_hidden_size=1536),
+    coatnet_4=MaxxVitCfg(embed_dim=(192, 384, 768, 1536), depths=(2, 12, 28, 2),
+                         stem_width=192, head_hidden_size=1536),
+    coatnet_5=MaxxVitCfg(embed_dim=(256, 512, 1280, 2048), depths=(2, 12, 28, 2),
+                         stem_width=192, head_hidden_size=2048),
+
+    # Experimental MaxVit configs
+    maxvit_pico_rw=MaxxVitCfg(
+        embed_dim=(32, 64, 128, 256), depths=(2, 2, 5, 2), block_type=('M',) * 4,
+        stem_width=(24, 32), **_rw_max_cfg()),
+    maxvit_nano_rw=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(1, 2, 3, 1), block_type=('M',) * 4,
+        stem_width=(32, 64), **_rw_max_cfg()),
+    maxvit_tiny_rw=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(2, 2, 5, 2), block_type=('M',) * 4,
+        stem_width=(32, 64), **_rw_max_cfg()),
+    maxvit_tiny_pm=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(2, 2, 5, 2), block_type=('PM',) * 4,
+        stem_width=(32, 64), **_rw_max_cfg()),
+    maxvit_rmlp_pico_rw=MaxxVitCfg(
+        embed_dim=(32, 64, 128, 256), depths=(2, 2, 5, 2), block_type=('M',) * 4,
+        stem_width=(24, 32), **_rw_max_cfg(rel_pos_type='mlp')),
+    maxvit_rmlp_nano_rw=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(1, 2, 3, 1), block_type=('M',) * 4,
+        stem_width=(32, 64), **_rw_max_cfg(rel_pos_type='mlp')),
+    maxvit_rmlp_tiny_rw=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(2, 2, 5, 2), block_type=('M',) * 4,
+        stem_width=(32, 64), **_rw_max_cfg(rel_pos_type='mlp')),
+    maxvit_rmlp_small_rw=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(2, 2, 5, 2), block_type=('M',) * 4,
+        stem_width=(32, 64), **_rw_max_cfg(rel_pos_type='mlp', init_values=1e-6)),
+    maxvit_rmlp_base_rw=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(2, 6, 14, 2), block_type=('M',) * 4,
+        stem_width=(32, 64), head_hidden_size=768, **_rw_max_cfg(rel_pos_type='mlp')),
+
+    maxxvit_rmlp_nano_rw=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(1, 2, 3, 1), block_type=('M',) * 4,
+        stem_width=(32, 64), weight_init='normal', **_next_cfg()),
+    maxxvit_rmlp_tiny_rw=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(2, 2, 5, 2), block_type=('M',) * 4,
+        stem_width=(32, 64), **_next_cfg()),
+    maxxvit_rmlp_small_rw=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(2, 2, 5, 2), block_type=('M',) * 4,
+        stem_width=(48, 96), **_next_cfg()),
+    maxxvitv2_nano_rw=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(1, 2, 3, 1), block_type=('M',) * 4,
+        stem_width=(48, 96), weight_init='normal',
+        **_next_cfg(no_block_attn=True, rel_pos_type='bias')),
+    maxxvitv2_rmlp_base_rw=MaxxVitCfg(
+        embed_dim=(128, 256, 512, 1024), depths=(2, 6, 12, 2), block_type=('M',) * 4,
+        stem_width=(64, 128), **_next_cfg(no_block_attn=True)),
+    maxxvitv2_rmlp_large_rw=MaxxVitCfg(
+        embed_dim=(160, 320, 640, 1280), depths=(2, 6, 16, 2), block_type=('M',) * 4,
+        stem_width=(80, 160), head_hidden_size=1280, **_next_cfg(no_block_attn=True)),
+
+    # MaxViT paper (TF port) configs
+    maxvit_tiny_tf=MaxxVitCfg(
+        embed_dim=(64, 128, 256, 512), depths=(2, 2, 5, 2), block_type=('M',) * 4,
+        stem_width=64, stem_bias=True, head_hidden_size=512, **_tf_cfg()),
+    maxvit_small_tf=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(2, 2, 5, 2), block_type=('M',) * 4,
+        stem_width=64, stem_bias=True, head_hidden_size=768, **_tf_cfg()),
+    maxvit_base_tf=MaxxVitCfg(
+        embed_dim=(96, 192, 384, 768), depths=(2, 6, 14, 2), block_type=('M',) * 4,
+        stem_width=64, stem_bias=True, head_hidden_size=768, **_tf_cfg()),
+    maxvit_large_tf=MaxxVitCfg(
+        embed_dim=(128, 256, 512, 1024), depths=(2, 6, 14, 2), block_type=('M',) * 4,
+        stem_width=128, stem_bias=True, head_hidden_size=1024, **_tf_cfg()),
+    maxvit_xlarge_tf=MaxxVitCfg(
+        embed_dim=(192, 384, 768, 1536), depths=(2, 6, 14, 2), block_type=('M',) * 4,
+        stem_width=192, stem_bias=True, head_hidden_size=1536, **_tf_cfg()),
+
+    test_maxxvit=MaxxVitCfg(
+        embed_dim=(16, 32, 48), depths=(1, 1, 1), block_type=('C', 'M', 'T'),
+        stem_width=(8, 16), **_rw_max_cfg()),
+)
+
+
+def _create_maxxvit(variant, cfg_variant=None, pretrained=False, **kwargs):
+    if cfg_variant is None:
+        if variant in model_cfgs:
+            cfg_variant = variant
+        else:
+            cfg_variant = '_'.join(variant.split('_')[:-1])
+    return build_model_with_cfg(
+        MaxxVit, variant, pretrained,
+        model_cfg=model_cfgs[cfg_variant],
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(flatten_sequential=True),
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': (7, 7),
+        'crop_pct': 0.95,
+        'interpolation': 'bicubic',
+        'fixed_input_size': True,
+        'mean': (0.5, 0.5, 0.5),
+        'std': (0.5, 0.5, 0.5),
+        'first_conv': 'stem.conv1',
+        'classifier': 'head.fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'coatnet_pico_rw_224.untrained': _cfg(),
+    'coatnet_nano_rw_224.sw_in1k': _cfg(hf_hub_id='timm/', crop_pct=0.9),
+    'coatnet_0_rw_224.sw_in1k': _cfg(hf_hub_id='timm/'),
+    'coatnet_1_rw_224.sw_in1k': _cfg(hf_hub_id='timm/'),
+    'coatnet_2_rw_224.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'coatnet_3_rw_224.untrained': _cfg(),
+    'coatnet_bn_0_rw_224.sw_in1k': _cfg(
+        hf_hub_id='timm/', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'coatnet_rmlp_nano_rw_224.sw_in1k': _cfg(hf_hub_id='timm/', crop_pct=0.9),
+    'coatnet_rmlp_0_rw_224.untrained': _cfg(),
+    'coatnet_rmlp_1_rw_224.sw_in1k': _cfg(hf_hub_id='timm/'),
+    'coatnet_rmlp_1_rw2_224.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'coatnet_rmlp_2_rw_224.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'coatnet_rmlp_2_rw_384.sw_in12k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+    'coatnet_rmlp_3_rw_224.untrained': _cfg(),
+    'coatnet_nano_cc_224.untrained': _cfg(),
+    'coatnext_nano_rw_224.sw_in1k': _cfg(hf_hub_id='timm/', crop_pct=0.9),
+    'coatnet_0_224.untrained': _cfg(),
+    'coatnet_1_224.untrained': _cfg(),
+    'coatnet_2_224.untrained': _cfg(),
+    'coatnet_3_224.untrained': _cfg(),
+    'coatnet_4_224.untrained': _cfg(),
+    'coatnet_5_224.untrained': _cfg(),
+
+    'maxvit_pico_rw_256.untrained': _cfg(input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxvit_nano_rw_256.sw_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxvit_tiny_rw_224.sw_in1k': _cfg(hf_hub_id='timm/'),
+    'maxvit_tiny_rw_256.untrained': _cfg(input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxvit_tiny_pm_256.untrained': _cfg(input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxvit_rmlp_pico_rw_256.sw_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxvit_rmlp_nano_rw_256.sw_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxvit_rmlp_tiny_rw_256.sw_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxvit_rmlp_small_rw_224.sw_in1k': _cfg(hf_hub_id='timm/', crop_pct=0.9),
+    'maxvit_rmlp_small_rw_256.untrained': _cfg(input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxvit_rmlp_base_rw_224.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'maxvit_rmlp_base_rw_384.sw_in12k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+
+    'maxxvit_rmlp_nano_rw_256.sw_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxxvit_rmlp_tiny_rw_256.untrained': _cfg(input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxxvit_rmlp_small_rw_256.sw_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxxvitv2_nano_rw_256.sw_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8)),
+    'maxxvitv2_rmlp_base_rw_224.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'maxxvitv2_rmlp_base_rw_384.sw_in12k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+    'maxxvitv2_rmlp_large_rw_224.untrained': _cfg(),
+
+    'maxvit_tiny_tf_224.in1k': _cfg(hf_hub_id='timm/'),
+    'maxvit_tiny_tf_384.in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+    'maxvit_tiny_tf_512.in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 512, 512), pool_size=(16, 16), crop_pct=1.0),
+    'maxvit_small_tf_224.in1k': _cfg(hf_hub_id='timm/'),
+    'maxvit_small_tf_384.in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+    'maxvit_small_tf_512.in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 512, 512), pool_size=(16, 16), crop_pct=1.0),
+    'maxvit_base_tf_224.in1k': _cfg(hf_hub_id='timm/'),
+    'maxvit_base_tf_384.in21k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+    'maxvit_base_tf_512.in21k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 512, 512), pool_size=(16, 16), crop_pct=1.0),
+    'maxvit_large_tf_224.in1k': _cfg(hf_hub_id='timm/'),
+    'maxvit_large_tf_384.in21k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+    'maxvit_large_tf_512.in21k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 512, 512), pool_size=(16, 16), crop_pct=1.0),
+    'maxvit_xlarge_tf_224.in21k': _cfg(hf_hub_id='timm/', num_classes=21843),
+    'maxvit_xlarge_tf_384.in21k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+    'maxvit_xlarge_tf_512.in21k_ft_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 512, 512), pool_size=(16, 16), crop_pct=1.0),
+
+    'test_maxxvit.untrained': _cfg(input_size=(3, 96, 96), pool_size=(3, 3)),
+})
+
+
+def _make_entry(name: str, cfg_variant: str, img_size: Optional[int] = None):
+    def entrypoint(pretrained=False, **kwargs):
+        if img_size is not None and img_size != 224:
+            kwargs.setdefault('img_size', img_size)
+        return _create_maxxvit(name, cfg_variant=cfg_variant, pretrained=pretrained, **kwargs)
+    entrypoint.__name__ = name
+    entrypoint.__doc__ = f'MaxxVit family model {name} (reference maxxvit.py entrypoints)'
+    return register_model(entrypoint)
+
+
+_entrypoints = [
+    # (variant name, cfg key)
+    ('coatnet_pico_rw_224', 'coatnet_pico_rw'),
+    ('coatnet_nano_rw_224', 'coatnet_nano_rw'),
+    ('coatnet_0_rw_224', 'coatnet_0_rw'),
+    ('coatnet_1_rw_224', 'coatnet_1_rw'),
+    ('coatnet_2_rw_224', 'coatnet_2_rw'),
+    ('coatnet_3_rw_224', 'coatnet_3_rw'),
+    ('coatnet_bn_0_rw_224', 'coatnet_bn_0_rw'),
+    ('coatnet_rmlp_nano_rw_224', 'coatnet_rmlp_nano_rw'),
+    ('coatnet_rmlp_0_rw_224', 'coatnet_rmlp_0_rw'),
+    ('coatnet_rmlp_1_rw_224', 'coatnet_rmlp_1_rw'),
+    ('coatnet_rmlp_1_rw2_224', 'coatnet_rmlp_1_rw2'),
+    ('coatnet_rmlp_2_rw_224', 'coatnet_rmlp_2_rw'),
+    ('coatnet_rmlp_2_rw_384', 'coatnet_rmlp_2_rw'),
+    ('coatnet_rmlp_3_rw_224', 'coatnet_rmlp_3_rw'),
+    ('coatnet_nano_cc_224', 'coatnet_nano_cc'),
+    ('coatnext_nano_rw_224', 'coatnext_nano_rw'),
+    ('coatnet_0_224', 'coatnet_0'),
+    ('coatnet_1_224', 'coatnet_1'),
+    ('coatnet_2_224', 'coatnet_2'),
+    ('coatnet_3_224', 'coatnet_3'),
+    ('coatnet_4_224', 'coatnet_4'),
+    ('coatnet_5_224', 'coatnet_5'),
+    ('maxvit_pico_rw_256', 'maxvit_pico_rw'),
+    ('maxvit_nano_rw_256', 'maxvit_nano_rw'),
+    ('maxvit_tiny_rw_224', 'maxvit_tiny_rw'),
+    ('maxvit_tiny_rw_256', 'maxvit_tiny_rw'),
+    ('maxvit_rmlp_pico_rw_256', 'maxvit_rmlp_pico_rw'),
+    ('maxvit_rmlp_nano_rw_256', 'maxvit_rmlp_nano_rw'),
+    ('maxvit_rmlp_tiny_rw_256', 'maxvit_rmlp_tiny_rw'),
+    ('maxvit_rmlp_small_rw_224', 'maxvit_rmlp_small_rw'),
+    ('maxvit_rmlp_small_rw_256', 'maxvit_rmlp_small_rw'),
+    ('maxvit_rmlp_base_rw_224', 'maxvit_rmlp_base_rw'),
+    ('maxvit_rmlp_base_rw_384', 'maxvit_rmlp_base_rw'),
+    ('maxvit_tiny_pm_256', 'maxvit_tiny_pm'),
+    ('maxxvit_rmlp_nano_rw_256', 'maxxvit_rmlp_nano_rw'),
+    ('maxxvit_rmlp_tiny_rw_256', 'maxxvit_rmlp_tiny_rw'),
+    ('maxxvit_rmlp_small_rw_256', 'maxxvit_rmlp_small_rw'),
+    ('maxxvitv2_nano_rw_256', 'maxxvitv2_nano_rw'),
+    ('maxxvitv2_rmlp_base_rw_224', 'maxxvitv2_rmlp_base_rw'),
+    ('maxxvitv2_rmlp_base_rw_384', 'maxxvitv2_rmlp_base_rw'),
+    ('maxxvitv2_rmlp_large_rw_224', 'maxxvitv2_rmlp_large_rw'),
+    ('maxvit_tiny_tf_224', 'maxvit_tiny_tf'),
+    ('maxvit_tiny_tf_384', 'maxvit_tiny_tf'),
+    ('maxvit_tiny_tf_512', 'maxvit_tiny_tf'),
+    ('maxvit_small_tf_224', 'maxvit_small_tf'),
+    ('maxvit_small_tf_384', 'maxvit_small_tf'),
+    ('maxvit_small_tf_512', 'maxvit_small_tf'),
+    ('maxvit_base_tf_224', 'maxvit_base_tf'),
+    ('maxvit_base_tf_384', 'maxvit_base_tf'),
+    ('maxvit_base_tf_512', 'maxvit_base_tf'),
+    ('maxvit_large_tf_224', 'maxvit_large_tf'),
+    ('maxvit_large_tf_384', 'maxvit_large_tf'),
+    ('maxvit_large_tf_512', 'maxvit_large_tf'),
+    ('maxvit_xlarge_tf_224', 'maxvit_xlarge_tf'),
+    ('maxvit_xlarge_tf_384', 'maxvit_xlarge_tf'),
+    ('maxvit_xlarge_tf_512', 'maxvit_xlarge_tf'),
+]
+
+for _name, _cfg_key in _entrypoints:
+    _size = int(_name.rsplit('_', 1)[-1])
+    _make_entry(_name, _cfg_key, img_size=_size)
+
+
+@register_model
+def test_maxxvit(pretrained=False, **kwargs) -> MaxxVit:
+    return _create_maxxvit('test_maxxvit', pretrained=pretrained, **kwargs)

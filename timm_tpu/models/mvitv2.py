@@ -1,0 +1,679 @@
+"""MViTv2: Improved Multiscale Vision Transformers, TPU-native
+(reference: timm/models/mvitv2.py:1-1160; Li et al. 2022).
+
+A pooling-attention pyramid: q/k/v are depthwise-conv-pooled inside
+attention, queries shrink the resolution at stage starts, and a decomposed
+(row + column) relative position bias is added to the logits. TPU-first
+notes: feature sizes are static python ints threaded through the stage loop
+(no dynamic shapes under jit); the rel-pos gather indices are trace-time
+numpy constants; the cls-token bias row/col is handled by zero-padding the
+decomposed bias rather than in-place slice assignment.
+
+`pool_first` (MViT-v1 ordering) is not implemented — no v2 config uses it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from ..layers import (
+    Dropout, DropPath, LayerNorm, Mlp, to_2tuple, trunc_normal_tf_, zeros_,
+    calculate_drop_path_rates,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['MultiScaleVit', 'MultiScaleVitCfg']
+
+
+@dataclass
+class MultiScaleVitCfg:
+    """Config schema kept field-compatible with the reference
+    (mvitv2.py:37-83) so recipes transfer."""
+    depths: Tuple[int, ...] = (2, 3, 16, 3)
+    embed_dim: Union[int, Tuple[int, ...]] = 96
+    num_heads: Union[int, Tuple[int, ...]] = 1
+    mlp_ratio: float = 4.0
+    pool_first: bool = False
+    expand_attn: bool = True
+    qkv_bias: bool = True
+    use_cls_token: bool = False
+    use_abs_pos: bool = False
+    residual_pooling: bool = True
+    mode: str = 'conv'
+    kernel_qkv: Tuple[int, int] = (3, 3)
+    stride_q: Optional[Tuple[Tuple[int, int], ...]] = ((1, 1), (2, 2), (2, 2), (2, 2))
+    stride_kv: Optional[Tuple[Tuple[int, int], ...]] = None
+    stride_kv_adaptive: Optional[Tuple[int, int]] = (4, 4)
+    patch_kernel: Tuple[int, int] = (7, 7)
+    patch_stride: Tuple[int, int] = (4, 4)
+    patch_padding: Tuple[int, int] = (3, 3)
+    pool_type: str = 'max'
+    rel_pos_type: str = 'spatial'
+    act_layer: Union[str, Tuple[str, str]] = 'gelu'
+    norm_layer: Union[str, Tuple[str, str]] = 'layernorm'
+    norm_eps: float = 1e-6
+
+    def __post_init__(self):
+        num_stages = len(self.depths)
+        if not isinstance(self.embed_dim, (tuple, list)):
+            self.embed_dim = tuple(self.embed_dim * 2 ** i for i in range(num_stages))
+        assert len(self.embed_dim) == num_stages
+        if not isinstance(self.num_heads, (tuple, list)):
+            self.num_heads = tuple(self.num_heads * 2 ** i for i in range(num_stages))
+        assert len(self.num_heads) == num_stages
+        if self.stride_kv_adaptive is not None and self.stride_kv is None:
+            _stride_kv = self.stride_kv_adaptive
+            pool_kv_stride = []
+            for i in range(num_stages):
+                if min(self.stride_q[i]) > 1:
+                    _stride_kv = [max(_stride_kv[d] // self.stride_q[i][d], 1)
+                                  for d in range(len(_stride_kv))]
+                pool_kv_stride.append(tuple(_stride_kv))
+            self.stride_kv = tuple(pool_kv_stride)
+
+
+def _rel_pos_dist_idx(q_size: int, k_size: int) -> np.ndarray:
+    """Static (q, k) index into a rel-pos table (reference cal_rel_pos_type
+    distance computation, mvitv2.py:152-185)."""
+    q_ratio = max(k_size / q_size, 1.0)
+    k_ratio = max(q_size / k_size, 1.0)
+    dist = (np.arange(q_size)[:, None] * q_ratio - np.arange(k_size)[None, :] * k_ratio)
+    dist += (k_size - 1) * k_ratio
+    return dist.astype(np.int64)
+
+
+class MultiScalePatchEmbed(nnx.Module):
+    """Overlapping conv patch embed (reference mvitv2.py:89-121)."""
+
+    def __init__(self, dim_in=3, dim_out=768, kernel=(7, 7), stride=(4, 4), padding=(3, 3),
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.proj = nnx.Conv(
+            dim_in, dim_out, kernel_size=kernel, strides=stride,
+            padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+            kernel_init=trunc_normal_tf_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        x = self.proj(x)
+        B, H, W, C = x.shape
+        return x.reshape(B, H * W, C), (H, W)
+
+
+def _pool_tokens(x, pool_fn, feat_size, num_heads, has_cls):
+    """(B, heads, N, d) → pooled (B, heads, N', d) + new feat size."""
+    H, W = feat_size
+    if has_cls:
+        cls_tok, x = x[:, :, :1], x[:, :, 1:]
+    else:
+        cls_tok = None
+    B, nh, N, d = x.shape
+    x = x.reshape(B * nh, H, W, d)
+    x = pool_fn(x)
+    Hp, Wp = x.shape[1], x.shape[2]
+    x = x.reshape(B, nh, Hp * Wp, d)
+    if cls_tok is not None:
+        x = jnp.concatenate([cls_tok, x], axis=2)
+    return x, (Hp, Wp)
+
+
+class MultiScaleAttention(nnx.Module):
+    """Pooling attention w/ decomposed rel-pos bias (reference mvitv2.py:378-540)."""
+
+    def __init__(
+            self, dim, dim_out, feat_size, num_heads=8, qkv_bias=True, mode='conv',
+            kernel_q=(1, 1), kernel_kv=(1, 1), stride_q=(1, 1), stride_kv=(1, 1),
+            has_cls_token=True, rel_pos_type='spatial', residual_pooling=True,
+            norm_layer: Callable = LayerNorm,
+            *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.num_heads = num_heads
+        self.dim_out = dim_out
+        self.head_dim = dim_out // num_heads
+        self.scale = self.head_dim ** -0.5
+        self.has_cls_token = has_cls_token
+        padding_q = tuple(int(q // 2) for q in kernel_q)
+        padding_kv = tuple(int(kv // 2) for kv in kernel_kv)
+
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_tf_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.qkv = linear(dim, dim_out * 3, use_bias=qkv_bias)
+        self.proj = linear(dim_out, dim_out)
+
+        import math
+        if math.prod(kernel_q) == 1 and math.prod(stride_q) == 1:
+            kernel_q = None
+        if math.prod(kernel_kv) == 1 and math.prod(stride_kv) == 1:
+            kernel_kv = None
+        self.mode = mode
+        norm_q = norm_k = norm_v = None
+        pool_q = pool_k = pool_v = None
+        if mode in ('avg', 'max'):
+            if kernel_q:
+                pool_q = _MaxAvgPool(kernel_q, stride_q, padding_q, mode)
+            if kernel_kv:
+                pool_k = _MaxAvgPool(kernel_kv, stride_kv, padding_kv, mode)
+                pool_v = _MaxAvgPool(kernel_kv, stride_kv, padding_kv, mode)
+        elif mode == 'conv':
+            dim_conv = dim_out // num_heads
+            conv = partial(
+                nnx.Conv, use_bias=False, feature_group_count=dim_conv,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            if kernel_q:
+                pool_q = conv(dim_conv, dim_conv, kernel_size=kernel_q, strides=stride_q,
+                              padding=[(padding_q[0], padding_q[0]), (padding_q[1], padding_q[1])])
+                norm_q = norm_layer(dim_conv, rngs=rngs)
+            if kernel_kv:
+                pool_k = conv(dim_conv, dim_conv, kernel_size=kernel_kv, strides=stride_kv,
+                              padding=[(padding_kv[0], padding_kv[0]), (padding_kv[1], padding_kv[1])])
+                norm_k = norm_layer(dim_conv, rngs=rngs)
+                pool_v = conv(dim_conv, dim_conv, kernel_size=kernel_kv, strides=stride_kv,
+                              padding=[(padding_kv[0], padding_kv[0]), (padding_kv[1], padding_kv[1])])
+                norm_v = norm_layer(dim_conv, rngs=rngs)
+        else:
+            raise NotImplementedError(f'Unsupported mode {mode} (pool_first/conv_unshared not used by v2 cfgs)')
+        self.pool_q, self.pool_k, self.pool_v = pool_q, pool_k, pool_v
+        self.norm_q, self.norm_k, self.norm_v = norm_q, norm_k, norm_v
+
+        self.rel_pos_type = rel_pos_type
+        if rel_pos_type == 'spatial':
+            assert feat_size[0] == feat_size[1]
+            size = feat_size[0]
+            q_size = size // stride_q[1] if len(stride_q) > 0 else size
+            kv_size = size // stride_kv[1] if len(stride_kv) > 0 else size
+            rel_sp_dim = 2 * max(q_size, kv_size) - 1
+            self.rel_pos_h = nnx.Param(
+                trunc_normal_tf_(std=0.02)(rngs.params(), (rel_sp_dim, self.head_dim), param_dtype))
+            self.rel_pos_w = nnx.Param(
+                trunc_normal_tf_(std=0.02)(rngs.params(), (rel_sp_dim, self.head_dim), param_dtype))
+        self.residual_pooling = residual_pooling
+
+    def _rel_pos_bias(self, q, q_size, k_size):
+        """Decomposed spatial rel-pos bias (reference cal_rel_pos_type)."""
+        sp = 1 if self.has_cls_token else 0
+        q_h, q_w = q_size
+        k_h, k_w = k_size
+        idx_h = jnp.asarray(_rel_pos_dist_idx(q_h, k_h))
+        idx_w = jnp.asarray(_rel_pos_dist_idx(q_w, k_w))
+        rel_h = self.rel_pos_h[...][idx_h]  # (q_h, k_h, d)
+        rel_w = self.rel_pos_w[...][idx_w]  # (q_w, k_w, d)
+        B, nh, _, d = q.shape
+        r_q = q[:, :, sp:].reshape(B, nh, q_h, q_w, d)
+        bh = jnp.einsum('byhwc,hkc->byhwk', r_q, rel_h.astype(q.dtype))
+        bw = jnp.einsum('byhwc,wkc->byhwk', r_q, rel_w.astype(q.dtype))
+        bias = bh[..., :, None] + bw[..., None, :]  # (B, nh, q_h, q_w, k_h, k_w)
+        bias = bias.reshape(B, nh, q_h * q_w, k_h * k_w)
+        if sp:
+            bias = jnp.pad(bias, ((0, 0), (0, 0), (1, 0), (1, 0)))
+        return bias
+
+    def __call__(self, x, feat_size):
+        B, N, _ = x.shape
+        qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, -1).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+
+        if self.pool_q is not None:
+            q, q_size = _pool_tokens(q, self.pool_q, feat_size, self.num_heads, self.has_cls_token)
+        else:
+            q_size = feat_size
+        if self.norm_q is not None:
+            q = self.norm_q(q)
+        if self.pool_k is not None:
+            k, k_size = _pool_tokens(k, self.pool_k, feat_size, self.num_heads, self.has_cls_token)
+        else:
+            k_size = feat_size
+        if self.norm_k is not None:
+            k = self.norm_k(k)
+        if self.pool_v is not None:
+            v, _ = _pool_tokens(v, self.pool_v, feat_size, self.num_heads, self.has_cls_token)
+        if self.norm_v is not None:
+            v = self.norm_v(v)
+
+        attn = jnp.einsum('bhnd,bhmd->bhnm', q * self.scale, k)
+        if self.rel_pos_type == 'spatial':
+            attn = attn + self._rel_pos_bias(q, q_size, k_size)
+        attn = jax.nn.softmax(attn, axis=-1)
+        x = jnp.einsum('bhnm,bhmd->bhnd', attn, v)
+        if self.residual_pooling:
+            x = x + q
+        x = x.transpose(0, 2, 1, 3).reshape(B, -1, self.dim_out)
+        return self.proj(x), q_size
+
+
+class _MaxAvgPool:
+    """SAME-style torch-padded max/avg pool over NHWC (static shapes)."""
+
+    def __init__(self, kernel, stride, padding, mode):
+        self.kernel = kernel
+        self.stride = stride
+        self.padding = padding
+        self.mode = mode
+
+    def __call__(self, x):
+        pads = ((0, 0), (self.padding[0], self.padding[0]), (self.padding[1], self.padding[1]), (0, 0))
+        if self.mode == 'max':
+            init = -jnp.inf
+            x = jax.lax.reduce_window(
+                jnp.pad(x, pads, constant_values=-jnp.inf), init, jax.lax.max,
+                (1, self.kernel[0], self.kernel[1], 1), (1, self.stride[0], self.stride[1], 1), 'VALID')
+            return x
+        x = jax.lax.reduce_window(
+            jnp.pad(x, pads), 0.0, jax.lax.add,
+            (1, self.kernel[0], self.kernel[1], 1), (1, self.stride[0], self.stride[1], 1), 'VALID')
+        return x / (self.kernel[0] * self.kernel[1])
+
+
+class MultiScaleBlock(nnx.Module):
+    """Pooling-attention block w/ pooled shortcut (reference mvitv2.py:537-639)."""
+
+    def __init__(
+            self, dim, dim_out, num_heads, feat_size, mlp_ratio=4.0, qkv_bias=True,
+            drop_path=0.0, norm_layer: Callable = LayerNorm, kernel_q=(1, 1), kernel_kv=(1, 1),
+            stride_q=(1, 1), stride_kv=(1, 1), mode='conv', has_cls_token=True,
+            expand_attn=False, rel_pos_type='spatial', residual_pooling=True,
+            *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        import math
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        proj_needed = dim != dim_out
+        self.dim = dim
+        self.dim_out = dim_out
+        self.has_cls_token = has_cls_token
+
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_tf_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.shortcut_proj_attn = linear(dim, dim_out) if proj_needed and expand_attn else None
+        if stride_q and math.prod(stride_q) > 1:
+            kernel_skip = tuple(s + 1 if s > 1 else s for s in stride_q)
+            padding_skip = tuple(int(k // 2) for k in kernel_skip)
+            self.shortcut_pool_attn = _MaxAvgPool(kernel_skip, stride_q, padding_skip, 'max')
+        else:
+            self.shortcut_pool_attn = None
+
+        att_dim = dim_out if expand_attn else dim
+        self.attn = MultiScaleAttention(
+            dim, att_dim, num_heads=num_heads, feat_size=feat_size, qkv_bias=qkv_bias,
+            kernel_q=kernel_q, kernel_kv=kernel_kv, stride_q=stride_q, stride_kv=stride_kv,
+            norm_layer=norm_layer, has_cls_token=has_cls_token, mode=mode,
+            rel_pos_type=rel_pos_type, residual_pooling=residual_pooling, **kw)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+
+        self.norm2 = norm_layer(att_dim, rngs=rngs)
+        self.shortcut_proj_mlp = linear(dim, dim_out) if proj_needed and not expand_attn else None
+        self.mlp = Mlp(att_dim, hidden_features=int(att_dim * mlp_ratio), out_features=dim_out, **kw)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def _shortcut_pool(self, x, feat_size):
+        if self.shortcut_pool_attn is None:
+            return x
+        if self.has_cls_token:
+            cls_tok, x = x[:, :1], x[:, 1:]
+        else:
+            cls_tok = None
+        B, L, C = x.shape
+        H, W = feat_size
+        x = self.shortcut_pool_attn(x.reshape(B, H, W, C))
+        x = x.reshape(B, -1, C)
+        if cls_tok is not None:
+            x = jnp.concatenate([cls_tok, x], axis=1)
+        return x
+
+    def __call__(self, x, feat_size):
+        x_norm = self.norm1(x)
+        # reference quirk preserved: shortcut uses UN-normalized input unless projected
+        x_shortcut = x if self.shortcut_proj_attn is None else self.shortcut_proj_attn(x_norm)
+        x_shortcut = self._shortcut_pool(x_shortcut, feat_size)
+        x, feat_size_new = self.attn(x_norm, feat_size)
+        x = x_shortcut + self.drop_path1(x)
+
+        x_norm = self.norm2(x)
+        x_shortcut = x if self.shortcut_proj_mlp is None else self.shortcut_proj_mlp(x_norm)
+        x = x_shortcut + self.drop_path2(self.mlp(x_norm))
+        return x, feat_size_new
+
+
+class MultiScaleVitStage(nnx.Module):
+    def __init__(
+            self, dim, dim_out, depth, num_heads, feat_size, mlp_ratio=4.0, qkv_bias=True,
+            kernel_q=(1, 1), kernel_kv=(1, 1), stride_q=(1, 1), stride_kv=(1, 1),
+            mode='conv', has_cls_token=True, expand_attn=False, rel_pos_type='spatial',
+            residual_pooling=True, norm_layer: Callable = LayerNorm, drop_path=0.0,
+            *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.grad_checkpointing = False
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        if expand_attn:
+            out_dims = (dim_out,) * depth
+        else:
+            out_dims = (dim,) * (depth - 1) + (dim_out,)
+        blocks = []
+        for i in range(depth):
+            blocks.append(MultiScaleBlock(
+                dim=dim, dim_out=out_dims[i], num_heads=num_heads, feat_size=feat_size,
+                mlp_ratio=mlp_ratio, qkv_bias=qkv_bias, kernel_q=kernel_q, kernel_kv=kernel_kv,
+                stride_q=stride_q if i == 0 else (1, 1), stride_kv=stride_kv, mode=mode,
+                has_cls_token=has_cls_token, rel_pos_type=rel_pos_type,
+                residual_pooling=residual_pooling, expand_attn=expand_attn,
+                norm_layer=norm_layer,
+                drop_path=drop_path[i] if isinstance(drop_path, (list, tuple)) else drop_path, **kw))
+            dim = out_dims[i]
+            if i == 0:
+                feat_size = tuple(s // st for s, st in zip(feat_size, stride_q))
+        self.blocks = nnx.List(blocks)
+        self.feat_size = feat_size
+
+    def __call__(self, x, feat_size):
+        if self.grad_checkpointing:
+            remat_block = nnx.remat(lambda blk, x_, fs: blk(x_, fs), static_argnums=(2,))
+            for blk in self.blocks:
+                x, feat_size = remat_block(blk, x, tuple(feat_size))
+        else:
+            for blk in self.blocks:
+                x, feat_size = blk(x, feat_size)
+        return x, feat_size
+
+
+class _Head(nnx.Module):
+    def __init__(self, in_features, num_classes, drop_rate, *, dtype=None,
+                 param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.drop = Dropout(drop_rate, rngs=rngs)
+        self.fc = nnx.Linear(
+            in_features, num_classes, kernel_init=trunc_normal_tf_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    def __call__(self, x):
+        x = self.drop(x)
+        return self.fc(x) if self.fc is not None else x
+
+
+class MultiScaleVit(nnx.Module):
+    """MViTv2 with the reference's model contract (reference mvitv2.py:715-975)."""
+
+    def __init__(
+            self,
+            cfg: MultiScaleVitCfg,
+            img_size: Union[int, Tuple[int, int]] = (224, 224),
+            in_chans: int = 3,
+            global_pool: Optional[str] = None,
+            num_classes: int = 1000,
+            drop_path_rate: float = 0.0,
+            drop_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        img_size = to_2tuple(img_size)
+        norm_layer = partial(LayerNorm, eps=cfg.norm_eps)
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        if global_pool is None:
+            global_pool = 'token' if cfg.use_cls_token else 'avg'
+        self.global_pool = global_pool
+        self.depths = tuple(cfg.depths)
+        self.expand_attn = cfg.expand_attn
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        embed_dim = cfg.embed_dim[0]
+        self.patch_embed = MultiScalePatchEmbed(
+            dim_in=in_chans, dim_out=embed_dim, kernel=cfg.patch_kernel,
+            stride=cfg.patch_stride, padding=cfg.patch_padding, **kw)
+        patch_dims = (img_size[0] // cfg.patch_stride[0], img_size[1] // cfg.patch_stride[1])
+        num_patches = patch_dims[0] * patch_dims[1]
+
+        if cfg.use_cls_token:
+            self.cls_token = nnx.Param(
+                trunc_normal_tf_(std=0.02)(rngs.params(), (1, 1, embed_dim), param_dtype))
+            self.num_prefix_tokens = 1
+            pos_embed_dim = num_patches + 1
+        else:
+            self.num_prefix_tokens = 0
+            self.cls_token = None
+            pos_embed_dim = num_patches
+
+        if cfg.use_abs_pos:
+            self.pos_embed = nnx.Param(
+                trunc_normal_tf_(std=0.02)(rngs.params(), (1, pos_embed_dim, embed_dim), param_dtype))
+        else:
+            self.pos_embed = None
+
+        num_stages = len(cfg.embed_dim)
+        feat_size = patch_dims
+        curr_stride = max(cfg.patch_stride)
+        dpr = calculate_drop_path_rates(drop_path_rate, list(cfg.depths), stagewise=True)
+        stages = []
+        self.feature_info = []
+        for i in range(num_stages):
+            if cfg.expand_attn:
+                dim_out = cfg.embed_dim[i]
+            else:
+                dim_out = cfg.embed_dim[min(i + 1, num_stages - 1)]
+            stage = MultiScaleVitStage(
+                dim=embed_dim, dim_out=dim_out, depth=cfg.depths[i], num_heads=cfg.num_heads[i],
+                feat_size=feat_size, mlp_ratio=cfg.mlp_ratio, qkv_bias=cfg.qkv_bias,
+                mode=cfg.mode, expand_attn=cfg.expand_attn, kernel_q=cfg.kernel_qkv,
+                kernel_kv=cfg.kernel_qkv, stride_q=cfg.stride_q[i], stride_kv=cfg.stride_kv[i],
+                has_cls_token=cfg.use_cls_token, rel_pos_type=cfg.rel_pos_type,
+                residual_pooling=cfg.residual_pooling, norm_layer=norm_layer, drop_path=dpr[i], **kw)
+            curr_stride *= max(cfg.stride_q[i])
+            self.feature_info += [dict(module=f'stages.{i}', num_chs=dim_out, reduction=curr_stride)]
+            embed_dim = dim_out
+            feat_size = stage.feat_size
+            stages.append(stage)
+        self.stages = nnx.List(stages)
+
+        self.num_features = self.head_hidden_size = embed_dim
+        self.norm = norm_layer(embed_dim, rngs=rngs)
+        self.head = _Head(self.num_features, num_classes, drop_rate, **kw)
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self):
+        return {'pos_embed', 'rel_pos_h', 'rel_pos_w', 'cls_token'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^patch_embed',
+            blocks=[(r'^stages\.(\d+)', None), (r'^norm', (99999,))],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        for s in self.stages:
+            s.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = _Head(self.num_features, num_classes, self.drop_rate,
+                          dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs)
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x, feat_size = self.patch_embed(x)
+        B = x.shape[0]
+        if self.cls_token is not None:
+            cls = jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (B, 1, x.shape[-1]))
+            x = jnp.concatenate([cls, x], axis=1)
+        if self.pos_embed is not None:
+            x = x + self.pos_embed[...].astype(x.dtype)
+        for stage in self.stages:
+            x, feat_size = stage(x, feat_size)
+        return self.norm(x) if self.norm is not None else x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        if self.global_pool:
+            if self.global_pool == 'avg':
+                x = x[:, self.num_prefix_tokens:].mean(axis=1)
+            else:
+                x = x[:, 0]
+        if pre_logits:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt in ('NHWC', 'NLC')
+        reshape = output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        x, feat_size = self.patch_embed(x)
+        B = x.shape[0]
+        if self.cls_token is not None:
+            cls = jnp.broadcast_to(self.cls_token[...].astype(x.dtype), (B, 1, x.shape[-1]))
+            x = jnp.concatenate([cls, x], axis=1)
+        if self.pos_embed is not None:
+            x = x + self.pos_embed[...].astype(x.dtype)
+
+        intermediates = []
+        last_idx = len(self.stages) - 1
+        feat_idx = 0
+        for feat_idx, stage in enumerate(self.stages):
+            x, feat_size = stage(x, feat_size)
+            if feat_idx in take_indices:
+                x_inter = self.norm(x) if (norm and self.norm is not None and feat_idx == last_idx) else x
+                if reshape:
+                    if self.cls_token is not None:
+                        x_inter = x_inter[:, 1:]
+                    x_inter = x_inter.reshape(B, feat_size[0], feat_size[1], -1)
+                intermediates.append(x_inter)
+        if intermediates_only:
+            return intermediates
+        if feat_idx == last_idx and self.norm is not None:
+            x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, _ = feature_take_indices(len(self.stages), indices)
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    if 'model_state' in state_dict:
+        state_dict = state_dict['model_state']
+    return convert_torch_state_dict(state_dict, model)
+
+
+model_cfgs = dict(
+    mvitv2_tiny=MultiScaleVitCfg(depths=(1, 2, 5, 2)),
+    mvitv2_small=MultiScaleVitCfg(depths=(1, 2, 11, 2)),
+    mvitv2_base=MultiScaleVitCfg(depths=(2, 3, 16, 3)),
+    mvitv2_large=MultiScaleVitCfg(depths=(2, 6, 36, 4), embed_dim=144, num_heads=2, expand_attn=False),
+    mvitv2_small_cls=MultiScaleVitCfg(depths=(1, 2, 11, 2), use_cls_token=True),
+    mvitv2_base_cls=MultiScaleVitCfg(depths=(2, 3, 16, 3), use_cls_token=True),
+    mvitv2_large_cls=MultiScaleVitCfg(
+        depths=(2, 6, 36, 4), embed_dim=144, num_heads=2, use_cls_token=True, expand_attn=True),
+    mvitv2_huge_cls=MultiScaleVitCfg(
+        depths=(4, 8, 60, 8), embed_dim=192, num_heads=3, use_cls_token=True, expand_attn=True),
+    test_mvitv2=MultiScaleVitCfg(depths=(1, 1, 1), embed_dim=32, num_heads=1,
+                                 stride_q=((1, 1), (2, 2), (2, 2)), patch_stride=(8, 8),
+                                 patch_kernel=(7, 7), patch_padding=(3, 3)),
+)
+
+
+def _create_mvitv2(variant, cfg_variant=None, pretrained=False, **kwargs):
+    out_indices = kwargs.pop('out_indices', 4)
+    return build_model_with_cfg(
+        MultiScaleVit, variant, pretrained,
+        model_cfg=model_cfgs[variant] if not cfg_variant else model_cfgs[cfg_variant],
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices),
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000,
+        'input_size': (3, 224, 224),
+        'pool_size': None,
+        'crop_pct': 0.9,
+        'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406),
+        'std': (0.229, 0.224, 0.225),
+        'first_conv': 'patch_embed.proj',
+        'classifier': 'head.fc',
+        'fixed_input_size': True,
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'mvitv2_tiny.fb_in1k': _cfg(hf_hub_id='timm/'),
+    'mvitv2_small.fb_in1k': _cfg(hf_hub_id='timm/'),
+    'mvitv2_base.fb_in1k': _cfg(hf_hub_id='timm/'),
+    'mvitv2_large.fb_in1k': _cfg(hf_hub_id='timm/'),
+    'mvitv2_small_cls.untrained': _cfg(),
+    'mvitv2_base_cls.fb_inw21k': _cfg(hf_hub_id='timm/', num_classes=19168),
+    'mvitv2_large_cls.fb_inw21k': _cfg(hf_hub_id='timm/', num_classes=19168),
+    'mvitv2_huge_cls.fb_inw21k': _cfg(hf_hub_id='timm/', num_classes=19168),
+    'test_mvitv2.untrained': _cfg(input_size=(3, 96, 96)),
+})
+
+
+@register_model
+def mvitv2_tiny(pretrained=False, **kwargs) -> MultiScaleVit:
+    return _create_mvitv2('mvitv2_tiny', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mvitv2_small(pretrained=False, **kwargs) -> MultiScaleVit:
+    return _create_mvitv2('mvitv2_small', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mvitv2_base(pretrained=False, **kwargs) -> MultiScaleVit:
+    return _create_mvitv2('mvitv2_base', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mvitv2_large(pretrained=False, **kwargs) -> MultiScaleVit:
+    return _create_mvitv2('mvitv2_large', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mvitv2_small_cls(pretrained=False, **kwargs) -> MultiScaleVit:
+    return _create_mvitv2('mvitv2_small_cls', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mvitv2_base_cls(pretrained=False, **kwargs) -> MultiScaleVit:
+    return _create_mvitv2('mvitv2_base_cls', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mvitv2_large_cls(pretrained=False, **kwargs) -> MultiScaleVit:
+    return _create_mvitv2('mvitv2_large_cls', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mvitv2_huge_cls(pretrained=False, **kwargs) -> MultiScaleVit:
+    return _create_mvitv2('mvitv2_huge_cls', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def test_mvitv2(pretrained=False, **kwargs) -> MultiScaleVit:
+    return _create_mvitv2('test_mvitv2', pretrained=pretrained, **kwargs)

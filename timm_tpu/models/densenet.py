@@ -182,6 +182,7 @@ default_cfgs = generate_default_cfgs({
     'densenet121.ra_in1k': _cfg(hf_hub_id='timm/'),
     'densenet169.tv_in1k': _cfg(hf_hub_id='timm/'),
     'densenet201.tv_in1k': _cfg(hf_hub_id='timm/'),
+    'densenet161.tv_in1k': _cfg(hf_hub_id='timm/'),
 })
 
 
@@ -225,6 +226,12 @@ def densenet121(pretrained=False, **kwargs) -> DenseNet:
 def densenet169(pretrained=False, **kwargs) -> DenseNet:
     model_args = dict(growth_rate=32, block_config=(6, 12, 32, 32))
     return _create_densenet('densenet169', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def densenet161(pretrained=False, **kwargs) -> DenseNet:
+    model_args = dict(growth_rate=48, block_config=(6, 12, 36, 24))
+    return _create_densenet('densenet161', pretrained, **dict(model_args, **kwargs))
 
 
 @register_model

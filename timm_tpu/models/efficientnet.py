@@ -175,8 +175,23 @@ class EfficientNet(nnx.Module):
         return take_indices
 
 
+def _create_effnet(variant, pretrained=False, **kwargs):
+    """Common builder: resolves tf-origin BN overrides (bn_eps/bn_momentum via
+    resolve_bn_args) into the norm layer (reference _create_effnet +
+    tf entrypoints' kwargs.setdefault('bn_eps', 1e-3))."""
+    bn_args = resolve_bn_args(kwargs)
+    if bn_args:
+        kwargs['norm_layer'] = partial(BatchNormAct2d, **bn_args)
+    return build_model_with_cfg(
+        EfficientNet, variant, pretrained,
+        pretrained_filter_fn=_filter_fn,
+        feature_cfg=dict(out_indices=(1, 2, 3, 4, 5)),
+        **kwargs,
+    )
+
+
 def _gen_efficientnet(variant, channel_multiplier=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
-    """EfficientNet B0-B7 generator (reference efficientnet.py _gen_efficientnet)."""
+    """EfficientNet B0-B8/L2 generator (reference efficientnet.py:718-766)."""
     arch_def = [
         ['ds_r1_k3_s1_e1_c16_se0.25'],
         ['ir_r2_k3_s2_e6_c24_se0.25'],
@@ -195,16 +210,78 @@ def _gen_efficientnet(variant, channel_multiplier=1.0, depth_multiplier=1.0, pre
         act_layer=resolve_act_layer(kwargs, 'silu'),
         **kwargs,
     )
-    return build_model_with_cfg(
-        EfficientNet, variant, pretrained,
-        pretrained_filter_fn=_filter_fn,
-        feature_cfg=dict(out_indices=(1, 2, 3, 4, 5)),
-        **model_kwargs,
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_efficientnet_edge(variant, channel_multiplier=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
+    """EfficientNet-EdgeTPU es/em/el (reference efficientnet.py:768-798)."""
+    arch_def = [
+        ['er_r1_k3_s1_e4_c24_fc24_noskip'],
+        ['er_r2_k3_s2_e8_c32'],
+        ['er_r4_k3_s2_e8_c48'],
+        ['ir_r5_k5_s2_e8_c96'],
+        ['ir_r4_k5_s1_e8_c144'],
+        ['ir_r2_k5_s2_e8_c192'],
+    ]
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier)
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, depth_multiplier),
+        num_features=round_chs_fn(1280),
+        stem_size=32,
+        round_chs_fn=round_chs_fn,
+        act_layer=resolve_act_layer(kwargs, 'relu'),
+        **kwargs,
     )
+    return _create_effnet(variant, pretrained, **model_kwargs)
 
 
-def _gen_efficientnetv2_s(variant, channel_multiplier=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
-    """EfficientNet-V2 small (reference efficientnet.py _gen_efficientnetv2_s)."""
+def _gen_efficientnet_lite(variant, channel_multiplier=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
+    """EfficientNet-Lite (reference efficientnet.py:832-871)."""
+    arch_def = [
+        ['ds_r1_k3_s1_e1_c16'],
+        ['ir_r2_k3_s2_e6_c24'],
+        ['ir_r2_k5_s2_e6_c40'],
+        ['ir_r3_k3_s2_e6_c80'],
+        ['ir_r3_k5_s1_e6_c112'],
+        ['ir_r4_k5_s2_e6_c192'],
+        ['ir_r1_k3_s1_e6_c320'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, depth_multiplier, fix_first_last=True),
+        num_features=1280,
+        stem_size=32,
+        fix_stem=True,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        act_layer=resolve_act_layer(kwargs, 'relu6'),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_efficientnetv2_base(variant, channel_multiplier=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
+    """EfficientNet-V2 base/b0-b3 (reference efficientnet.py:873-901)."""
+    arch_def = [
+        ['cn_r1_k3_s1_e1_c16_skip'],
+        ['er_r2_k3_s2_e4_c32'],
+        ['er_r2_k3_s2_e4_c48'],
+        ['ir_r3_k3_s2_e4_c96_se0.25'],
+        ['ir_r5_k3_s1_e6_c112_se0.25'],
+        ['ir_r8_k3_s2_e6_c192_se0.25'],
+    ]
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier, round_limit=0.0)
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, depth_multiplier),
+        num_features=round_chs_fn(1280),
+        stem_size=32,
+        round_chs_fn=round_chs_fn,
+        act_layer=resolve_act_layer(kwargs, 'silu'),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_efficientnetv2_s(variant, channel_multiplier=1.0, depth_multiplier=1.0, rw=False, pretrained=False, **kwargs):
+    """EfficientNet-V2 small (reference efficientnet.py:903-941)."""
     arch_def = [
         ['cn_r2_k3_s1_e1_c24_skip'],
         ['er_r4_k3_s2_e4_c48'],
@@ -213,24 +290,26 @@ def _gen_efficientnetv2_s(variant, channel_multiplier=1.0, depth_multiplier=1.0,
         ['ir_r9_k3_s1_e6_c160_se0.25'],
         ['ir_r15_k3_s2_e6_c256_se0.25'],
     ]
+    num_features = 1280
+    if rw:
+        # timm's pre-release v2 small variant
+        arch_def[0] = ['er_r2_k3_s1_e1_c24']
+        arch_def[-1] = ['ir_r15_k3_s2_e6_c272_se0.25']
+        num_features = 1792
     round_chs_fn = partial(round_channels, multiplier=channel_multiplier)
     model_kwargs = dict(
         block_args=decode_arch_def(arch_def, depth_multiplier),
-        num_features=round_chs_fn(1280),
+        num_features=round_chs_fn(num_features),
         stem_size=24,
         round_chs_fn=round_chs_fn,
         act_layer=resolve_act_layer(kwargs, 'silu'),
         **kwargs,
     )
-    return build_model_with_cfg(
-        EfficientNet, variant, pretrained,
-        pretrained_filter_fn=_filter_fn,
-        feature_cfg=dict(out_indices=(1, 2, 3, 4, 5)),
-        **model_kwargs,
-    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
 
 
 def _gen_efficientnetv2_m(variant, pretrained=False, **kwargs):
+    """EfficientNet-V2 medium (reference efficientnet.py:943-973)."""
     arch_def = [
         ['cn_r3_k3_s1_e1_c24_skip'],
         ['er_r5_k3_s2_e4_c48'],
@@ -247,12 +326,195 @@ def _gen_efficientnetv2_m(variant, pretrained=False, **kwargs):
         act_layer=resolve_act_layer(kwargs, 'silu'),
         **kwargs,
     )
-    return build_model_with_cfg(
-        EfficientNet, variant, pretrained,
-        pretrained_filter_fn=_filter_fn,
-        feature_cfg=dict(out_indices=(1, 2, 3, 4, 5)),
-        **model_kwargs,
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_efficientnetv2_l(variant, pretrained=False, **kwargs):
+    """EfficientNet-V2 large (reference efficientnet.py:975-1005)."""
+    arch_def = [
+        ['cn_r4_k3_s1_e1_c32_skip'],
+        ['er_r7_k3_s2_e4_c64'],
+        ['er_r7_k3_s2_e4_c96'],
+        ['ir_r10_k3_s2_e4_c192_se0.25'],
+        ['ir_r19_k3_s1_e6_c224_se0.25'],
+        ['ir_r25_k3_s2_e6_c384_se0.25'],
+        ['ir_r7_k3_s1_e6_c640_se0.25'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        num_features=1280,
+        stem_size=32,
+        act_layer=resolve_act_layer(kwargs, 'silu'),
+        **kwargs,
     )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_efficientnetv2_xl(variant, pretrained=False, **kwargs):
+    """EfficientNet-V2 xlarge (reference efficientnet.py:1007-1037)."""
+    arch_def = [
+        ['cn_r4_k3_s1_e1_c32_skip'],
+        ['er_r8_k3_s2_e4_c64'],
+        ['er_r8_k3_s2_e4_c96'],
+        ['ir_r16_k3_s2_e4_c192_se0.25'],
+        ['ir_r24_k3_s1_e6_c256_se0.25'],
+        ['ir_r32_k3_s2_e6_c512_se0.25'],
+        ['ir_r8_k3_s1_e6_c640_se0.25'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        num_features=1280,
+        stem_size=32,
+        act_layer=resolve_act_layer(kwargs, 'silu'),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_mnasnet_a1(variant, channel_multiplier=1.0, pretrained=False, **kwargs):
+    """MNASNet-A1 (w/ SE) a.k.a. semnasnet (reference efficientnet.py:479-513)."""
+    arch_def = [
+        ['ds_r1_k3_s1_e1_c16_noskip'],
+        ['ir_r2_k3_s2_e6_c24'],
+        ['ir_r3_k5_s2_e3_c40_se0.25'],
+        ['ir_r4_k3_s2_e6_c80'],
+        ['ir_r2_k3_s1_e6_c112_se0.25'],
+        ['ir_r3_k5_s2_e6_c160_se0.25'],
+        ['ir_r1_k3_s1_e6_c320'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        stem_size=32,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_mnasnet_b1(variant, channel_multiplier=1.0, pretrained=False, **kwargs):
+    """MNASNet-B1 (reference efficientnet.py:515-549)."""
+    arch_def = [
+        ['ds_r1_k3_s1_c16_noskip'],
+        ['ir_r3_k3_s2_e3_c24'],
+        ['ir_r3_k5_s2_e3_c40'],
+        ['ir_r3_k5_s2_e6_c80'],
+        ['ir_r2_k3_s1_e6_c96'],
+        ['ir_r4_k5_s2_e6_c192'],
+        ['ir_r1_k3_s1_e6_c320_noskip'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        stem_size=32,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_mnasnet_small(variant, channel_multiplier=1.0, pretrained=False, **kwargs):
+    """MNASNet small (reference efficientnet.py:551-578)."""
+    arch_def = [
+        ['ds_r1_k3_s1_c8'],
+        ['ir_r1_k3_s2_e3_c16'],
+        ['ir_r2_k3_s2_e6_c16'],
+        ['ir_r4_k5_s2_e6_c32_se0.25'],
+        ['ir_r3_k3_s1_e6_c32_se0.25'],
+        ['ir_r3_k5_s2_e6_c88_se0.25'],
+        ['ir_r1_k3_s1_e6_c144'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        stem_size=8,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_mobilenet_v2(variant, channel_multiplier=1.0, depth_multiplier=1.0, fix_stem_head=False,
+                      pretrained=False, **kwargs):
+    """MobileNet-V2 (reference efficientnet.py:616-651)."""
+    arch_def = [
+        ['ds_r1_k3_s1_c16'],
+        ['ir_r2_k3_s2_e6_c24'],
+        ['ir_r3_k3_s2_e6_c32'],
+        ['ir_r4_k3_s2_e6_c64'],
+        ['ir_r3_k3_s1_e6_c96'],
+        ['ir_r3_k3_s2_e6_c160'],
+        ['ir_r1_k3_s1_e6_c320'],
+    ]
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier)
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, depth_multiplier=depth_multiplier, fix_first_last=fix_stem_head),
+        num_features=1280 if fix_stem_head else max(1280, round_chs_fn(1280)),
+        stem_size=32,
+        fix_stem=fix_stem_head,
+        round_chs_fn=round_chs_fn,
+        act_layer=resolve_act_layer(kwargs, 'relu6'),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_fbnetc(variant, channel_multiplier=1.0, pretrained=False, **kwargs):
+    """FBNet-C (reference efficientnet.py:653-681)."""
+    arch_def = [
+        ['ir_r1_k3_s1_e1_c16'],
+        ['ir_r1_k3_s2_e6_c24', 'ir_r2_k3_s1_e1_c24'],
+        ['ir_r1_k5_s2_e6_c32', 'ir_r1_k5_s1_e3_c32', 'ir_r1_k5_s1_e6_c32', 'ir_r1_k3_s1_e6_c32'],
+        ['ir_r1_k5_s2_e6_c64', 'ir_r1_k5_s1_e3_c64', 'ir_r2_k5_s1_e6_c64'],
+        ['ir_r3_k5_s1_e6_c112', 'ir_r1_k5_s1_e3_c112'],
+        ['ir_r4_k5_s2_e6_c184'],
+        ['ir_r1_k3_s1_e6_c352'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        stem_size=16,
+        num_features=1984,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_spnasnet(variant, channel_multiplier=1.0, pretrained=False, **kwargs):
+    """Single-Path NAS (reference efficientnet.py:683-716)."""
+    arch_def = [
+        ['ds_r1_k3_s1_c16_noskip'],
+        ['ir_r3_k3_s2_e3_c24'],
+        ['ir_r1_k5_s2_e6_c40', 'ir_r3_k3_s1_e3_c40'],
+        ['ir_r1_k5_s2_e6_c80', 'ir_r3_k3_s1_e3_c80'],
+        ['ir_r1_k5_s1_e6_c96', 'ir_r3_k5_s1_e3_c96'],
+        ['ir_r4_k5_s2_e6_c192'],
+        ['ir_r1_k3_s1_e6_c320_noskip'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        stem_size=32,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_tinynet(variant, model_width=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
+    """TinyNet (reference efficientnet.py:1188-1209)."""
+    arch_def = [
+        ['ds_r1_k3_s1_e1_c16_se0.25'], ['ir_r2_k3_s2_e6_c24_se0.25'],
+        ['ir_r2_k5_s2_e6_c40_se0.25'], ['ir_r3_k3_s2_e6_c80_se0.25'],
+        ['ir_r3_k5_s1_e6_c112_se0.25'], ['ir_r4_k5_s2_e6_c192_se0.25'],
+        ['ir_r1_k3_s1_e6_c320_se0.25'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, depth_multiplier, depth_trunc='round'),
+        num_features=max(1280, round_channels(1280, model_width, 8, None)),
+        stem_size=32,
+        fix_stem=True,
+        round_chs_fn=partial(round_channels, multiplier=model_width),
+        act_layer=resolve_act_layer(kwargs, 'swish'),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
 
 
 def _filter_fn(state_dict, model):
@@ -284,39 +546,210 @@ def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
     }
 
 
+# (channel_multiplier, depth_multiplier, train res, crop_pct) per B-variant —
+# reference efficientnet.py compound-scaling table
+_B_PARAMS = {
+    'b0': (1.0, 1.0, 224, 0.875), 'b1': (1.0, 1.1, 240, 0.882),
+    'b2': (1.1, 1.2, 260, 0.89), 'b3': (1.2, 1.4, 300, 0.904),
+    'b4': (1.4, 1.8, 380, 0.922), 'b5': (1.6, 2.2, 456, 0.934),
+    'b6': (1.8, 2.6, 528, 0.942), 'b7': (2.0, 3.1, 600, 0.949),
+    'b8': (2.2, 3.6, 672, 0.954), 'l2': (4.3, 5.3, 800, 0.961),
+}
+_LITE_PARAMS = {
+    'lite0': (1.0, 1.0, 224, 0.875), 'lite1': (1.0, 1.1, 240, 0.882),
+    'lite2': (1.1, 1.2, 260, 0.89), 'lite3': (1.2, 1.4, 280, 0.904),
+    'lite4': (1.4, 1.8, 300, 0.92),
+}
+_TF_STATS = dict(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))
+
+
+def _res_cfg(res, crop, **kwargs):
+    return _cfg(input_size=(3, res, res), pool_size=(res // 32, res // 32), crop_pct=crop, **kwargs)
+
+
 default_cfgs = generate_default_cfgs({
     'efficientnet_b0.ra_in1k': _cfg(hf_hub_id='timm/'),
-    'efficientnet_b1.ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), crop_pct=0.882),
-    'efficientnet_b2.ra_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.89),
-    'efficientnet_b3.ra2_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 288, 288), crop_pct=0.904),
-    'efficientnetv2_s.in1k': _cfg(
-        hf_hub_id='timm/', input_size=(3, 300, 300), test_input_size=(3, 384, 384), crop_pct=1.0),
-    'efficientnetv2_m.untrained': _cfg(input_size=(3, 320, 320), test_input_size=(3, 416, 416), crop_pct=1.0),
-    'tf_efficientnetv2_s.in1k': _cfg(
-        hf_hub_id='timm/', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5),
-        input_size=(3, 300, 300), test_input_size=(3, 384, 384), crop_pct=1.0),
+    'efficientnet_b1.ft_in1k': _res_cfg(240, 0.882, hf_hub_id='timm/'),
+    'efficientnet_b2.ra_in1k': _res_cfg(256, 0.89, hf_hub_id='timm/'),
+    'efficientnet_b3.ra2_in1k': _res_cfg(288, 0.904, hf_hub_id='timm/'),
+    'efficientnet_b4.ra2_in1k': _res_cfg(320, 0.922, hf_hub_id='timm/'),
+    'efficientnet_b5.sw_in12k_ft_in1k': _res_cfg(448, 1.0, hf_hub_id='timm/', crop_mode='squash'),
+    'efficientnet_b6.untrained': _res_cfg(528, 0.942),
+    'efficientnet_b7.untrained': _res_cfg(600, 0.949),
+    'efficientnet_b8.untrained': _res_cfg(672, 0.954),
+    'efficientnet_l2.untrained': _res_cfg(800, 0.961),
+    **{f'tf_efficientnet_{v}.in1k': _res_cfg(r, c, hf_hub_id='timm/', **_TF_STATS)
+       for v, (_, _, r, c) in _B_PARAMS.items() if v in ('b0', 'b1', 'b2', 'b3', 'b4', 'b5')},
+    'tf_efficientnet_b6.aa_in1k': _res_cfg(528, 0.942, hf_hub_id='timm/', **_TF_STATS),
+    'tf_efficientnet_b7.ra_in1k': _res_cfg(600, 0.949, hf_hub_id='timm/', **_TF_STATS),
+    'tf_efficientnet_b8.ra_in1k': _res_cfg(672, 0.954, hf_hub_id='timm/', **_TF_STATS),
+    'tf_efficientnet_l2.ns_jft_in1k': _res_cfg(800, 0.96, hf_hub_id='timm/', **_TF_STATS),
+
+    'efficientnet_es.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'efficientnet_em.ra2_in1k': _res_cfg(240, 0.882, hf_hub_id='timm/'),
+    'efficientnet_el.ra_in1k': _res_cfg(300, 0.904, hf_hub_id='timm/'),
+    'tf_efficientnet_es.in1k': _cfg(hf_hub_id='timm/', **_TF_STATS),
+    'tf_efficientnet_em.in1k': _res_cfg(240, 0.882, hf_hub_id='timm/', **_TF_STATS),
+    'tf_efficientnet_el.in1k': _res_cfg(300, 0.904, hf_hub_id='timm/', **_TF_STATS),
+
+    'efficientnet_lite0.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'efficientnet_lite1.untrained': _res_cfg(240, 0.882),
+    'efficientnet_lite2.untrained': _res_cfg(260, 0.89),
+    'efficientnet_lite3.untrained': _res_cfg(280, 0.904),
+    'efficientnet_lite4.untrained': _res_cfg(300, 0.92),
+    **{f'tf_efficientnet_{v}.in1k': _res_cfg(r, c, hf_hub_id='timm/', **_TF_STATS)
+       for v, (_, _, r, c) in _LITE_PARAMS.items()},
+
+    'efficientnetv2_rw_t.ra2_in1k': _res_cfg(224, 1.0, hf_hub_id='timm/', test_input_size=(3, 288, 288)),
+    'efficientnetv2_rw_s.ra2_in1k': _res_cfg(288, 1.0, hf_hub_id='timm/', test_input_size=(3, 384, 384)),
+    'efficientnetv2_rw_m.agc_in1k': _res_cfg(320, 1.0, hf_hub_id='timm/', test_input_size=(3, 416, 416)),
+    'efficientnetv2_s.in1k': _res_cfg(300, 1.0, hf_hub_id='timm/', test_input_size=(3, 384, 384)),
+    'efficientnetv2_m.untrained': _res_cfg(320, 1.0, test_input_size=(3, 416, 416)),
+    'efficientnetv2_l.untrained': _res_cfg(384, 1.0, test_input_size=(3, 480, 480)),
+    'efficientnetv2_xl.untrained': _res_cfg(384, 1.0, test_input_size=(3, 512, 512)),
+    'efficientnetv2_b0.untrained': _cfg(),
+    'efficientnetv2_b1.untrained': _res_cfg(240, 0.882),
+    'efficientnetv2_b2.untrained': _res_cfg(260, 0.89),
+    'efficientnetv2_b3.untrained': _res_cfg(288, 0.904),
+    'tf_efficientnetv2_s.in1k': _res_cfg(300, 1.0, hf_hub_id='timm/', test_input_size=(3, 384, 384), **_TF_STATS),
+    'tf_efficientnetv2_m.in21k_ft_in1k': _res_cfg(
+        384, 1.0, hf_hub_id='timm/', test_input_size=(3, 480, 480), **_TF_STATS),
+    'tf_efficientnetv2_l.in21k_ft_in1k': _res_cfg(
+        384, 1.0, hf_hub_id='timm/', test_input_size=(3, 480, 480), **_TF_STATS),
+    'tf_efficientnetv2_xl.in21k_ft_in1k': _res_cfg(
+        384, 1.0, hf_hub_id='timm/', test_input_size=(3, 512, 512), **_TF_STATS),
+    'tf_efficientnetv2_b0.in1k': _res_cfg(192, 0.875, hf_hub_id='timm/', test_input_size=(3, 224, 224), **_TF_STATS),
+    'tf_efficientnetv2_b1.in1k': _res_cfg(192, 0.882, hf_hub_id='timm/', test_input_size=(3, 240, 240), **_TF_STATS),
+    'tf_efficientnetv2_b2.in1k': _res_cfg(208, 0.89, hf_hub_id='timm/', test_input_size=(3, 260, 260), **_TF_STATS),
+    'tf_efficientnetv2_b3.in1k': _res_cfg(240, 0.904, hf_hub_id='timm/', test_input_size=(3, 300, 300), **_TF_STATS),
+
+    'mnasnet_050.untrained': _cfg(),
+    'mnasnet_075.untrained': _cfg(),
+    'mnasnet_100.rmsp_in1k': _cfg(hf_hub_id='timm/'),
+    'mnasnet_140.untrained': _cfg(),
+    'semnasnet_050.untrained': _cfg(),
+    'semnasnet_075.rmsp_in1k': _cfg(hf_hub_id='timm/'),
+    'semnasnet_100.rmsp_in1k': _cfg(hf_hub_id='timm/'),
+    'semnasnet_140.untrained': _cfg(),
+    'mnasnet_small.lamb_in1k': _cfg(hf_hub_id='timm/'),
+    'mobilenetv2_035.untrained': _cfg(),
+    'mobilenetv2_050.lamb_in1k': _cfg(hf_hub_id='timm/'),
+    'mobilenetv2_075.untrained': _cfg(),
+    'mobilenetv2_100.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'mobilenetv2_110d.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'mobilenetv2_120d.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'mobilenetv2_140.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'fbnetc_100.rmsp_in1k': _cfg(hf_hub_id='timm/'),
+    'spnasnet_100.rmsp_in1k': _cfg(hf_hub_id='timm/'),
+    'tinynet_a.in1k': _res_cfg(192, 0.875, hf_hub_id='timm/'),
+    'tinynet_b.in1k': _res_cfg(188, 0.875, hf_hub_id='timm/'),
+    'tinynet_c.in1k': _res_cfg(184, 0.875, hf_hub_id='timm/'),
+    'tinynet_d.in1k': _res_cfg(152, 0.875, hf_hub_id='timm/'),
+    'tinynet_e.in1k': _res_cfg(106, 0.875, hf_hub_id='timm/'),
     'test_efficientnet.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
 })
 
 
-@register_model
-def efficientnet_b0(pretrained=False, **kwargs) -> EfficientNet:
-    return _gen_efficientnet('efficientnet_b0', 1.0, 1.0, pretrained, **kwargs)
+def _register_effnet_b(name: str):
+    cm, dm, _, _ = _B_PARAMS[name]
+
+    def base(pretrained=False, **kwargs):
+        return _gen_efficientnet(f'efficientnet_{name}', cm, dm, pretrained, **kwargs)
+
+    def tf(pretrained=False, **kwargs):
+        kwargs.setdefault('bn_eps', 1e-3)
+        kwargs.setdefault('pad_type', 'same')
+        return _gen_efficientnet(f'tf_efficientnet_{name}', cm, dm, pretrained, **kwargs)
+
+    base.__name__ = f'efficientnet_{name}'
+    base.__doc__ = f'EfficientNet-{name.upper()} (reference efficientnet.py entrypoints)'
+    tf.__name__ = f'tf_efficientnet_{name}'
+    tf.__doc__ = f'EfficientNet-{name.upper()}, TF-origin weights (SAME padding, bn_eps=1e-3)'
+    register_model(base)
+    register_model(tf)
+
+
+for _b in _B_PARAMS:
+    _register_effnet_b(_b)
+
+
+def _register_effnet_lite(name: str):
+    cm, dm, _, _ = _LITE_PARAMS[name]
+
+    def base(pretrained=False, **kwargs):
+        return _gen_efficientnet_lite(f'efficientnet_{name}', cm, dm, pretrained, **kwargs)
+
+    def tf(pretrained=False, **kwargs):
+        kwargs.setdefault('bn_eps', 1e-3)
+        kwargs.setdefault('pad_type', 'same')
+        return _gen_efficientnet_lite(f'tf_efficientnet_{name}', cm, dm, pretrained, **kwargs)
+
+    base.__name__ = f'efficientnet_{name}'
+    base.__doc__ = f'EfficientNet-{name} (reference efficientnet.py entrypoints)'
+    tf.__name__ = f'tf_efficientnet_{name}'
+    tf.__doc__ = f'EfficientNet-{name}, TF-origin weights (SAME padding, bn_eps=1e-3)'
+    register_model(base)
+    register_model(tf)
+
+
+for _l in _LITE_PARAMS:
+    _register_effnet_lite(_l)
 
 
 @register_model
-def efficientnet_b1(pretrained=False, **kwargs) -> EfficientNet:
-    return _gen_efficientnet('efficientnet_b1', 1.0, 1.1, pretrained, **kwargs)
+def efficientnet_es(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnet_edge('efficientnet_es', 1.0, 1.0, pretrained, **kwargs)
 
 
 @register_model
-def efficientnet_b2(pretrained=False, **kwargs) -> EfficientNet:
-    return _gen_efficientnet('efficientnet_b2', 1.1, 1.2, pretrained, **kwargs)
+def efficientnet_em(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnet_edge('efficientnet_em', 1.0, 1.1, pretrained, **kwargs)
 
 
 @register_model
-def efficientnet_b3(pretrained=False, **kwargs) -> EfficientNet:
-    return _gen_efficientnet('efficientnet_b3', 1.2, 1.4, pretrained, **kwargs)
+def efficientnet_el(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnet_edge('efficientnet_el', 1.2, 1.4, pretrained, **kwargs)
+
+
+@register_model
+def tf_efficientnet_es(pretrained=False, **kwargs) -> EfficientNet:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_efficientnet_edge('tf_efficientnet_es', 1.0, 1.0, pretrained, **kwargs)
+
+
+@register_model
+def tf_efficientnet_em(pretrained=False, **kwargs) -> EfficientNet:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_efficientnet_edge('tf_efficientnet_em', 1.0, 1.1, pretrained, **kwargs)
+
+
+@register_model
+def tf_efficientnet_el(pretrained=False, **kwargs) -> EfficientNet:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_efficientnet_edge('tf_efficientnet_el', 1.2, 1.4, pretrained, **kwargs)
+
+
+@register_model
+def efficientnetv2_rw_t(pretrained=False, **kwargs) -> EfficientNet:
+    """V2 Tiny: a 0.8/0.9-scaled v2-S (reference efficientnet.py:2367)."""
+    return _gen_efficientnetv2_s(
+        'efficientnetv2_rw_t', channel_multiplier=0.8, depth_multiplier=0.9, rw=False,
+        pretrained=pretrained, **kwargs)
+
+
+@register_model
+def efficientnetv2_rw_s(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnetv2_s('efficientnetv2_rw_s', rw=True, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def efficientnetv2_rw_m(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnetv2_s(
+        'efficientnetv2_rw_m', channel_multiplier=1.2, depth_multiplier=(1.2,) * 4 + (1.6,) * 2,
+        rw=True, pretrained=pretrained, **kwargs)
 
 
 @register_model
@@ -330,9 +763,206 @@ def efficientnetv2_m(pretrained=False, **kwargs) -> EfficientNet:
 
 
 @register_model
+def efficientnetv2_l(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnetv2_l('efficientnetv2_l', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def efficientnetv2_xl(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnetv2_xl('efficientnetv2_xl', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def efficientnetv2_b0(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnetv2_base('efficientnetv2_b0', 1.0, 1.0, pretrained, **kwargs)
+
+
+@register_model
+def efficientnetv2_b1(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnetv2_base('efficientnetv2_b1', 1.0, 1.1, pretrained, **kwargs)
+
+
+@register_model
+def efficientnetv2_b2(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnetv2_base('efficientnetv2_b2', 1.1, 1.2, pretrained, **kwargs)
+
+
+@register_model
+def efficientnetv2_b3(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_efficientnetv2_base('efficientnetv2_b3', 1.2, 1.4, pretrained, **kwargs)
+
+
+@register_model
 def tf_efficientnetv2_s(pretrained=False, **kwargs) -> EfficientNet:
-    """TF-origin weights variant; same arch, SAME padding is already native."""
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
     return _gen_efficientnetv2_s('tf_efficientnetv2_s', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def tf_efficientnetv2_m(pretrained=False, **kwargs) -> EfficientNet:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_efficientnetv2_m('tf_efficientnetv2_m', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def tf_efficientnetv2_l(pretrained=False, **kwargs) -> EfficientNet:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_efficientnetv2_l('tf_efficientnetv2_l', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def tf_efficientnetv2_xl(pretrained=False, **kwargs) -> EfficientNet:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_efficientnetv2_xl('tf_efficientnetv2_xl', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def tf_efficientnetv2_b0(pretrained=False, **kwargs) -> EfficientNet:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_efficientnetv2_base('tf_efficientnetv2_b0', 1.0, 1.0, pretrained, **kwargs)
+
+
+@register_model
+def tf_efficientnetv2_b1(pretrained=False, **kwargs) -> EfficientNet:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_efficientnetv2_base('tf_efficientnetv2_b1', 1.0, 1.1, pretrained, **kwargs)
+
+
+@register_model
+def tf_efficientnetv2_b2(pretrained=False, **kwargs) -> EfficientNet:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_efficientnetv2_base('tf_efficientnetv2_b2', 1.1, 1.2, pretrained, **kwargs)
+
+
+@register_model
+def tf_efficientnetv2_b3(pretrained=False, **kwargs) -> EfficientNet:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_efficientnetv2_base('tf_efficientnetv2_b3', 1.2, 1.4, pretrained, **kwargs)
+
+
+@register_model
+def mnasnet_050(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mnasnet_b1('mnasnet_050', 0.5, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mnasnet_075(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mnasnet_b1('mnasnet_075', 0.75, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mnasnet_100(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mnasnet_b1('mnasnet_100', 1.0, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mnasnet_140(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mnasnet_b1('mnasnet_140', 1.4, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def semnasnet_050(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mnasnet_a1('semnasnet_050', 0.5, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def semnasnet_075(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mnasnet_a1('semnasnet_075', 0.75, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def semnasnet_100(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mnasnet_a1('semnasnet_100', 1.0, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def semnasnet_140(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mnasnet_a1('semnasnet_140', 1.4, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mnasnet_small(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mnasnet_small('mnasnet_small', 1.0, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilenetv2_035(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mobilenet_v2('mobilenetv2_035', 0.35, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilenetv2_050(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mobilenet_v2('mobilenetv2_050', 0.5, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilenetv2_075(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mobilenet_v2('mobilenetv2_075', 0.75, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilenetv2_100(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mobilenet_v2('mobilenetv2_100', 1.0, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilenetv2_110d(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mobilenet_v2(
+        'mobilenetv2_110d', 1.1, depth_multiplier=1.2, fix_stem_head=True, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilenetv2_120d(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mobilenet_v2(
+        'mobilenetv2_120d', 1.2, depth_multiplier=1.4, fix_stem_head=True, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilenetv2_140(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_mobilenet_v2('mobilenetv2_140', 1.4, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def fbnetc_100(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_fbnetc('fbnetc_100', 1.0, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def spnasnet_100(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_spnasnet('spnasnet_100', 1.0, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def tinynet_a(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_tinynet('tinynet_a', 1.0, 1.2, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def tinynet_b(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_tinynet('tinynet_b', 0.75, 1.1, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def tinynet_c(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_tinynet('tinynet_c', 0.54, 0.85, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def tinynet_d(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_tinynet('tinynet_d', 0.54, 0.695, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def tinynet_e(pretrained=False, **kwargs) -> EfficientNet:
+    return _gen_tinynet('tinynet_e', 0.51, 0.6, pretrained=pretrained, **kwargs)
 
 
 @register_model

@@ -179,28 +179,65 @@ class MobileNetV3(nnx.Module):
         return take_indices
 
 
+def _create_mnv3(variant, pretrained=False, arch_def=None, **model_kwargs):
+    from .efficientnet import checkpoint_filter_fn as _eff_filter
+    return build_model_with_cfg(
+        MobileNetV3, variant, pretrained,
+        pretrained_filter_fn=_eff_filter,
+        feature_cfg=dict(out_indices=tuple(range(len(arch_def)))),
+        **model_kwargs,
+    )
+
+
 def _gen_mobilenet_v3(variant: str, channel_multiplier: float = 1.0, pretrained: bool = False, **kwargs):
+    """MobileNet-V3 large/small (+ 'minimal' SE/hswish-free twins)
+    (reference mobilenetv3.py:557-666)."""
     if 'small' in variant:
         num_features = 1024
-        arch_def = [
-            ['ds_r1_k3_s2_e1_c16_se0.25_nre'],
-            ['ir_r1_k3_s2_e4.5_c24_nre', 'ir_r1_k3_s1_e3.67_c24_nre'],
-            ['ir_r1_k5_s2_e4_c40_se0.25', 'ir_r2_k5_s1_e6_c40_se0.25'],
-            ['ir_r2_k5_s1_e3_c48_se0.25'],
-            ['ir_r3_k5_s2_e6_c96_se0.25'],
-            ['cn_r1_k1_s1_c576'],
-        ]
+        if 'minimal' in variant:
+            act_layer = resolve_act_layer(kwargs, 'relu')
+            arch_def = [
+                ['ds_r1_k3_s2_e1_c16'],
+                ['ir_r1_k3_s2_e4.5_c24', 'ir_r1_k3_s1_e3.67_c24'],
+                ['ir_r1_k3_s2_e4_c40', 'ir_r2_k3_s1_e6_c40'],
+                ['ir_r2_k3_s1_e3_c48'],
+                ['ir_r3_k3_s2_e6_c96'],
+                ['cn_r1_k1_s1_c576'],
+            ]
+        else:
+            act_layer = resolve_act_layer(kwargs, 'hard_swish')
+            arch_def = [
+                ['ds_r1_k3_s2_e1_c16_se0.25_nre'],
+                ['ir_r1_k3_s2_e4.5_c24_nre', 'ir_r1_k3_s1_e3.67_c24_nre'],
+                ['ir_r1_k5_s2_e4_c40_se0.25', 'ir_r2_k5_s1_e6_c40_se0.25'],
+                ['ir_r2_k5_s1_e3_c48_se0.25'],
+                ['ir_r3_k5_s2_e6_c96_se0.25'],
+                ['cn_r1_k1_s1_c576'],
+            ]
     else:
         num_features = 1280
-        arch_def = [
-            ['ds_r1_k3_s1_e1_c16_nre'],
-            ['ir_r1_k3_s2_e4_c24_nre', 'ir_r1_k3_s1_e3_c24_nre'],
-            ['ir_r3_k5_s2_e3_c40_se0.25_nre'],
-            ['ir_r1_k3_s2_e6_c80', 'ir_r1_k3_s1_e2.5_c80', 'ir_r2_k3_s1_e2.3_c80'],
-            ['ir_r2_k3_s1_e6_c112_se0.25'],
-            ['ir_r3_k5_s2_e6_c160_se0.25'],
-            ['cn_r1_k1_s1_c960'],
-        ]
+        if 'minimal' in variant:
+            act_layer = resolve_act_layer(kwargs, 'relu')
+            arch_def = [
+                ['ds_r1_k3_s1_e1_c16'],
+                ['ir_r1_k3_s2_e4_c24', 'ir_r1_k3_s1_e3_c24'],
+                ['ir_r3_k3_s2_e3_c40'],
+                ['ir_r1_k3_s2_e6_c80', 'ir_r1_k3_s1_e2.5_c80', 'ir_r2_k3_s1_e2.3_c80'],
+                ['ir_r2_k3_s1_e6_c112'],
+                ['ir_r3_k3_s2_e6_c160'],
+                ['cn_r1_k1_s1_c960'],
+            ]
+        else:
+            act_layer = resolve_act_layer(kwargs, 'hard_swish')
+            arch_def = [
+                ['ds_r1_k3_s1_e1_c16_nre'],
+                ['ir_r1_k3_s2_e4_c24_nre', 'ir_r1_k3_s1_e3_c24_nre'],
+                ['ir_r3_k5_s2_e3_c40_se0.25_nre'],
+                ['ir_r1_k3_s2_e6_c80', 'ir_r1_k3_s1_e2.5_c80', 'ir_r2_k3_s1_e2.3_c80'],
+                ['ir_r2_k3_s1_e6_c112_se0.25'],
+                ['ir_r3_k5_s2_e6_c160_se0.25'],
+                ['cn_r1_k1_s1_c960'],
+            ]
     round_chs_fn = partial(round_channels, multiplier=channel_multiplier)
     model_kwargs = dict(
         block_args=decode_arch_def(arch_def),
@@ -209,16 +246,109 @@ def _gen_mobilenet_v3(variant: str, channel_multiplier: float = 1.0, pretrained:
         fix_stem=channel_multiplier < 0.75,
         round_chs_fn=round_chs_fn,
         norm_layer=partial(BatchNormAct2d, **resolve_bn_args(kwargs)),
-        act_layer=resolve_act_layer(kwargs, 'hard_swish'),
+        act_layer=act_layer,
         **kwargs,
     )
-    from .efficientnet import checkpoint_filter_fn as _eff_filter
-    return build_model_with_cfg(
-        MobileNetV3, variant, pretrained,
-        pretrained_filter_fn=_eff_filter,
-        feature_cfg=dict(out_indices=tuple(range(len(arch_def)))),
-        **model_kwargs,
+    return _create_mnv3(variant, pretrained, arch_def=arch_def, **model_kwargs)
+
+
+def _gen_mobilenet_v3_rw(variant: str, channel_multiplier: float = 1.0, pretrained: bool = False, **kwargs):
+    """timm's original MobileNet-V3 port (no force-relu SE, no head bias)
+    (reference mobilenetv3.py:511-554)."""
+    arch_def = [
+        ['ds_r1_k3_s1_e1_c16_nre_noskip'],
+        ['ir_r1_k3_s2_e4_c24_nre', 'ir_r1_k3_s1_e3_c24_nre'],
+        ['ir_r3_k5_s2_e3_c40_se0.25_nre'],
+        ['ir_r1_k3_s2_e6_c80', 'ir_r1_k3_s1_e2.5_c80', 'ir_r2_k3_s1_e2.3_c80'],
+        ['ir_r2_k3_s1_e6_c112_se0.25'],
+        ['ir_r3_k5_s2_e6_c160_se0.25'],
+        ['cn_r1_k1_s1_c960'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        head_bias=False,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        norm_layer=partial(BatchNormAct2d, **resolve_bn_args(kwargs)),
+        act_layer=resolve_act_layer(kwargs, 'hard_swish'),
+        se_layer=partial(SqueezeExcite, gate_layer='hard_sigmoid'),
+        **kwargs,
     )
+    return _create_mnv3(variant, pretrained, arch_def=arch_def, **model_kwargs)
+
+
+def _gen_fbnetv3(variant: str, channel_multiplier: float = 1.0, pretrained: bool = False, **kwargs):
+    """FBNetV3 b/d/g (reference mobilenetv3.py:669-737)."""
+    vl = variant.split('_')[-1]
+    if vl in ('a', 'b'):
+        stem_size = 16
+        arch_def = [
+            ['ds_r2_k3_s1_e1_c16'],
+            ['ir_r1_k5_s2_e4_c24', 'ir_r3_k5_s1_e2_c24'],
+            ['ir_r1_k5_s2_e5_c40_se0.25', 'ir_r4_k5_s1_e3_c40_se0.25'],
+            ['ir_r1_k5_s2_e5_c72', 'ir_r4_k3_s1_e3_c72'],
+            ['ir_r1_k3_s1_e5_c120_se0.25', 'ir_r5_k5_s1_e3_c120_se0.25'],
+            ['ir_r1_k3_s2_e6_c184_se0.25', 'ir_r5_k5_s1_e4_c184_se0.25', 'ir_r1_k5_s1_e6_c224_se0.25'],
+            ['cn_r1_k1_s1_c1344'],
+        ]
+    elif vl == 'd':
+        stem_size = 24
+        arch_def = [
+            ['ds_r2_k3_s1_e1_c16'],
+            ['ir_r1_k3_s2_e5_c24', 'ir_r5_k3_s1_e2_c24'],
+            ['ir_r1_k5_s2_e4_c40_se0.25', 'ir_r4_k3_s1_e3_c40_se0.25'],
+            ['ir_r1_k3_s2_e5_c72', 'ir_r4_k3_s1_e3_c72'],
+            ['ir_r1_k3_s1_e5_c128_se0.25', 'ir_r6_k5_s1_e3_c128_se0.25'],
+            ['ir_r1_k3_s2_e6_c208_se0.25', 'ir_r5_k5_s1_e5_c208_se0.25', 'ir_r1_k5_s1_e6_c240_se0.25'],
+            ['cn_r1_k1_s1_c1440'],
+        ]
+    else:  # 'g'
+        stem_size = 32
+        arch_def = [
+            ['ds_r3_k3_s1_e1_c24'],
+            ['ir_r1_k5_s2_e4_c40', 'ir_r4_k5_s1_e2_c40'],
+            ['ir_r1_k5_s2_e4_c56_se0.25', 'ir_r4_k5_s1_e3_c56_se0.25'],
+            ['ir_r1_k5_s2_e5_c104', 'ir_r4_k3_s1_e3_c104'],
+            ['ir_r1_k3_s1_e5_c160_se0.25', 'ir_r8_k5_s1_e3_c160_se0.25'],
+            ['ir_r1_k3_s2_e6_c264_se0.25', 'ir_r6_k5_s1_e5_c264_se0.25', 'ir_r2_k5_s1_e6_c288_se0.25'],
+            ['cn_r1_k1_s1_c1728'],
+        ]
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier, round_limit=0.95)
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        num_features=1984,
+        head_bias=False,
+        stem_size=stem_size,
+        round_chs_fn=round_chs_fn,
+        se_from_exp=False,
+        norm_layer=partial(BatchNormAct2d, **resolve_bn_args(kwargs)),
+        act_layer=resolve_act_layer(kwargs, 'hard_swish'),
+        se_layer=partial(SqueezeExcite, gate_layer='hard_sigmoid', rd_round_fn=round_chs_fn),
+        **kwargs,
+    )
+    return _create_mnv3(variant, pretrained, arch_def=arch_def, **model_kwargs)
+
+
+def _gen_lcnet(variant: str, channel_multiplier: float = 1.0, pretrained: bool = False, **kwargs):
+    """PP-LCNet (reference mobilenetv3.py:740-782)."""
+    arch_def = [
+        ['dsa_r1_k3_s1_c32'],
+        ['dsa_r2_k3_s2_c64'],
+        ['dsa_r2_k3_s2_c128'],
+        ['dsa_r1_k3_s2_c256', 'dsa_r1_k5_s1_c256'],
+        ['dsa_r4_k5_s1_c256'],
+        ['dsa_r2_k5_s2_c512_se0.25'],
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        stem_size=16,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        norm_layer=partial(BatchNormAct2d, **resolve_bn_args(kwargs)),
+        act_layer=resolve_act_layer(kwargs, 'hard_swish'),
+        se_layer=partial(SqueezeExcite, gate_layer='hard_sigmoid', force_act_layer='relu'),
+        num_features=1280,
+        **kwargs,
+    )
+    return _create_mnv3(variant, pretrained, arch_def=arch_def, **model_kwargs)
 
 
 def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
@@ -232,9 +362,35 @@ def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
 
 
 default_cfgs = generate_default_cfgs({
+    'mobilenetv3_large_075.untrained': _cfg(),
     'mobilenetv3_large_100.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'mobilenetv3_small_050.lamb_in1k': _cfg(hf_hub_id='timm/'),
+    'mobilenetv3_small_075.lamb_in1k': _cfg(hf_hub_id='timm/'),
     'mobilenetv3_small_100.lamb_in1k': _cfg(hf_hub_id='timm/'),
+    'mobilenetv3_rw.rmsp_in1k': _cfg(hf_hub_id='timm/'),
+    'tf_mobilenetv3_large_075.in1k': _cfg(hf_hub_id='timm/', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
+    'tf_mobilenetv3_large_100.in1k': _cfg(hf_hub_id='timm/', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
+    'tf_mobilenetv3_large_minimal_100.in1k': _cfg(hf_hub_id='timm/', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
+    'tf_mobilenetv3_small_075.in1k': _cfg(hf_hub_id='timm/', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
+    'tf_mobilenetv3_small_100.in1k': _cfg(hf_hub_id='timm/', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
+    'tf_mobilenetv3_small_minimal_100.in1k': _cfg(hf_hub_id='timm/', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
+    'fbnetv3_b.ra2_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), test_input_size=(3, 256, 256),
+                               crop_pct=0.95),
+    'fbnetv3_d.ra2_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), test_input_size=(3, 256, 256),
+                               crop_pct=0.95),
+    'fbnetv3_g.ra2_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), test_input_size=(3, 288, 288),
+                               crop_pct=0.95, pool_size=(8, 8)),
+    'lcnet_035.untrained': _cfg(),
+    'lcnet_050.ra2_in1k': _cfg(hf_hub_id='timm/'),
+    'lcnet_075.ra2_in1k': _cfg(hf_hub_id='timm/'),
+    'lcnet_100.ra2_in1k': _cfg(hf_hub_id='timm/'),
+    'lcnet_150.untrained': _cfg(),
 })
+
+
+@register_model
+def mobilenetv3_large_075(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_mobilenet_v3('mobilenetv3_large_075', 0.75, pretrained, **kwargs)
 
 
 @register_model
@@ -243,8 +399,107 @@ def mobilenetv3_large_100(pretrained=False, **kwargs) -> MobileNetV3:
 
 
 @register_model
+def mobilenetv3_small_050(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_mobilenet_v3('mobilenetv3_small_050', 0.5, pretrained, **kwargs)
+
+
+@register_model
+def mobilenetv3_small_075(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_mobilenet_v3('mobilenetv3_small_075', 0.75, pretrained, **kwargs)
+
+
+@register_model
 def mobilenetv3_small_100(pretrained=False, **kwargs) -> MobileNetV3:
     return _gen_mobilenet_v3('mobilenetv3_small_100', 1.0, pretrained, **kwargs)
+
+
+@register_model
+def mobilenetv3_rw(pretrained=False, **kwargs) -> MobileNetV3:
+    # reference keeps TF-default BN eps for this port (mobilenetv3.py:1322)
+    kwargs.setdefault('bn_eps', 1e-3)
+    return _gen_mobilenet_v3_rw('mobilenetv3_rw', 1.0, pretrained, **kwargs)
+
+
+@register_model
+def tf_mobilenetv3_large_075(pretrained=False, **kwargs) -> MobileNetV3:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_mobilenet_v3('tf_mobilenetv3_large_075', 0.75, pretrained, **kwargs)
+
+
+@register_model
+def tf_mobilenetv3_large_100(pretrained=False, **kwargs) -> MobileNetV3:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_mobilenet_v3('tf_mobilenetv3_large_100', 1.0, pretrained, **kwargs)
+
+
+@register_model
+def tf_mobilenetv3_large_minimal_100(pretrained=False, **kwargs) -> MobileNetV3:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_mobilenet_v3('tf_mobilenetv3_large_minimal_100', 1.0, pretrained, **kwargs)
+
+
+@register_model
+def tf_mobilenetv3_small_075(pretrained=False, **kwargs) -> MobileNetV3:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_mobilenet_v3('tf_mobilenetv3_small_075', 0.75, pretrained, **kwargs)
+
+
+@register_model
+def tf_mobilenetv3_small_100(pretrained=False, **kwargs) -> MobileNetV3:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_mobilenet_v3('tf_mobilenetv3_small_100', 1.0, pretrained, **kwargs)
+
+
+@register_model
+def tf_mobilenetv3_small_minimal_100(pretrained=False, **kwargs) -> MobileNetV3:
+    kwargs.setdefault('bn_eps', 1e-3)
+    kwargs.setdefault('pad_type', 'same')
+    return _gen_mobilenet_v3('tf_mobilenetv3_small_minimal_100', 1.0, pretrained, **kwargs)
+
+
+@register_model
+def fbnetv3_b(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_fbnetv3('fbnetv3_b', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def fbnetv3_d(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_fbnetv3('fbnetv3_d', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def fbnetv3_g(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_fbnetv3('fbnetv3_g', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def lcnet_035(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_lcnet('lcnet_035', 0.35, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def lcnet_050(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_lcnet('lcnet_050', 0.5, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def lcnet_075(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_lcnet('lcnet_075', 0.75, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def lcnet_100(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_lcnet('lcnet_100', 1.0, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def lcnet_150(pretrained=False, **kwargs) -> MobileNetV3:
+    return _gen_lcnet('lcnet_150', 1.5, pretrained=pretrained, **kwargs)
 
 
 from .efficientnet import checkpoint_filter_fn  # noqa: E402,F401

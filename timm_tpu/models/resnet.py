@@ -15,8 +15,8 @@ import jax.numpy as jnp
 from flax import nnx
 
 from ..layers import (
-    BatchNormAct2d, ClassifierHead, DropPath, SEModule, calculate_drop_path_rates,
-    create_conv2d, get_act_fn,
+    BatchNormAct2d, ClassifierHead, DropPath, EcaModule, SEModule,
+    calculate_drop_path_rates, create_conv2d, get_act_fn,
 )
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
@@ -421,6 +421,47 @@ default_cfgs = generate_default_cfgs({
     'wide_resnet50_2.racm_in1k': _cfg(hf_hub_id='timm/'),
     'seresnet50.ra2_in1k': _cfg(hf_hub_id='timm/'),
     'test_resnet.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+    # tail variants (reference resnet.py default_cfgs; deep-stem models use conv1.0 first conv)
+    'resnet10t.c3_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 176, 176),
+                              test_input_size=(3, 224, 224), crop_pct=0.95),
+    'resnet14t.c3_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 176, 176),
+                              test_input_size=(3, 224, 224), crop_pct=0.95),
+    'resnet18d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+    'resnet26d.bt_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+    'resnet26t.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+    'resnet34d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+    'resnet50t.untrained': _cfg(first_conv='conv1.0'),
+    'resnet101d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 256, 256),
+                                test_input_size=(3, 320, 320), crop_pct=0.95),
+    'resnet152d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 256, 256),
+                                test_input_size=(3, 320, 320), crop_pct=0.95),
+    'resnet200.untrained': _cfg(crop_pct=0.95, test_input_size=(3, 288, 288), test_crop_pct=1.0),
+    'resnet200d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 256, 256),
+                                test_input_size=(3, 320, 320), crop_pct=0.95),
+    'resnext50d_32x4d.bt_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+    'resnext101_32x4d.fb_ssl_yfcc100m_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'resnext101_32x8d.fb_wsl_ig1b_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'resnext101_32x16d.fb_wsl_ig1b_ft_in1k': _cfg(hf_hub_id='timm/'),
+    'resnext101_64x4d.c1_in1k': _cfg(hf_hub_id='timm/'),
+    'wide_resnet101_2.tv2_in1k': _cfg(hf_hub_id='timm/'),
+    'seresnet34.untrained': _cfg(),
+    'seresnet50t.untrained': _cfg(first_conv='conv1.0'),
+    'seresnet101.untrained': _cfg(),
+    'seresnet152.untrained': _cfg(),
+    'seresnext26d_32x4d.bt_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+    'seresnext26t_32x4d.bt_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+    'seresnext50_32x4d.racm_in1k': _cfg(hf_hub_id='timm/'),
+    'seresnext101_32x4d.untrained': _cfg(),
+    'seresnext101_32x8d.ah_in1k': _cfg(
+        hf_hub_id='timm/', crop_pct=0.95, test_input_size=(3, 288, 288), test_crop_pct=1.0),
+    'seresnext101_64x4d.gluon_in1k': _cfg(hf_hub_id='timm/'),
+    'ecaresnet26t.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 256, 256),
+                                  test_input_size=(3, 320, 320), crop_pct=0.95),
+    'ecaresnet50d.miil_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+    'ecaresnet50t.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 256, 256),
+                                  test_input_size=(3, 320, 320), crop_pct=0.95),
+    'ecaresnet101d.miil_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+    'ecaresnetlight.miil_in1k': _cfg(hf_hub_id='timm/'),
 })
 
 
@@ -517,6 +558,215 @@ def wide_resnet50_2(pretrained=False, **kwargs) -> ResNet:
 def seresnet50(pretrained=False, **kwargs) -> ResNet:
     model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), se_layer=SEModule)
     return _create_resnet('seresnet50', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet10t(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=BasicBlock, layers=(1, 1, 1, 1), stem_width=32, stem_type='deep_tiered', avg_down=True)
+    return _create_resnet('resnet10t', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet14t(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(1, 1, 1, 1), stem_width=32, stem_type='deep_tiered', avg_down=True)
+    return _create_resnet('resnet14t', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet18d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=BasicBlock, layers=(2, 2, 2, 2), stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnet18d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet26d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(2, 2, 2, 2), stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnet26d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet26t(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(2, 2, 2, 2), stem_width=32, stem_type='deep_tiered', avg_down=True)
+    return _create_resnet('resnet26t', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet34d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=BasicBlock, layers=(3, 4, 6, 3), stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnet34d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet50t(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), stem_width=32, stem_type='deep_tiered', avg_down=True)
+    return _create_resnet('resnet50t', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet101d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnet101d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet152d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 8, 36, 3), stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnet152d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet200(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 24, 36, 3))
+    return _create_resnet('resnet200', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet200d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 24, 36, 3), stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnet200d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnext50d_32x4d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 6, 3), cardinality=32, base_width=4,
+        stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnext50d_32x4d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnext101_32x4d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), cardinality=32, base_width=4)
+    return _create_resnet('resnext101_32x4d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnext101_32x8d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), cardinality=32, base_width=8)
+    return _create_resnet('resnext101_32x8d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnext101_32x16d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), cardinality=32, base_width=16)
+    return _create_resnet('resnext101_32x16d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnext101_64x4d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), cardinality=64, base_width=4)
+    return _create_resnet('resnext101_64x4d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def wide_resnet101_2(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), base_width=128)
+    return _create_resnet('wide_resnet101_2', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnet34(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=BasicBlock, layers=(3, 4, 6, 3), se_layer=SEModule)
+    return _create_resnet('seresnet34', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnet50t(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 6, 3), stem_width=32, stem_type='deep_tiered',
+        avg_down=True, se_layer=SEModule)
+    return _create_resnet('seresnet50t', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnet101(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), se_layer=SEModule)
+    return _create_resnet('seresnet101', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnet152(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 8, 36, 3), se_layer=SEModule)
+    return _create_resnet('seresnet152', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnext26d_32x4d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(2, 2, 2, 2), cardinality=32, base_width=4, stem_width=32,
+        stem_type='deep', avg_down=True, se_layer=SEModule)
+    return _create_resnet('seresnext26d_32x4d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnext26t_32x4d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(2, 2, 2, 2), cardinality=32, base_width=4, stem_width=32,
+        stem_type='deep_tiered', avg_down=True, se_layer=SEModule)
+    return _create_resnet('seresnext26t_32x4d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnext50_32x4d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), cardinality=32, base_width=4, se_layer=SEModule)
+    return _create_resnet('seresnext50_32x4d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnext101_32x4d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), cardinality=32, base_width=4, se_layer=SEModule)
+    return _create_resnet('seresnext101_32x4d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnext101_32x8d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), cardinality=32, base_width=8, se_layer=SEModule)
+    return _create_resnet('seresnext101_32x8d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnext101_64x4d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), cardinality=64, base_width=4, se_layer=SEModule)
+    return _create_resnet('seresnext101_64x4d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def ecaresnet26t(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(2, 2, 2, 2), stem_width=32, stem_type='deep_tiered',
+        avg_down=True, se_layer=EcaModule)
+    return _create_resnet('ecaresnet26t', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def ecaresnet50d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 6, 3), stem_width=32, stem_type='deep',
+        avg_down=True, se_layer=EcaModule)
+    return _create_resnet('ecaresnet50d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def ecaresnet50t(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 6, 3), stem_width=32, stem_type='deep_tiered',
+        avg_down=True, se_layer=EcaModule)
+    return _create_resnet('ecaresnet50t', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def ecaresnet101d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 23, 3), stem_width=32, stem_type='deep',
+        avg_down=True, se_layer=EcaModule)
+    return _create_resnet('ecaresnet101d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def ecaresnetlight(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(1, 1, 11, 3), stem_width=32, avg_down=True, se_layer=EcaModule)
+    return _create_resnet('ecaresnetlight', pretrained, **dict(model_args, **kwargs))
 
 
 @register_model

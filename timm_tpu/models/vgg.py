@@ -211,7 +211,9 @@ default_cfgs = generate_default_cfgs({
     'vgg16.tv_in1k': _cfg(hf_hub_id='timm/'),
     'vgg19.tv_in1k': _cfg(hf_hub_id='timm/'),
     'vgg11_bn.tv_in1k': _cfg(hf_hub_id='timm/'),
+    'vgg13_bn.tv_in1k': _cfg(hf_hub_id='timm/'),
     'vgg16_bn.tv_in1k': _cfg(hf_hub_id='timm/'),
+    'vgg19_bn.tv_in1k': _cfg(hf_hub_id='timm/'),
 })
 
 
@@ -281,5 +283,15 @@ def vgg11_bn(pretrained=False, **kwargs) -> VGG:
 
 
 @register_model
+def vgg13_bn(pretrained=False, **kwargs) -> VGG:
+    return _create_vgg('vgg13_bn', pretrained, **kwargs)
+
+
+@register_model
 def vgg16_bn(pretrained=False, **kwargs) -> VGG:
     return _create_vgg('vgg16_bn', pretrained, **kwargs)
+
+
+@register_model
+def vgg19_bn(pretrained=False, **kwargs) -> VGG:
+    return _create_vgg('vgg19_bn', pretrained, **kwargs)

@@ -232,17 +232,20 @@ def main():
         model_kwargs['drop_rate'] = args.drop
     if args.drop_path is not None:
         model_kwargs['drop_path_rate'] = args.drop_path
-    model = create_model(
-        args.model,
+    factory_kwargs = dict(
         pretrained=args.pretrained,
         num_classes=args.num_classes,
-        img_size=args.img_size,
         in_chans=args.in_chans,
         checkpoint_path=args.initial_checkpoint,
         dtype=dtype,
         seed=args.seed,
-        **model_kwargs,
     )
+    try:
+        model = create_model(args.model, img_size=args.img_size, **factory_kwargs, **model_kwargs)
+    except TypeError:
+        # fixed-receptive-field conv nets take no img_size arg; the data
+        # pipeline still honors --img-size via resolve_data_config below
+        model = create_model(args.model, **factory_kwargs, **model_kwargs)
     if args.num_classes is None:
         args.num_classes = model.num_classes
     if args.grad_checkpointing:

@@ -1,0 +1,133 @@
+"""Bottleneck self-attention (BoTNet), TPU-native NHWC
+(reference: timm/layers/bottleneck_attn.py:1-190; Srinivas et al. 2021).
+
+The decomposed relative-position logits use a static GATHER over a trace-time
+index (out[i, j] = x[i, j - i + win - 1]) instead of the reference's
+pad/flatten/reshape shifting trick — identical math, no dynamic reshapes for
+XLA to chase.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from .helpers import make_divisible, to_2tuple
+
+__all__ = ['BottleneckAttn', 'PosEmbedRel', 'rel_logits_1d']
+
+
+def rel_logits_1d(q, rel_k, permute_mask: Tuple[int, ...], k_other: int):
+    """Relative logits along one dimension via static gather.
+
+    Args:
+        q: (B, H, W, dim) queries (W = query positions along this axis)
+        rel_k: (2 * win - 1, dim) relative embedding (win = key positions)
+        permute_mask: output permutation
+        k_other: key size along the OTHER axis (tiled dimension)
+    Returns (permuted) (B, H, k_other, W, win).
+    """
+    B, H, W, dim = q.shape
+    rel_size = rel_k.shape[0]
+    win = (rel_size + 1) // 2
+    x = jnp.einsum('bhwd,rd->bhwr', q, rel_k)  # (B, H, W, 2*win-1)
+    # absolute index: key j relative to query i → j - i + win - 1
+    idx = np.arange(win)[None, :] - np.arange(W)[:, None] + (win - 1)  # (W, win)
+    x = jnp.take_along_axis(x, jnp.asarray(idx)[None, None], axis=-1)  # (B, H, W, win)
+    x = jnp.broadcast_to(x[:, :, None], (B, H, k_other, W, win))
+    return x.transpose(permute_mask)
+
+
+class PosEmbedRel(nnx.Module):
+    """Decomposed 2D relative position embedding over a full feature map
+    (reference bottleneck_attn.py:45-81)."""
+
+    def __init__(self, feat_size, dim_head: int, scale: float,
+                 *, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.height, self.width = to_2tuple(feat_size)
+        self.dim_head = dim_head
+        # reference re-inits these with trunc_normal_(std=scale)
+        self.height_rel = nnx.Param(
+            jax.random.truncated_normal(rngs.params(), -2, 2, (self.height * 2 - 1, dim_head), param_dtype) * scale)
+        self.width_rel = nnx.Param(
+            jax.random.truncated_normal(rngs.params(), -2, 2, (self.width * 2 - 1, dim_head), param_dtype) * scale)
+
+    def __call__(self, q):
+        # q: (B', HW, dim) → logits (B', HW, HW)
+        B, HW, _ = q.shape
+        q = q.reshape(B, self.height, self.width, -1)
+        rel_logits_w = rel_logits_1d(q, self.width_rel[...], (0, 1, 3, 2, 4), k_other=self.height)
+        q = q.transpose(0, 2, 1, 3)
+        rel_logits_h = rel_logits_1d(q, self.height_rel[...], (0, 3, 1, 4, 2), k_other=self.width)
+        return (rel_logits_h + rel_logits_w).reshape(B, HW, HW)
+
+
+class BottleneckAttn(nnx.Module):
+    """Bottleneck attention block (reference bottleneck_attn.py:83-190)."""
+
+    def __init__(
+            self,
+            dim: int,
+            dim_out: Optional[int] = None,
+            feat_size=None,
+            stride: int = 1,
+            num_heads: int = 4,
+            dim_head: Optional[int] = None,
+            qk_ratio: float = 1.0,
+            qkv_bias: bool = False,
+            scale_pos_embed: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert feat_size is not None, 'bottleneck attention requires a static feat_size'
+        dim_out = dim_out or dim
+        assert dim_out % num_heads == 0
+        self.num_heads = num_heads
+        self.dim_head_qk = dim_head or make_divisible(dim_out * qk_ratio, divisor=8) // num_heads
+        self.dim_head_v = dim_out // num_heads
+        self.dim_out_qk = num_heads * self.dim_head_qk
+        self.dim_out_v = num_heads * self.dim_head_v
+        self.scale = self.dim_head_qk ** -0.5
+        self.scale_pos_embed = scale_pos_embed
+        self.stride = stride
+
+        fan_in = dim
+        self.qkv = nnx.Conv(
+            dim, self.dim_out_qk * 2 + self.dim_out_v, kernel_size=(1, 1), use_bias=qkv_bias,
+            kernel_init=nnx.initializers.truncated_normal(stddev=fan_in ** -0.5),
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.pos_embed = PosEmbedRel(feat_size, dim_head=self.dim_head_qk, scale=self.scale,
+                                     param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        assert H == self.pos_embed.height and W == self.pos_embed.width
+        x = self.qkv(x)  # (B, H, W, 2*qk + v)
+        M = H * W
+        q, k, v = jnp.split(x.reshape(B, M, -1), [self.dim_out_qk, self.dim_out_qk * 2], axis=-1)
+        # channel layout is (heads, dim_head) head-major, matching torch's
+        # B*heads reshape of the NCHW channel axis
+        q = q.reshape(B, M, self.num_heads, self.dim_head_qk).transpose(0, 2, 1, 3)
+        k = k.reshape(B, M, self.num_heads, self.dim_head_qk).transpose(0, 2, 1, 3)
+        v = v.reshape(B, M, self.num_heads, self.dim_head_v).transpose(0, 2, 1, 3)
+
+        pos = self.pos_embed(q.reshape(B * self.num_heads, M, self.dim_head_qk))
+        pos = pos.reshape(B, self.num_heads, M, M)
+        logits = jnp.einsum('bhmd,bhnd->bhmn', q, k)
+        if self.scale_pos_embed:
+            attn = (logits + pos) * self.scale
+        else:
+            attn = logits * self.scale + pos
+        attn = jax.nn.softmax(attn, axis=-1)
+        out = jnp.einsum('bhmn,bhnd->bhmd', attn, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, H, W, self.dim_out_v)
+        if self.stride == 2:
+            # AvgPool2d(2, 2) floors odd maps: crop trailing row/col first
+            out = out[:, :2 * (H // 2), :2 * (W // 2)]
+            out = out.reshape(B, H // 2, 2, W // 2, 2, -1).mean(axis=(2, 4))
+        return out

@@ -5,8 +5,11 @@ from __future__ import annotations
 from functools import partial
 from typing import Callable, Union
 
+from .bottleneck_attn import BottleneckAttn
 from .cbam import CbamModule, LightCbamModule
 from .eca import CecaModule, EcaModule
+from .halo_attn import HaloAttn
+from .lambda_layer import LambdaLayer
 from .gather_excite import GatherExcite
 from .global_context import GlobalContext
 from .non_local_attn import BatNonLocalAttn, NonLocalAttn
@@ -17,6 +20,9 @@ from .squeeze_excite import EffectiveSEModule, SEModule
 __all__ = ['get_attn', 'create_attn']
 
 _ATTN_MAP = dict(
+    # self-attention spatial mixers (byoanet-style nets)
+    bottleneck=BottleneckAttn,
+    halo=HaloAttn,
     se=SEModule,
     ese=EffectiveSEModule,
     eca=EcaModule,
@@ -31,6 +37,7 @@ _ATTN_MAP = dict(
     sk=SelectiveKernel,
     splat=SplitAttn,
 )
+_ATTN_MAP['lambda'] = LambdaLayer
 
 
 def get_attn(attn_type: Union[str, Callable, None]):

@@ -1,0 +1,148 @@
+"""Halo attention (HaloNet), TPU-native NHWC
+(reference: timm/layers/halo_attn.py:1-280; Vaswani et al. 2021).
+
+Blocked local attention: queries are non-overlapping blocks, keys/values are
+the blocks extended by a halo. The reference's `tensor.unfold` (not lowered
+for torch-XLA, as its own comment notes) is replaced here by a static python
+loop of strided slices over the padded map — one slice per block, all shapes
+fixed at trace time, which XLA fuses into the attention matmuls. Relative
+position logits share the static-gather `rel_logits_1d` with bottleneck_attn.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from .bottleneck_attn import rel_logits_1d
+from .helpers import make_divisible
+
+__all__ = ['HaloAttn']
+
+
+class PosEmbedRelHalo(nnx.Module):
+    """Relative position embedding over (block, win) query/key grids
+    (reference halo_attn.py PosEmbedRel)."""
+
+    def __init__(self, block_size: int, win_size: int, dim_head: int, scale: float,
+                 *, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.block_size = block_size
+        self.win_size = win_size
+        self.dim_head = dim_head
+        # reference re-inits these with trunc_normal_(std=scale)
+        self.height_rel = nnx.Param(
+            jax.random.truncated_normal(rngs.params(), -2, 2, (win_size * 2 - 1, dim_head), param_dtype) * scale)
+        self.width_rel = nnx.Param(
+            jax.random.truncated_normal(rngs.params(), -2, 2, (win_size * 2 - 1, dim_head), param_dtype) * scale)
+
+    def __call__(self, q):
+        # q: (B, BB, block_size^2, dim) → (B, BB, block_size^2, win_size^2)
+        B, BB, HW, _ = q.shape
+        q = q.reshape(-1, self.block_size, self.block_size, self.dim_head)
+        rel_logits_w = rel_logits_1d(q, self.width_rel[...], (0, 1, 3, 2, 4), k_other=self.win_size)
+        q = q.transpose(0, 2, 1, 3)
+        rel_logits_h = rel_logits_1d(q, self.height_rel[...], (0, 3, 1, 4, 2), k_other=self.win_size)
+        rel_logits = rel_logits_h + rel_logits_w
+        return rel_logits.reshape(B, BB, HW, -1)
+
+
+class HaloAttn(nnx.Module):
+    """Halo attention block (reference halo_attn.py:101-250)."""
+
+    def __init__(
+            self,
+            dim: int,
+            dim_out: Optional[int] = None,
+            feat_size=None,  # unused; arg compat with bottleneck/lambda
+            stride: int = 1,
+            num_heads: int = 8,
+            dim_head: Optional[int] = None,
+            block_size: int = 8,
+            halo_size: int = 3,
+            qk_ratio: float = 1.0,
+            qkv_bias: bool = False,
+            avg_down: bool = False,
+            scale_pos_embed: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        dim_out = dim_out or dim
+        assert dim_out % num_heads == 0
+        assert stride in (1, 2)
+        self.num_heads = num_heads
+        self.dim_head_qk = dim_head or make_divisible(dim_out * qk_ratio, divisor=8) // num_heads
+        self.dim_head_v = dim_out // num_heads
+        self.dim_out_qk = num_heads * self.dim_head_qk
+        self.dim_out_v = num_heads * self.dim_head_v
+        self.scale = self.dim_head_qk ** -0.5
+        self.scale_pos_embed = scale_pos_embed
+        self.block_size = self.block_size_ds = block_size
+        self.halo_size = halo_size
+        self.win_size = block_size + halo_size * 2
+        self.block_stride = 1
+        self.use_avg_pool = False
+        if stride > 1:
+            self.use_avg_pool = avg_down or block_size % stride != 0
+            self.block_stride = 1 if self.use_avg_pool else stride
+            self.block_size_ds = self.block_size // self.block_stride
+
+        init = nnx.initializers.truncated_normal(stddev=dim ** -0.5)
+        self.q = nnx.Conv(
+            dim, self.dim_out_qk, kernel_size=(1, 1), strides=self.block_stride,
+            use_bias=qkv_bias, kernel_init=init, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.kv = nnx.Conv(
+            dim, self.dim_out_qk + self.dim_out_v, kernel_size=(1, 1), use_bias=qkv_bias,
+            kernel_init=init, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.pos_embed = PosEmbedRelHalo(
+            block_size=self.block_size_ds, win_size=self.win_size,
+            dim_head=self.dim_head_qk, scale=self.scale, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        assert H % self.block_size == 0 and W % self.block_size == 0
+        nH = H // self.block_size
+        nW = W // self.block_size
+        nblocks = nH * nW
+        bs = self.block_size_ds
+
+        q = self.q(x)  # (B, H', W', heads*dqk)
+        q = q.reshape(B, nH, bs, nW, bs, self.num_heads, self.dim_head_qk)
+        q = q.transpose(0, 5, 1, 3, 2, 4, 6).reshape(B, self.num_heads, nblocks, bs * bs, self.dim_head_qk)
+
+        kv = self.kv(x)
+        kv = jnp.pad(kv, ((0, 0), (self.halo_size, self.halo_size), (self.halo_size, self.halo_size), (0, 0)))
+        # overlapping (win, win) windows at block stride: static slice per block
+        win = self.win_size
+        rows = []
+        for bh in range(nH):
+            cols = []
+            for bw in range(nW):
+                cols.append(kv[:, bh * self.block_size: bh * self.block_size + win,
+                               bw * self.block_size: bw * self.block_size + win, :])
+            rows.append(jnp.stack(cols, axis=1))
+        kv = jnp.stack(rows, axis=1)  # (B, nH, nW, win, win, Ckv)
+        kv = kv.reshape(B, nblocks, win * win, self.num_heads, self.dim_head_qk + self.dim_head_v)
+        kv = kv.transpose(0, 3, 1, 2, 4)  # (B, heads, nblocks, win^2, dqk+dv)
+        k, v = jnp.split(kv, [self.dim_head_qk], axis=-1)
+
+        pos = self.pos_embed(q.reshape(B * self.num_heads, nblocks, bs * bs, self.dim_head_qk))
+        pos = pos.reshape(B, self.num_heads, nblocks, bs * bs, win * win)
+        logits = jnp.einsum('bhnqd,bhnkd->bhnqk', q, k)
+        if self.scale_pos_embed:
+            attn = (logits + pos) * self.scale
+        else:
+            attn = logits * self.scale + pos
+        attn = jax.nn.softmax(attn, axis=-1)
+        out = jnp.einsum('bhnqk,bhnkd->bhnqd', attn, v)  # (B, heads, nblocks, bs^2, dv)
+        out = out.reshape(B, self.num_heads, nH, nW, bs, bs, self.dim_head_v)
+        out = out.transpose(0, 2, 4, 3, 5, 1, 6).reshape(
+            B, nH * bs, nW * bs, self.dim_out_v)
+        if self.use_avg_pool:
+            Ho, Wo = out.shape[1], out.shape[2]
+            out = out[:, :2 * (Ho // 2), :2 * (Wo // 2)]
+            out = out.reshape(B, Ho // 2, 2, Wo // 2, 2, -1).mean(axis=(2, 4))
+        return out

@@ -1,0 +1,112 @@
+"""Lambda layer (LambdaNetworks), TPU-native NHWC
+(reference: timm/layers/lambda_layer.py:1-175; Bello 2021).
+
+Content + position lambdas via einsums; the positional path's Conv3d
+(r, r, 1) over (H, W, V) is expressed as a shared 2D conv applied per value
+channel (fold V into batch) — same weights, no 3D conv lowering needed. The
+relative-position variant gathers a static (M, M) index into the pos table.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from .helpers import make_divisible, to_2tuple
+from .norm import BatchNorm2d
+
+__all__ = ['LambdaLayer']
+
+
+def _rel_pos_indices(size):
+    size = to_2tuple(size)
+    pos = np.stack(np.meshgrid(np.arange(size[0]), np.arange(size[1]), indexing='ij')).reshape(2, -1)
+    rel_pos = pos[:, None, :] - pos[:, :, None]
+    rel_pos[0] += size[0] - 1
+    rel_pos[1] += size[1] - 1
+    return rel_pos  # (2, M, M)
+
+
+class LambdaLayer(nnx.Module):
+    """Lambda layer (reference lambda_layer.py:46-175)."""
+
+    def __init__(
+            self,
+            dim: int,
+            dim_out: Optional[int] = None,
+            feat_size=None,
+            stride: int = 1,
+            num_heads: int = 4,
+            dim_head: int = 16,
+            r: Optional[int] = 9,
+            qk_ratio: float = 1.0,
+            qkv_bias: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        dim_out = dim_out or dim
+        assert dim_out % num_heads == 0
+        self.dim_qk = dim_head or make_divisible(dim_out * qk_ratio, divisor=8) // num_heads
+        self.num_heads = num_heads
+        self.dim_v = dim_out // num_heads
+        self.stride = stride
+
+        self.qkv = nnx.Conv(
+            dim, num_heads * self.dim_qk + self.dim_qk + self.dim_v, kernel_size=(1, 1),
+            use_bias=qkv_bias, kernel_init=nnx.initializers.truncated_normal(stddev=dim ** -0.5),
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm_q = BatchNorm2d(num_heads * self.dim_qk, rngs=rngs)
+        self.norm_v = BatchNorm2d(self.dim_v, rngs=rngs)
+
+        if r is not None:
+            # local positional lambdas: shared (r, r) conv per value channel
+            self.conv_lambda = nnx.Conv(
+                1, self.dim_qk, kernel_size=(r, r), padding=[(r // 2, r // 2), (r // 2, r // 2)],
+                kernel_init=nnx.initializers.truncated_normal(stddev=self.dim_qk ** -0.5),
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.pos_emb = None
+            self._rel_pos_indices = None
+        else:
+            assert feat_size is not None
+            feat_size = to_2tuple(feat_size)
+            rel_size = [2 * s - 1 for s in feat_size]
+            self.conv_lambda = None
+            self.pos_emb = nnx.Param(
+                jax.random.truncated_normal(
+                    rngs.params(), -2, 2, (rel_size[0], rel_size[1], self.dim_qk), param_dtype) * 0.02)
+            self._rel_pos_indices = jnp.asarray(_rel_pos_indices(feat_size))
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        M = H * W
+        qkv = self.qkv(x)  # (B, H, W, heads*K + K + V)
+        q, k, v = jnp.split(
+            qkv, [self.num_heads * self.dim_qk, self.num_heads * self.dim_qk + self.dim_qk], axis=-1)
+        q = self.norm_q(q).reshape(B, M, self.num_heads, self.dim_qk).transpose(0, 2, 1, 3)  # B, h, M, K
+        v = self.norm_v(v).reshape(B, M, self.dim_v)  # B, M, V
+        k = jax.nn.softmax(k.reshape(B, M, self.dim_qk), axis=1)  # normalize over positions
+
+        content_lam = jnp.einsum('bmk,bmv->bkv', k, v)
+        content_out = jnp.einsum('bhmk,bkv->bhmv', q, content_lam)
+
+        if self.pos_emb is None:
+            # (B, H, W, V) → per-channel shared conv → (B, M, K, V)
+            vs = v.reshape(B, H, W, self.dim_v).transpose(0, 3, 1, 2).reshape(B * self.dim_v, H, W, 1)
+            pl = self.conv_lambda(vs)  # (B*V, H, W, K)
+            position_lam = pl.reshape(B, self.dim_v, M, self.dim_qk).transpose(0, 2, 3, 1)  # B, M, K, V
+        else:
+            pos = self.pos_emb[...][self._rel_pos_indices[0], self._rel_pos_indices[1]]  # (M, M, K)
+            position_lam = jnp.einsum('mnk,bnv->bmkv', pos.astype(v.dtype), v)
+        position_out = jnp.einsum('bhmk,bmkv->bhmv', q, position_lam)
+
+        out = (content_out + position_out).transpose(0, 2, 1, 3).reshape(B, H, W, -1)
+        if self.stride == 2:
+            # AvgPool2d(2, 2) floors odd maps: crop trailing row/col first
+            out = out[:, :2 * (H // 2), :2 * (W // 2)]
+            out = out.reshape(B, H // 2, 2, W // 2, 2, -1).mean(axis=(2, 4))
+        return out

@@ -14,6 +14,7 @@ from ._registry import (
 )
 
 from .beit import Beit
+from .byoanet import *  # noqa: F401,F403 — registers byoanet entrypoints
 from .byobnet import ByoBlockCfg, ByoModelCfg, ByobNet
 from .cait import Cait
 from .convnext import ConvNeXt

@@ -421,29 +421,40 @@ default_cfgs = generate_default_cfgs({
     'wide_resnet50_2.racm_in1k': _cfg(hf_hub_id='timm/'),
     'seresnet50.ra2_in1k': _cfg(hf_hub_id='timm/'),
     'test_resnet.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
-    # tail variants (reference resnet.py default_cfgs; deep-stem models use conv1.0 first conv)
+    # tail variants (cfg values ported exactly from reference resnet.py
+    # default_cfgs; _ttcfg = timm-trained default: test 288px @ 0.95)
     'resnet10t.c3_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 176, 176),
-                              test_input_size=(3, 224, 224), crop_pct=0.95),
+                              pool_size=(6, 6), test_input_size=(3, 224, 224), test_crop_pct=0.95),
     'resnet14t.c3_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 176, 176),
-                              test_input_size=(3, 224, 224), crop_pct=0.95),
-    'resnet18d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
-    'resnet26d.bt_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
-    'resnet26t.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
-    'resnet34d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
-    'resnet50t.untrained': _cfg(first_conv='conv1.0'),
+                              pool_size=(6, 6), test_input_size=(3, 224, 224), test_crop_pct=0.95),
+    'resnet18d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0',
+                               test_input_size=(3, 288, 288), test_crop_pct=0.95),
+    'resnet26d.bt_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0',
+                              test_input_size=(3, 288, 288), test_crop_pct=0.95),
+    'resnet26t.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 256, 256),
+                               pool_size=(8, 8), crop_pct=0.94, test_input_size=(3, 320, 320),
+                               test_crop_pct=1.0),
+    'resnet34d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0',
+                               test_input_size=(3, 288, 288), test_crop_pct=0.95),
+    'resnet50t.untrained': _cfg(first_conv='conv1.0', test_input_size=(3, 288, 288), test_crop_pct=0.95),
     'resnet101d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 256, 256),
-                                test_input_size=(3, 320, 320), crop_pct=0.95),
+                                pool_size=(8, 8), crop_pct=0.95, test_input_size=(3, 320, 320),
+                                test_crop_pct=1.0),
     'resnet152d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 256, 256),
-                                test_input_size=(3, 320, 320), crop_pct=0.95),
-    'resnet200.untrained': _cfg(crop_pct=0.95, test_input_size=(3, 288, 288), test_crop_pct=1.0),
+                                pool_size=(8, 8), crop_pct=0.95, test_input_size=(3, 320, 320),
+                                test_crop_pct=1.0),
+    'resnet200.untrained': _cfg(test_input_size=(3, 288, 288), test_crop_pct=0.95),
     'resnet200d.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 256, 256),
-                                test_input_size=(3, 320, 320), crop_pct=0.95),
+                                pool_size=(8, 8), crop_pct=0.95, test_input_size=(3, 320, 320),
+                                test_crop_pct=1.0),
     'resnext50d_32x4d.bt_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
     'resnext101_32x4d.fb_ssl_yfcc100m_ft_in1k': _cfg(hf_hub_id='timm/'),
     'resnext101_32x8d.fb_wsl_ig1b_ft_in1k': _cfg(hf_hub_id='timm/'),
     'resnext101_32x16d.fb_wsl_ig1b_ft_in1k': _cfg(hf_hub_id='timm/'),
     'resnext101_64x4d.c1_in1k': _cfg(hf_hub_id='timm/'),
-    'wide_resnet101_2.tv2_in1k': _cfg(hf_hub_id='timm/'),
+    'wide_resnet101_2.tv2_in1k': _cfg(
+        hf_hub_id='timm/', input_size=(3, 176, 176), pool_size=(6, 6),
+        test_input_size=(3, 224, 224), test_crop_pct=0.965),
     'seresnet34.untrained': _cfg(),
     'seresnet50t.untrained': _cfg(first_conv='conv1.0'),
     'seresnet101.untrained': _cfg(),
@@ -456,7 +467,7 @@ default_cfgs = generate_default_cfgs({
         hf_hub_id='timm/', crop_pct=0.95, test_input_size=(3, 288, 288), test_crop_pct=1.0),
     'seresnext101_64x4d.gluon_in1k': _cfg(hf_hub_id='timm/'),
     'ecaresnet26t.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 256, 256),
-                                  test_input_size=(3, 320, 320), crop_pct=0.95),
+                                  pool_size=(8, 8), test_input_size=(3, 320, 320), test_crop_pct=0.95),
     'ecaresnet50d.miil_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
     'ecaresnet50t.ra2_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0', input_size=(3, 256, 256),
                                   test_input_size=(3, 320, 320), crop_pct=0.95),

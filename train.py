@@ -240,11 +240,17 @@ def main():
         dtype=dtype,
         seed=args.seed,
     )
-    try:
-        model = create_model(args.model, img_size=args.img_size, **factory_kwargs, **model_kwargs)
-    except TypeError:
-        # fixed-receptive-field conv nets take no img_size arg; the data
-        # pipeline still honors --img-size via resolve_data_config below
+    # pass img_size only to models whose constructor takes it; fixed-field
+    # conv nets get resized inputs via resolve_data_config instead. The retry
+    # is limited to the exact img_size TypeError so real errors still surface.
+    if args.img_size is not None:
+        try:
+            model = create_model(args.model, img_size=args.img_size, **factory_kwargs, **model_kwargs)
+        except TypeError as e:
+            if 'img_size' not in str(e):
+                raise
+            model = create_model(args.model, **factory_kwargs, **model_kwargs)
+    else:
         model = create_model(args.model, **factory_kwargs, **model_kwargs)
     if args.num_classes is None:
         args.num_classes = model.num_classes

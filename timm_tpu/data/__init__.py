@@ -9,7 +9,8 @@ from .constants import (
 )
 from .dataset import AugMixDataset, ImageDataset
 from .dataset_factory import create_dataset
-from .loader import ThreadedLoader, create_loader
+from .loader import StreamingLoader, ThreadedLoader, create_loader
+from .readers_streaming import ReaderImageInTar, ReaderTfds, ReaderWds, assign_shards
 from .mixup import FastCollateMixup, Mixup
 from .naflex_loader import NaFlexCollator, NaFlexLoader, calculate_naflex_batch_size, create_naflex_loader
 from .random_erasing import RandomErasing

@@ -67,6 +67,43 @@ class ImageDataset:
         return self.reader.filenames(basename, absolute)
 
 
+class IterableImageDataset:
+    """Wraps an iterable (streaming) reader with transforms
+    (reference dataset.py IterableImageDataset)."""
+
+    def __init__(
+            self,
+            root: str,
+            reader=None,
+            transform: Optional[Callable] = None,
+            target_transform: Optional[Callable] = None,
+            **kwargs,
+    ):
+        assert reader is not None, 'IterableImageDataset requires a constructed streaming reader'
+        self.reader = reader
+        self.transform = transform
+        self.target_transform = target_transform
+
+    def __iter__(self):
+        for img, target in self.reader:
+            if self.transform is not None:
+                img = self.transform(img)
+            if self.target_transform is not None:
+                target = self.target_transform(target)
+            yield img, target
+
+    def __len__(self):
+        return len(self.reader)
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.reader, 'set_epoch'):
+            self.reader.set_epoch(epoch)
+
+    def set_worker_info(self, worker_id: int, num_workers: int):
+        if hasattr(self.reader, 'set_worker_info'):
+            self.reader.set_worker_info(worker_id, num_workers)
+
+
 class AugMixDataset:
     """Returns (clean, aug1..augN) tuples for JSD training
     (reference dataset.py:170)."""

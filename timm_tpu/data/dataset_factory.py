@@ -78,10 +78,41 @@ def create_dataset(
     name = name or ''
     if name.startswith('hfds/'):
         return HfdsWrapper(name[5:], root, split, **{k: kwargs[k] for k in ('input_key', 'target_key') if k in kwargs})
-    if name.startswith(('tfds/', 'wds/', 'hfids/', 'torch/')):
+    if name.startswith('wds/'):
+        import jax
+        from .dataset import IterableImageDataset
+        from .readers_streaming import ReaderWds
+        reader = ReaderWds(
+            root=name[4:] if name[4:] else root,
+            split=split,
+            is_training=is_training,
+            seed=kwargs.get('seed', 42),
+            input_img_mode=input_img_mode,
+            input_key=kwargs.get('input_key'),
+            target_key=kwargs.get('target_key'),
+            dist_rank=jax.process_index(),
+            dist_num_replicas=jax.process_count(),
+        )
+        return IterableImageDataset(root, reader=reader)
+    if name.startswith('tfds/'):
+        import jax
+        from .dataset import IterableImageDataset
+        from .readers_streaming import ReaderTfds
+        reader = ReaderTfds(
+            root=root, name=name[5:], split=split, is_training=is_training,
+            seed=kwargs.get('seed', 42), input_img_mode=input_img_mode,
+            dist_rank=jax.process_index(), dist_num_replicas=jax.process_count(),
+        )
+        return IterableImageDataset(root, reader=reader)
+    if name.startswith(('hfids/', 'torch/')):
         raise NotImplementedError(
-            f'Dataset scheme {name.split("/")[0]} is not wired up yet; use a folder dataset or hfds/.')
-    # folder / tar default
+            f'Dataset scheme {name.split("/")[0]} is not wired up yet; use folder, wds/, tfds/ or hfds/.')
+    # tar file(s): map-style reader over image members
+    if root and (str(root).endswith('.tar') or name == 'tar'):
+        from .readers_streaming import ReaderImageInTar
+        reader = ReaderImageInTar(root, class_map=class_map or '', input_img_mode=input_img_mode)
+        return ImageDataset(root, reader=reader, split=split, input_img_mode=input_img_mode)
+    # folder default
     if search_split and root and os.path.isdir(root):
         root = _search_split(root, split)
     return ImageDataset(

@@ -24,7 +24,142 @@ from .constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
 from .random_erasing import RandomErasing
 from .transforms_factory import create_transform
 
-__all__ = ['create_loader', 'ThreadedLoader']
+__all__ = ['create_loader', 'StreamingLoader', 'ThreadedLoader']
+
+
+class StreamingLoader:
+    """Batch loader over an ITERABLE dataset (wds/tfds streaming readers).
+
+    The reader owns shard assignment (process x worker). This loader runs a
+    producer thread that decodes/augments ahead of the consumer through a
+    bounded prefetch queue (overlapping input work with the device step),
+    applies RandomErasing post-collate like ThreadedLoader, and — when the
+    reader's sample count is known — EQUALIZES batches across hosts: every
+    host emits exactly `len(self)` batches per epoch, cycling its stream if
+    its shard slice runs short (the streaming analogue of the padded
+    distributed sampler). With an unknown length, batches stream until the
+    reader is exhausted (single-host only; multi-host needs the count to
+    stay in lockstep).
+    """
+
+    def __init__(
+            self,
+            dataset,
+            batch_size: int,
+            is_training: bool = False,
+            drop_last: Optional[bool] = None,
+            prefetch: int = 4,
+            re_prob: float = 0.0,
+            re_mode: str = 'const',
+            re_count: int = 1,
+            re_num_splits: int = 0,
+            mean=IMAGENET_DEFAULT_MEAN,
+            std=IMAGENET_DEFAULT_STD,
+            process_index: int = 0,
+            process_count: int = 1,
+            **kwargs,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.is_training = is_training
+        self.drop_last = is_training if drop_last is None else drop_last
+        self.prefetch = prefetch
+        self.epoch = 0
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.random_erasing = RandomErasing(
+            probability=re_prob, mode=re_mode, min_count=re_count,
+            num_splits=re_num_splits, mean=self.mean, std=self.std) if re_prob > 0 and is_training else None
+        self.process_index = process_index
+        self.process_count = process_count
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        if hasattr(self.dataset, 'set_epoch'):
+            self.dataset.set_epoch(epoch)
+
+    def _num_batches(self) -> Optional[int]:
+        try:
+            n = len(self.dataset)
+        except TypeError:
+            return None
+        per_host = n // self.process_count if self.process_count > 1 else n
+        if self.drop_last:
+            return max(per_host // self.batch_size, 1)
+        return max(-(-per_host // self.batch_size), 1)
+
+    def __len__(self):
+        n = self._num_batches()
+        if n is None:
+            raise TypeError(
+                'streaming dataset length unknown (no sample count); '
+                'pass --epoch-size or provide an _info.json sidecar')
+        return n
+
+    def __iter__(self):
+        if hasattr(self.dataset, 'set_epoch'):
+            self.dataset.set_epoch(self.epoch)
+        target_batches = self._num_batches()
+
+        stop = threading.Event()
+        sample_q: 'queue.Queue' = queue.Queue(maxsize=self.prefetch * self.batch_size)
+
+        def producer():
+            try:
+                emitted = 0
+                needed = None if target_batches is None else target_batches * self.batch_size
+                while True:
+                    for sample in self.dataset:
+                        if stop.is_set():
+                            return
+                        sample_q.put(sample)
+                        emitted += 1
+                        if needed is not None and emitted >= needed:
+                            sample_q.put(None)
+                            return
+                    if needed is None or emitted == 0:
+                        break  # unknown length: single pass; empty stream: avoid spin
+                    # shard slice ran short of the equalized count: cycle
+                    if hasattr(self.dataset, 'set_epoch'):
+                        self.dataset.set_epoch(self.epoch + 1000 + emitted)
+            except Exception as e:
+                sample_q.put(e)
+                return
+            sample_q.put(None)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+
+        batch_imgs, batch_targets = [], []
+        try:
+            while True:
+                item = sample_q.get()
+                if item is None:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                img, target = item
+                batch_imgs.append(img)
+                batch_targets.append(target)
+                if len(batch_imgs) == self.batch_size:
+                    yield self._collate(batch_imgs, batch_targets)
+                    batch_imgs, batch_targets = [], []
+            if batch_imgs and not self.drop_last:
+                yield self._collate(batch_imgs, batch_targets)
+        finally:
+            stop.set()
+            try:
+                while True:
+                    sample_q.get_nowait()
+            except queue.Empty:
+                pass
+
+    def _collate(self, imgs, targets):
+        x = np.stack(imgs)
+        t = np.asarray(targets)
+        if self.random_erasing is not None:
+            x = self.random_erasing(x)
+        return x, t
 
 
 class ThreadedLoader:
@@ -280,13 +415,10 @@ def create_loader(
         separate=num_aug_splits > 0,
     )
 
-    return ThreadedLoader(
-        dataset,
+    loader_kwargs = dict(
         batch_size=batch_size,
         is_training=is_training,
-        num_workers=num_workers,
         drop_last=drop_last,
-        seed=seed,
         re_prob=re_prob,
         re_mode=re_mode,
         re_count=re_count,
@@ -295,4 +427,13 @@ def create_loader(
         std=std,
         process_index=jax.process_index(),
         process_count=jax.process_count(),
+    )
+    if not hasattr(dataset, '__getitem__'):
+        # iterable (streaming) dataset: the reader owns shard assignment
+        return StreamingLoader(dataset, **loader_kwargs)
+    return ThreadedLoader(
+        dataset,
+        num_workers=num_workers,
+        seed=seed,
+        **loader_kwargs,
     )

@@ -92,6 +92,8 @@ def make_parser():
     group.add_argument('--warmup-lr', type=float, default=1e-5, metavar='LR')
     group.add_argument('--min-lr', type=float, default=0, metavar='LR')
     group.add_argument('--epochs', type=int, default=300, metavar='N')
+    group.add_argument('--epoch-size', type=int, default=0, metavar='N',
+                       help='samples per epoch when the loader length is unknown (streaming datasets)')
     group.add_argument('--epoch-repeats', type=float, default=0.0, metavar='N')
     group.add_argument('--start-epoch', default=None, type=int, metavar='N')
     group.add_argument('--decay-milestones', default=[90, 180, 270], type=int, nargs='+', metavar='MILESTONES')
@@ -405,7 +407,16 @@ def main():
                 label_smoothing=args.smoothing, num_classes=args.num_classes)
 
     # scheduler
-    updates_per_epoch = (len(loader_train) + args.grad_accum_steps - 1) // args.grad_accum_steps
+    try:
+        steps_per_epoch = len(loader_train)
+    except TypeError:
+        # streaming dataset with unknown length: --epoch-size defines the epoch
+        if not args.epoch_size:
+            raise ValueError(
+                'streaming dataset has no known length; pass --epoch-size N '
+                '(samples per epoch) or provide an _info.json shard sidecar')
+        steps_per_epoch = max(args.epoch_size // args.batch_size, 1)
+    updates_per_epoch = (steps_per_epoch + args.grad_accum_steps - 1) // args.grad_accum_steps
     lr_scheduler, num_epochs = create_scheduler_v2(
         base_lr=args.lr,
         **{k: v for k, v in scheduler_kwargs(args).items() if k != 'num_epochs'},

@@ -100,3 +100,110 @@ def test_naflex_loader_buckets(tmp_path):
         assert batch['patch_valid'].any(axis=1).all()  # every row has tokens
         seen.add(batch['seq_len'])
     assert seen  # produced at least one batch
+
+
+def test_naflex_mixup_lam_math():
+    """Mixed-target loss math: lam-weighted per-sample CE on padded batches
+    must equal the hand-computed mix of one-hot CE terms."""
+    import jax
+    import jax.numpy as jnp
+    from flax import nnx
+    import timm_tpu
+    from timm_tpu.task.classification import NaFlexClassificationTask
+    import optax
+
+    m = timm_tpu.create_model('test_naflexvit', num_classes=7)
+    m.train()
+    task = NaFlexClassificationTask(m, optimizer=None)
+
+    rng = np.random.RandomState(0)
+    B, L, pd = 4, 16, 16 * 16 * 3
+    batch = {
+        'patches': jnp.asarray(rng.rand(B, L, pd), jnp.float32),
+        'patch_coord': jnp.asarray(rng.randint(0, 4, (B, L, 2))),
+        'patch_valid': jnp.asarray(np.arange(L)[None, :] < np.array([8, 16, 12, 16])[:, None]),
+        'target': jnp.asarray([0, 1, 2, 3]),
+        'target_b': jnp.asarray([3, 2, 1, 0]),
+        'lam': jnp.asarray([1.0, 0.25, 0.5, 0.75], jnp.float32),
+    }
+    loss, output = task.loss_forward(m, batch)
+    logprobs = jax.nn.log_softmax(np.asarray(output, np.float64))
+    expect = 0.0
+    for i in range(B):
+        la = -logprobs[i, int(batch['target'][i])]
+        lb = -logprobs[i, int(batch['target_b'][i])]
+        lam = float(batch['lam'][i])
+        expect += lam * la + (1 - lam) * lb
+    expect /= B
+    assert abs(float(loss) - expect) < 1e-4
+
+
+def test_naflex_mix_batch_variable_size():
+    from timm_tpu.data.naflex_mixup import mix_batch_variable_size
+    rng = np.random.RandomState(0)
+    imgs = [rng.rand(h, w, 3).astype(np.float32)
+            for h, w in ((32, 48), (48, 32), (40, 40), (32, 32))]
+    mixed, lams, pair_to = mix_batch_variable_size(imgs, mixup_alpha=0.8, cutmix_alpha=0.0)
+    assert len(mixed) == 4 and len(lams) == 4
+    for i, (m, o) in enumerate(zip(mixed, imgs)):
+        assert m.shape == o.shape, 'mixing must preserve each sample shape'
+        assert 0.0 <= lams[i] <= 1.0
+    # every paired sample actually changed
+    for i, j in pair_to.items():
+        assert not np.allclose(mixed[i], imgs[i])
+
+
+def test_naflex_random_erasing_token_space():
+    from timm_tpu.data.naflex_loader import NaFlexRandomErasing, patchify_np
+    rng = np.random.RandomState(0)
+    arr = rng.rand(64, 48, 3).astype(np.float32)
+    p, c = patchify_np(arr, 16)
+    re = NaFlexRandomErasing(probability=1.0, mode='const')
+    p2 = re(p, c)
+    erased = (p2 == 0).all(axis=1)
+    assert erased.any(), 'some patches must be erased'
+    assert not erased.all(), 'not every patch may be erased'
+    # erased patches form a rectangle in grid coords
+    ys, xs = c[erased, 0], c[erased, 1]
+    assert len(set(ys)) * len(set(xs)) == erased.sum()
+
+
+def test_naflex_variable_patch_size_forward():
+    import jax.numpy as jnp
+    import timm_tpu
+    m = timm_tpu.create_model('test_naflexvit', num_classes=5)
+    m.eval()
+    rng = np.random.RandomState(0)
+    for P in (8, 16):
+        pd = P * P * 3
+        out = m({
+            'patches': jnp.asarray(rng.rand(2, 16, pd), jnp.float32),
+            'patch_coord': jnp.asarray(rng.randint(0, 4, (2, 16, 2))),
+            'patch_valid': jnp.asarray(np.ones((2, 16), bool)),
+        })
+        assert out.shape == (2, 5)
+        assert bool(jnp.isfinite(out).all())
+
+
+def test_naflex_loader_mixup_and_patch_choices(tmp_path):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for cls in ('a', 'b'):
+        d = tmp_path / 'train' / cls
+        d.mkdir(parents=True)
+        for i in range(8):
+            Image.fromarray(rng.randint(0, 255, (48 + 8 * i, 56, 3), np.uint8)).save(d / f'{i}.jpg')
+    from timm_tpu.data import create_dataset
+    from timm_tpu.data.naflex_loader import create_naflex_loader
+    ds = create_dataset('', root=str(tmp_path), split='train')
+    loader = create_naflex_loader(
+        ds, patch_size=16, patch_size_choices=(8, 16), train_seq_lens=(16, 25),
+        max_seq_len=25, batch_size=4, is_training=True,
+        mixup_alpha=0.8, cutmix_alpha=1.0, re_prob=0.5)
+    seen_pd = set()
+    for batch in loader:
+        assert 'lam' in batch and 'target_b' in batch
+        assert batch['lam'].shape == batch['target'].shape
+        assert ((batch['lam'] >= 0) & (batch['lam'] <= 1)).all()
+        seen_pd.add(batch['patches'].shape[-1])
+    assert seen_pd <= {8 * 8 * 3, 16 * 16 * 3} and seen_pd

@@ -30,16 +30,17 @@ __all__ = ['create_loader', 'StreamingLoader', 'ThreadedLoader']
 class StreamingLoader:
     """Batch loader over an ITERABLE dataset (wds/tfds streaming readers).
 
-    The reader owns shard assignment (process x worker). This loader runs a
-    producer thread that decodes/augments ahead of the consumer through a
-    bounded prefetch queue (overlapping input work with the device step),
-    applies RandomErasing post-collate like ThreadedLoader, and — when the
-    reader's sample count is known — EQUALIZES batches across hosts: every
-    host emits exactly `len(self)` batches per epoch, cycling its stream if
-    its shard slice runs short (the streaming analogue of the padded
-    distributed sampler). With an unknown length, batches stream until the
-    reader is exhausted (single-host only; multi-host needs the count to
-    stay in lockstep).
+    The reader owns shard assignment (process x worker). During training with
+    `num_workers > 1` and a worker-aware reader (set_worker_info), N producer
+    threads each stream a worker-strided copy of the reader and decode/augment
+    in parallel; otherwise a single producer thread prefetches ahead of the
+    consumer. Either way a bounded queue overlaps input work with the device
+    step. RandomErasing applies post-collate like ThreadedLoader. For
+    multi-host runs with a known sample count, batch counts are EQUALIZED:
+    every host emits exactly `len(self)` batches per epoch, cycling its
+    stream if its shard slice runs short (the streaming analogue of the
+    padded distributed sampler). Single-host streams naturally (short final
+    batch on eval).
     """
 
     def __init__(
@@ -48,6 +49,7 @@ class StreamingLoader:
             batch_size: int,
             is_training: bool = False,
             drop_last: Optional[bool] = None,
+            num_workers: int = 1,
             prefetch: int = 4,
             re_prob: float = 0.0,
             re_mode: str = 'const',
@@ -63,6 +65,7 @@ class StreamingLoader:
         self.batch_size = batch_size
         self.is_training = is_training
         self.drop_last = is_training if drop_last is None else drop_last
+        self.num_workers = max(1, num_workers)
         self.prefetch = prefetch
         self.epoch = 0
         self.mean = np.asarray(mean, np.float32)
@@ -99,36 +102,80 @@ class StreamingLoader:
     def __iter__(self):
         if hasattr(self.dataset, 'set_epoch'):
             self.dataset.set_epoch(self.epoch)
-        target_batches = self._num_batches()
+        # single host: no lockstep requirement — stream naturally (short final
+        # batch on eval, like ThreadedLoader). Multi-host: equalize counts.
+        target_batches = self._num_batches() if self.process_count > 1 or self.drop_last else None
 
         stop = threading.Event()
         sample_q: 'queue.Queue' = queue.Queue(maxsize=self.prefetch * self.batch_size)
 
-        def producer():
+        def _streams():
+            """One iterable per producer thread; multi-worker splits the
+            reader by worker stride when the reader supports it."""
+            reader = getattr(self.dataset, 'reader', None)
+            transform = getattr(self.dataset, 'transform', None)
+            if (self.is_training and self.num_workers > 1 and reader is not None
+                    and hasattr(reader, 'set_worker_info')):
+                import copy
+
+                def stream(worker_reader):
+                    for img, target in worker_reader:
+                        if transform is not None:
+                            img = transform(img)
+                        yield img, target
+
+                out = []
+                for w in range(self.num_workers):
+                    r = copy.copy(reader)
+                    r.set_worker_info(w, self.num_workers)
+                    out.append(stream(r))
+                return out
+            return [iter(self.dataset)]
+
+        needed = None if target_batches is None else target_batches * self.batch_size
+        emitted_lock = threading.Lock()
+        state = {'emitted': 0, 'live': 0}
+
+        def producer(make_stream, restartable):
             try:
-                emitted = 0
-                needed = None if target_batches is None else target_batches * self.batch_size
                 while True:
-                    for sample in self.dataset:
+                    for sample in make_stream():
                         if stop.is_set():
                             return
+                        with emitted_lock:
+                            if needed is not None and state['emitted'] >= needed:
+                                return
+                            state['emitted'] += 1
                         sample_q.put(sample)
-                        emitted += 1
-                        if needed is not None and emitted >= needed:
-                            sample_q.put(None)
-                            return
-                    if needed is None or emitted == 0:
-                        break  # unknown length: single pass; empty stream: avoid spin
+                    with emitted_lock:
+                        done = needed is None or state['emitted'] == 0 or state['emitted'] >= needed
+                    if done or not restartable:
+                        return
                     # shard slice ran short of the equalized count: cycle
                     if hasattr(self.dataset, 'set_epoch'):
-                        self.dataset.set_epoch(self.epoch + 1000 + emitted)
+                        self.dataset.set_epoch(self.epoch + 1000 + state['emitted'])
             except Exception as e:
                 sample_q.put(e)
-                return
+
+        def run_producers():
+            streams = _streams()
+            threads = []
+            if len(streams) == 1:
+                # single stream restarts by re-iterating the dataset (cycling)
+                t = threading.Thread(
+                    target=producer, args=(lambda: iter(self.dataset), True), daemon=True)
+                t.start()
+                threads.append(t)
+            else:
+                for s in streams:
+                    t = threading.Thread(target=producer, args=(lambda s=s: s, False), daemon=True)
+                    t.start()
+                    threads.append(t)
+            for t in threads:
+                t.join()
             sample_q.put(None)
 
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
+        threading.Thread(target=run_producers, daemon=True).start()
 
         batch_imgs, batch_targets = [], []
         try:
@@ -430,7 +477,7 @@ def create_loader(
     )
     if not hasattr(dataset, '__getitem__'):
         # iterable (streaming) dataset: the reader owns shard assignment
-        return StreamingLoader(dataset, **loader_kwargs)
+        return StreamingLoader(dataset, num_workers=num_workers, **loader_kwargs)
     return ThreadedLoader(
         dataset,
         num_workers=num_workers,

@@ -80,33 +80,90 @@ def patchify_np(arr: np.ndarray, patch_size: int):
     return patches, coord
 
 
+class NaFlexRandomErasing:
+    """Token-space random erasing (reference naflex_random_erasing.py:1):
+    erase a random rectangle of PATCHES using grid coords — applied after
+    patchify, so it composes with any patch size / sequence length."""
+
+    def __init__(self, probability: float = 0.5, min_area: float = 0.02, max_area: float = 1 / 3,
+                 mode: str = 'pixel', rng: Optional[random.Random] = None):
+        self.probability = probability
+        self.min_area = min_area
+        self.max_area = max_area
+        assert mode in ('pixel', 'const')
+        self.mode = mode
+        self.rng = rng or random.Random()
+
+    def __call__(self, patches: np.ndarray, coord: np.ndarray):
+        if self.rng.random() > self.probability:
+            return patches
+        gh = int(coord[:, 0].max()) + 1
+        gw = int(coord[:, 1].max()) + 1
+        area = gh * gw
+        target_area = self.rng.uniform(self.min_area, self.max_area) * area
+        eh = max(1, min(gh, int(round(math.sqrt(target_area)))))
+        ew = max(1, min(gw, int(round(target_area / eh))))
+        top = self.rng.randint(0, gh - eh)
+        left = self.rng.randint(0, gw - ew)
+        mask = ((coord[:, 0] >= top) & (coord[:, 0] < top + eh) &
+                (coord[:, 1] >= left) & (coord[:, 1] < left + ew))
+        patches = patches.copy()
+        if self.mode == 'pixel':
+            # noise drawn from a generator seeded off self.rng → reproducible
+            nrng = np.random.RandomState(self.rng.randrange(2 ** 31))
+            patches[mask] = nrng.randn(int(mask.sum()), patches.shape[1]).astype(patches.dtype)
+        else:
+            patches[mask] = 0.0
+        return patches
+
+
 class NaFlexCollator:
-    """Pad a list of (patches, coord, target) to seq_len
-    (reference naflex_dataset.py:74-153)."""
+    """Pad a list of (patches, coord, target[, target_b, lam]) to seq_len
+    (reference naflex_dataset.py:74-153). When mixup metadata is present the
+    batch carries `target_b` (partner labels) and per-sample `lam` weights."""
 
     def __init__(self, patch_size: int = 16, in_chans: int = 3):
         self.patch_size = patch_size
+        self.in_chans = in_chans
         self.patch_dim = patch_size * patch_size * in_chans
 
-    def __call__(self, samples: List[Tuple[np.ndarray, np.ndarray, int]], seq_len: int) -> Dict:
+    def __call__(self, samples: List[Tuple], seq_len: int, patch_size: Optional[int] = None) -> Dict:
         B = len(samples)
-        patches = np.zeros((B, seq_len, self.patch_dim), np.float32)
+        p_size = patch_size or self.patch_size
+        patch_dim = p_size * p_size * self.in_chans
+        patches = np.zeros((B, seq_len, patch_dim), np.float32)
         coord = np.zeros((B, seq_len, 2), np.int32)
         valid = np.zeros((B, seq_len), bool)
         targets = np.zeros((B,), np.int64)
-        for i, (p, c, t) in enumerate(samples):
+        targets_b = np.zeros((B,), np.int64)
+        lam = np.ones((B,), np.float32)
+        has_mix = False
+        for i, s in enumerate(samples):
+            p, c, t = s[0], s[1], s[2]
             n = min(len(p), seq_len)
             patches[i, :n] = p[:n]
             coord[i, :n] = c[:n]
             valid[i, :n] = True
             targets[i] = t
-        return {
+            if len(s) > 3:
+                targets_b[i] = s[3]
+                lam[i] = s[4]
+                has_mix = True
+            else:
+                targets_b[i] = t
+        out = {
             'patches': patches,
             'patch_coord': coord,
             'patch_valid': valid,
             'seq_len': seq_len,
             'target': targets,
         }
+        if patch_size is not None:
+            out['patch_size'] = p_size
+        if has_mix:
+            out['target_b'] = targets_b
+            out['lam'] = lam
+        return out
 
 
 class NaFlexLoader:
@@ -119,28 +176,52 @@ class NaFlexLoader:
             tokens_per_batch: int = 576 * 64,
             seq_lens: Sequence[int] = (128, 256, 576, 784, 1024),
             patch_size: int = 16,
+            patch_size_choices: Optional[Sequence[int]] = None,
+            patch_size_choice_probs: Optional[Sequence[float]] = None,
             is_training: bool = False,
             mean=IMAGENET_DEFAULT_MEAN,
             std=IMAGENET_DEFAULT_STD,
             interpolation: str = 'bicubic',
             hflip: float = 0.5,
+            mixup_alpha: float = 0.0,
+            cutmix_alpha: float = 0.0,
+            mixup_prob: float = 1.0,
+            mixup_switch_prob: float = 0.5,
+            re_prob: float = 0.0,
+            re_mode: str = 'pixel',
             seed: int = 42,
             process_index: int = 0,
             process_count: int = 1,
+            batch_divisor: int = 1,
     ):
         self.dataset = dataset
         self.tokens_per_batch = tokens_per_batch
         self.seq_lens = tuple(sorted(seq_lens))
         self.patch_size = patch_size
+        self.patch_size_choices = tuple(patch_size_choices) if patch_size_choices else None
+        if self.patch_size_choices and patch_size_choice_probs:
+            assert len(patch_size_choice_probs) == len(self.patch_size_choices)
+            self.patch_size_choice_probs = tuple(patch_size_choice_probs)
+        elif self.patch_size_choices:
+            self.patch_size_choice_probs = (1.0 / len(self.patch_size_choices),) * len(self.patch_size_choices)
+        else:
+            self.patch_size_choice_probs = None
         self.is_training = is_training
         self.mean = np.asarray(mean, np.float32)
         self.std = np.asarray(std, np.float32)
         self.interpolation = interpolation
         self.hflip = RandomHorizontalFlip(hflip) if is_training and hflip > 0 else None
+        self.mixup_alpha = mixup_alpha if is_training else 0.0
+        self.cutmix_alpha = cutmix_alpha if is_training else 0.0
+        self.mixup_prob = mixup_prob
+        self.mixup_switch_prob = mixup_switch_prob
+        self.random_erasing = NaFlexRandomErasing(re_prob, mode=re_mode) \
+            if re_prob > 0 and is_training else None
         self.seed = seed
         self.epoch = 0
         self.process_index = process_index
         self.process_count = process_count
+        self.batch_divisor = max(1, batch_divisor)
         self.collator = NaFlexCollator(patch_size)
         # dataset must yield PIL images: disable any tensor transform
         if getattr(dataset, 'transform', None) is not None:
@@ -169,10 +250,15 @@ class NaFlexLoader:
             rng.shuffle(indices)
         batches = []
         pos = 0
+        divisor = self.process_count * self.batch_divisor
         while pos < len(indices):
             seq_len = rng.choice(self.seq_lens) if self.is_training else self.seq_lens[-1]
+            if self.is_training and self.patch_size_choices:
+                patch_size = rng.choices(self.patch_size_choices, self.patch_size_choice_probs)[0]
+            else:
+                patch_size = self.patch_size
             bs = calculate_naflex_batch_size(
-                self.tokens_per_batch, seq_len, divisor=self.process_count)
+                self.tokens_per_batch, seq_len, divisor=divisor)
             group = indices[pos:pos + bs]
             pos += bs
             if len(group) < bs:
@@ -182,32 +268,59 @@ class NaFlexLoader:
                 group = group + indices[:bs - len(group)]
             # this host's slice of the global batch
             local = group[self.process_index::self.process_count]
-            batches.append((seq_len, bs // self.process_count, local))
+            batches.append((seq_len, patch_size, bs // self.process_count, local))
         return batches
 
     def __len__(self):
         return len(self._schedule())
 
     def __iter__(self):
-        for seq_len, bs, group in self._schedule():
-            samples = []
+        mix_rng = random.Random(self.seed * 31 + self.epoch)
+        for seq_len, patch_size, bs, group in self._schedule():
+            arrays, targets = [], []
             for idx in group:
                 img, target = self.dataset[idx]
                 if self.hflip is not None:
                     img = self.hflip(img)
-                img = resize_to_seq_len(img, seq_len, self.patch_size, self.interpolation)
+                img = resize_to_seq_len(img, seq_len, patch_size, self.interpolation)
                 arr = np.asarray(img, np.float32) / 255.0
                 if arr.ndim == 2:
                     arr = arr[:, :, None]
                 arr = (arr - self.mean) / self.std
-                p, c = patchify_np(arr, self.patch_size)
-                samples.append((p, c, target))
-            yield self.collator(samples, seq_len)
+                arrays.append(arr)
+                targets.append(target)
+
+            do_mix = ((self.mixup_alpha > 0 or self.cutmix_alpha > 0) and len(arrays) > 1
+                      and mix_rng.random() < self.mixup_prob)
+            if do_mix:
+                from .naflex_mixup import mix_batch_variable_size
+                arrays, lams, pair_to = mix_batch_variable_size(
+                    arrays, mixup_alpha=self.mixup_alpha, cutmix_alpha=self.cutmix_alpha,
+                    switch_prob=self.mixup_switch_prob, rng=mix_rng)
+                samples = []
+                for i, arr in enumerate(arrays):
+                    p, c = patchify_np(arr, patch_size)
+                    if self.random_erasing is not None:
+                        p = self.random_erasing(p, c)
+                    t_b = targets[pair_to[i]] if i in pair_to else targets[i]
+                    samples.append((p, c, targets[i], t_b, lams[i]))
+            else:
+                samples = []
+                for arr, t in zip(arrays, targets):
+                    p, c = patchify_np(arr, patch_size)
+                    if self.random_erasing is not None:
+                        p = self.random_erasing(p, c)
+                    samples.append((p, c, t))
+            yield self.collator(
+                samples, seq_len,
+                patch_size=patch_size if self.patch_size_choices else None)
 
 
 def create_naflex_loader(
         dataset,
         patch_size: int = 16,
+        patch_size_choices: Optional[Sequence[int]] = None,
+        patch_size_choice_probs: Optional[Sequence[float]] = None,
         train_seq_lens: Sequence[int] = (128, 256, 576, 784, 1024),
         max_seq_len: int = 576,
         batch_size: int = 32,  # batch size at max_seq_len → token budget
@@ -216,7 +329,14 @@ def create_naflex_loader(
         std=IMAGENET_DEFAULT_STD,
         interpolation: str = 'bicubic',
         hflip: float = 0.5,
+        mixup_alpha: float = 0.0,
+        cutmix_alpha: float = 0.0,
+        mixup_prob: float = 1.0,
+        mixup_switch_prob: float = 0.5,
+        re_prob: float = 0.0,
+        re_mode: str = 'pixel',
         seed: int = 42,
+        grad_accum_steps: int = 1,
         **kwargs,
 ):
     """(reference naflex_loader.py:225)."""
@@ -228,12 +348,21 @@ def create_naflex_loader(
         tokens_per_batch=tokens_per_batch,
         seq_lens=seq_lens,
         patch_size=patch_size,
+        patch_size_choices=patch_size_choices,
+        patch_size_choice_probs=patch_size_choice_probs,
         is_training=is_training,
         mean=mean,
         std=std,
         interpolation=interpolation,
         hflip=hflip,
+        mixup_alpha=mixup_alpha,
+        cutmix_alpha=cutmix_alpha,
+        mixup_prob=mixup_prob,
+        mixup_switch_prob=mixup_switch_prob,
+        re_prob=re_prob,
+        re_mode=re_mode,
         seed=seed,
         process_index=jax.process_index(),
         process_count=jax.process_count(),
+        batch_divisor=max(1, grad_accum_steps),
     )

@@ -90,6 +90,7 @@ class NaFlexEmbeds(nnx.Module):
     ):
         assert pos_embed in ('factorized', 'learn', 'none')
         self.patch_size = patch_size
+        self.in_chans = in_chans
         self.embed_dim = embed_dim
         self.max_grid_size = max_grid_size
         self.pos_embed_type = pos_embed
@@ -120,9 +121,25 @@ class NaFlexEmbeds(nnx.Module):
             self.pos_embed_grid = self.pos_embed_y = self.pos_embed_x = None
         self.pos_drop = Dropout(pos_drop_rate, rngs=rngs)
 
-    def __call__(self, patches, patch_coord):
+    def _proj(self, patches, patch_size: Optional[int]):
+        if patch_size is None or patch_size == self.patch_size:
+            return self.proj(patches)
+        # variable patch size: PI-resample the projection kernel to the
+        # batch's patch size at trace time (static per bucket — FlexiViT-style,
+        # reference naflexvit.py resample_patch_embed path)
+        from ..layers.patch_embed import resample_patch_embed
+        P, C, D = self.patch_size, self.in_chans, self.embed_dim
+        kernel = self.proj.kernel[...].reshape(P, P, C, D)
+        kernel = resample_patch_embed(kernel, (patch_size, patch_size))
+        kernel = kernel.reshape(patch_size * patch_size * C, D)
+        y = patches @ kernel.astype(patches.dtype)
+        if self.proj.bias is not None:
+            y = y + self.proj.bias[...].astype(y.dtype)
+        return y
+
+    def __call__(self, patches, patch_coord, patch_size: Optional[int] = None):
         # patches (B, L, P*P*C), patch_coord (B, L, 2) int
-        x = self.proj(patches)
+        x = self._proj(patches, patch_size)
         B, L, D = x.shape
         yy = jnp.clip(patch_coord[..., 0], 0, self.max_grid_size - 1)
         xx = jnp.clip(patch_coord[..., 1], 0, self.max_grid_size - 1)
@@ -264,8 +281,8 @@ class NaFlexVit(nnx.Module):
             dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
 
     # -- forward -------------------------------------------------------------
-    def forward_features(self, patches, patch_coord, patch_valid=None):
-        x = self.embeds(patches, patch_coord)
+    def forward_features(self, patches, patch_coord, patch_valid=None, patch_size=None):
+        x = self.embeds(patches, patch_coord, patch_size=patch_size)
         attn_mask = None
         if patch_valid is not None:
             attn_mask = create_attention_mask(
@@ -303,7 +320,15 @@ class NaFlexVit(nnx.Module):
             patches, patch_coord, patch_valid = d['patches'], d['patch_coord'], d.get('patch_valid')
         elif patches.ndim == 4:
             patches, patch_coord, patch_valid = patchify_image(patches, self.embeds.patch_size)
-        x = self.forward_features(patches, patch_coord, patch_valid)
+        # variable patch size is derived STATICALLY from the patch dim (shape),
+        # so each (seq_len, patch_size) bucket traces its own program — no
+        # dependence on traced ints in the batch dict
+        patch_size = None
+        pd = patches.shape[-1]
+        if pd != self.embeds.patch_size ** 2 * self.embeds.in_chans:
+            import math as _math
+            patch_size = int(_math.isqrt(pd // self.embeds.in_chans))
+        x = self.forward_features(patches, patch_coord, patch_valid, patch_size=patch_size)
         return self.forward_head(x, patch_valid)
 
     def forward_intermediates(

@@ -32,7 +32,31 @@ class ClassificationTask(TrainingTask):
 
 class NaFlexClassificationTask(ClassificationTask):
     """Classification over NaFlex dict batches ({patches, patch_coord,
-    patch_valid, target}); each seq-len bucket traces once."""
+    patch_valid, target[, target_b, lam]}); each (seq_len, patch_size)
+    bucket traces once. When the loader performed variable-size mixup/cutmix,
+    the per-sample lam-mixed (and optionally smoothed) soft target
+    distribution is built here and fed to the CONFIGURED train loss
+    (SoftTargetCrossEntropy, BCE, ... — anything accepting dense targets),
+    mirroring how the reference's Mixup builds soft labels for the tuple
+    pipeline (reference mixup.py mixup_target)."""
+
+    def __init__(self, *args, mixup_label_smoothing: Optional[float] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        # not-None ⇒ the train loss expects DENSE targets (mixup configured);
+        # un-mixed batches then get smoothed one-hot targets too
+        self.mixup_label_smoothing = mixup_label_smoothing
+
+    def _soft_targets(self, batch, nc):
+        import jax.numpy as jnp
+        s = self.mixup_label_smoothing or 0.0
+        off, on = s / nc, 1.0 - s + s / nc
+        B = batch['target'].shape[0]
+        oh_a = jnp.full((B, nc), off).at[jnp.arange(B), batch['target']].set(on)
+        if 'lam' not in batch:
+            return oh_a
+        oh_b = jnp.full((B, nc), off).at[jnp.arange(B), batch['target_b']].set(on)
+        lam = batch['lam'].astype(jnp.float32)[:, None]
+        return lam * oh_a + (1.0 - lam) * oh_b
 
     def loss_forward(self, model: nnx.Module, batch: Dict[str, Any]):
         output = model({
@@ -40,7 +64,10 @@ class NaFlexClassificationTask(ClassificationTask):
             'patch_coord': batch['patch_coord'],
             'patch_valid': batch['patch_valid'],
         })
-        loss = self.train_loss_fn(output, batch['target'])
+        if self.mixup_label_smoothing is not None or 'lam' in batch:
+            loss = self.train_loss_fn(output, self._soft_targets(batch, output.shape[-1]))
+        else:
+            loss = self.train_loss_fn(output, batch['target'])
         return loss, output
 
     def eval_forward(self, model: nnx.Module, batch: Dict[str, Any]):

@@ -126,12 +126,17 @@ class TrainingTask:
                 return loss.astype(jnp.float32)
 
             if accum > 1:
-                microbatches = jax.tree.map(
-                    lambda x: x.reshape(accum, -1, *x.shape[1:]), batch)
+                # scalar leaves (e.g. NaFlex seq_len/patch_size metadata) are
+                # broadcast to every microbatch rather than reshaped
+                def _split(x):
+                    return x.reshape(accum, -1, *x.shape[1:]) if getattr(x, 'ndim', 0) >= 1 else x
+
+                microbatches = jax.tree.map(_split, batch)
                 loss = jnp.zeros((), jnp.float32)
                 grads = None
                 for i in range(accum):
-                    mb = jax.tree.map(lambda x: x[i], microbatches)
+                    mb = jax.tree.map(
+                        lambda x: x[i] if getattr(x, 'ndim', 0) >= 2 else x, microbatches)
                     l_i, g_i = nnx.value_and_grad(loss_fn)(model, mb)
                     loss = loss + l_i
                     grads = g_i if grads is None else jax.tree.map(jnp.add, grads, g_i)

@@ -152,6 +152,8 @@ def make_parser():
     group.add_argument('--naflex-loader', action='store_true', help='token-budget variable-res training')
     group.add_argument('--naflex-train-seq-lens', type=int, nargs='+', default=[128, 256, 576, 784, 1024])
     group.add_argument('--naflex-max-seq-len', type=int, default=576)
+    group.add_argument('--naflex-patch-sizes', type=int, nargs='+', default=None,
+                       help='variable patch sizes sampled per train batch (e.g. 8 12 16)')
     return parser
 
 
@@ -280,17 +282,16 @@ def main():
     norm_mean = data_config['mean']
     norm_std = data_config['std']
     if args.naflex_loader:
-        if args.grad_accum_steps > 1:
-            raise ValueError('--naflex-loader does not support --grad-accum-steps > 1 '
-                             '(token-budget batch sizes are not accumulation-divisible)')
-        if args.mixup > 0 or args.cutmix > 0:
-            raise NotImplementedError('--naflex-loader does not support mixup/cutmix yet')
         from timm_tpu.task import NaFlexClassificationTask
         task_cls = NaFlexClassificationTask
         # NaFlex batches are normalized host-side by the loader
         norm_mean = norm_std = None
     else:
         task_cls = ClassificationTask
+    task_kwargs = {}
+    if args.naflex_loader and (args.mixup > 0 or args.cutmix > 0):
+        # smoothing folds into the soft mixed targets (reference mixup_target)
+        task_kwargs['mixup_label_smoothing'] = args.smoothing
     task = task_cls(
         model,
         optimizer=optimizer,
@@ -300,6 +301,7 @@ def main():
         clip_mode=args.clip_mode,
         mean=norm_mean,
         std=norm_std,
+        **task_kwargs,
     )
 
     # loss selection (ref train.py:886-913)
@@ -335,11 +337,16 @@ def main():
             args.dataset, root=args.data_dir, split=args.val_split, class_map=args.class_map)
         loader_train = create_naflex_loader(
             dataset_train, patch_size=patch_size,
+            patch_size_choices=tuple(args.naflex_patch_sizes) if args.naflex_patch_sizes else None,
             train_seq_lens=tuple(args.naflex_train_seq_lens),
             max_seq_len=args.naflex_max_seq_len,
             batch_size=args.batch_size, is_training=True,
             mean=data_config['mean'], std=data_config['std'],
-            interpolation=data_config['interpolation'], hflip=args.hflip, seed=args.seed)
+            interpolation=data_config['interpolation'], hflip=args.hflip,
+            mixup_alpha=args.mixup, cutmix_alpha=args.cutmix,
+            mixup_prob=args.mixup_prob, mixup_switch_prob=args.mixup_switch_prob,
+            re_prob=args.reprob, re_mode='pixel' if args.remode == 'pixel' else 'const',
+            seed=args.seed, grad_accum_steps=args.grad_accum_steps)
         loader_eval = create_naflex_loader(
             dataset_eval, patch_size=patch_size,
             max_seq_len=args.naflex_max_seq_len,
@@ -416,7 +423,12 @@ def main():
                 'streaming dataset has no known length; pass --epoch-size N '
                 '(samples per epoch) or provide an _info.json shard sidecar')
         steps_per_epoch = max(args.epoch_size // args.batch_size, 1)
-    updates_per_epoch = (steps_per_epoch + args.grad_accum_steps - 1) // args.grad_accum_steps
+    if args.naflex_loader:
+        # each NaFlex loader batch is one update (accumulation happens INSIDE
+        # task.train_step over microbatches of the accum-scaled batch)
+        updates_per_epoch = steps_per_epoch
+    else:
+        updates_per_epoch = (steps_per_epoch + args.grad_accum_steps - 1) // args.grad_accum_steps
     lr_scheduler, num_epochs = create_scheduler_v2(
         base_lr=args.lr,
         **{k: v for k, v in scheduler_kwargs(args).items() if k != 'num_epochs'},
@@ -505,10 +517,12 @@ def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
     log_t0 = time.time()
     for batch_idx, batch_data in enumerate(loader):
         if isinstance(batch_data, dict):
-            # NaFlex dict batch: one update per batch, no accumulation/mixup
+            # NaFlex dict batch; scalar metadata (seq_len/patch_size) stays on
+            # host — the model derives the patch size from the patch dim shape
             n = batch_data['patches'].shape[0]
             batch = shard_batch(
-                {k: jnp.asarray(v) for k, v in batch_data.items() if k != 'seq_len'}, mesh)
+                {k: jnp.asarray(v) for k, v in batch_data.items()
+                 if k not in ('seq_len', 'patch_size')}, mesh)
             metrics = task.train_step(batch, lr=lr, step=num_updates)
             num_updates += 1
             samples_since_log += n

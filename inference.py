@@ -32,10 +32,14 @@ parser.add_argument('--crop-pct', default=None, type=float)
 parser.add_argument('--crop-mode', default=None, type=str)
 parser.add_argument('--num-classes', type=int, default=None)
 parser.add_argument('--class-map', default='', type=str)
-parser.add_argument('--label-type', default='index', type=str, choices=['index', 'name'],
-                    help="'name' uses dataset class-folder names when available")
+parser.add_argument('--label-type', default='index', type=str,
+                    choices=['index', 'name', 'description', 'detail'],
+                    help="'name'/'description' resolve ImageNet synsets/lemmas from bundled "
+                         'class metadata (falling back to dataset class-folder names)')
 parser.add_argument('-j', '--workers', default=4, type=int)
 parser.add_argument('--amp', action='store_true', default=False)
+parser.add_argument('--device', default=None, type=str,
+                    help="jax platform override (e.g. 'cpu'); must be set before first device op")
 parser.add_argument('--topk', default=1, type=int, metavar='N')
 parser.add_argument('--fullname', action='store_true', default=False)
 parser.add_argument('--outputs-name', default=None)
@@ -54,6 +58,10 @@ def main():
     setup_default_logging()
     args = parser.parse_args()
 
+    if args.device:
+        # must land before the first device op (model init); env JAX_PLATFORMS
+        # loses to the axon plugin's sitecustomize registration
+        jax.config.update('jax_platforms', args.device)
     dtype = jnp.bfloat16 if args.amp else None
     try:
         model = timm_tpu.create_model(
@@ -112,12 +120,24 @@ def main():
     probs = np.concatenate(all_probs)
     filenames = dataset.filenames(basename=not args.fullname)[:num]
 
-    idx_to_name = None
-    if args.label_type == 'name' and hasattr(dataset, 'reader') and hasattr(dataset.reader, 'class_to_idx'):
-        idx_to_name = {v: k for k, v in dataset.reader.class_to_idx.items()}
+    to_label = None
+    if args.label_type in ('name', 'description', 'detail'):
+        # prefer the model's ImageNet label space (reference inference.py:213)
+        from timm_tpu.data.dataset_info import ImageNetInfo, infer_imagenet_subset
+        subset = infer_imagenet_subset({'num_classes': args.num_classes or model.num_classes})
+        if subset is not None:
+            info = ImageNetInfo(subset)
+            if args.label_type == 'name':
+                to_label = info.index_to_label_name
+            else:
+                from functools import partial
+                to_label = partial(info.index_to_description, detailed=args.label_type == 'detail')
+        elif hasattr(dataset, 'reader') and hasattr(dataset.reader, 'class_to_idx'):
+            idx_to_name = {v: k for k, v in dataset.reader.class_to_idx.items()}
+            to_label = lambda i: idx_to_name.get(i, i)
 
     def _label(i: int):
-        return idx_to_name.get(i, i) if idx_to_name is not None else int(i)
+        return to_label(int(i)) if to_label is not None else int(i)
 
     rows = []
     for fn, ind, prb in zip(filenames, indices, probs):

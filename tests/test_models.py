@@ -59,6 +59,55 @@ def test_model_forward_slow(model_name):
     assert bool(jnp.isfinite(out).all())
 
 
+# one small representative per family for gradient coverage (reference
+# tests/test_models.py:213 runs backward over every model; we cover every
+# FAMILY with its smallest member to keep CPU wall time bounded)
+FAMILY_BACKWARD_MODELS = [
+    'vit_tiny_patch16_224', 'deit_tiny_distilled_patch16_224', 'eva02_tiny_patch14_336',
+    'beit_base_patch16_224', 'cait_xxs24_224', 'xcit_nano_12_p16_224',
+    'levit_128s', 'volo_d1_224', 'mvitv2_tiny', 'swin_tiny_patch4_window7_224',
+    'swinv2_tiny_window8_256', 'coatnet_pico_rw_224', 'maxvit_pico_rw_256',
+    'mixer_s32_224', 'convnext_atto', 'resnet18', 'resnetv2_50', 'nf_resnet50',
+    'regnetx_002', 'vgg11', 'densenet121', 'efficientnet_lite0',
+    'mobilenetv3_small_100', 'mnasnet_050', 'lcnet_035', 'gernet_s',
+    'halonet26t', 'lambda_resnet26t', 'botnet26t_256',
+]
+_family_backward = FAMILY_BACKWARD_MODELS
+
+
+# halo blocked attention needs block_size (8) to divide every stage grid
+_BACKWARD_SIZE_OVERRIDES = {'halonet26t': 256}
+
+
+@pytest.mark.backward
+@pytest.mark.parametrize('model_name', _family_backward)
+def test_model_backward_family(model_name):
+    """Gradient sweep, one representative per family (marker: backward)."""
+    cfg = get_pretrained_cfg(model_name)
+    want = _BACKWARD_SIZE_OVERRIDES.get(model_name, 96)
+    try:
+        model = timm_tpu.create_model(model_name, img_size=want, num_classes=5)
+        size = want
+    except TypeError:
+        model = timm_tpu.create_model(model_name, num_classes=5)
+        size = cfg.input_size[-1] if cfg else 224
+    model.train()
+    x = jnp.asarray(np.random.rand(2, size, size, 3), jnp.float32)
+    t = jnp.asarray([0, 1])
+
+    def loss_fn(model):
+        out = model(x)
+        out = out[0] if isinstance(out, tuple) else out
+        return jnp.mean((out - jax.nn.one_hot(t, out.shape[-1])) ** 2)
+
+    grads = nnx.grad(loss_fn)(model)
+    num_params = len(jax.tree.leaves(nnx.state(model, nnx.Param)))
+    num_grads = len([g for g in jax.tree.leaves(grads) if g is not None])
+    assert num_params == num_grads, 'Some params missing gradients'
+    finite = all(bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads))
+    assert finite, 'NaN/Inf gradient'
+
+
 @pytest.mark.base
 @pytest.mark.parametrize('model_name', list_models('test_*'))
 def test_model_backward(model_name):

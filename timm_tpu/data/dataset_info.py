@@ -1,0 +1,117 @@
+"""Dataset class-label metadata (reference: timm/data/dataset_info.py +
+imagenet_info.py + _info/ data files).
+
+The bundled `_info/*.json` files are DATASET METADATA (WordNet synset ids and
+lemmas for the ImageNet label spaces — published facts of the datasets, not
+reference code), re-serialized compactly from the public label lists.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Union
+
+__all__ = ['DatasetInfo', 'ImageNetInfo', 'CustomDatasetInfo', 'infer_imagenet_subset']
+
+_INFO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), '_info')
+
+_SUBSETS = {
+    'imagenet': 'imagenet1k.json',
+    'imagenet1k': 'imagenet1k.json',
+    'imagenet12k': 'imagenet12k.json',
+}
+
+# num_classes → subset name (reference imagenet_info.py infer_imagenet_subset)
+_NUM_CLASSES_TO_SUBSET = {
+    1000: 'imagenet-1k',
+    11821: 'imagenet-12k',
+}
+
+
+def infer_imagenet_subset(model_or_cfg) -> Optional[str]:
+    """Guess the ImageNet label space from a model / pretrained cfg
+    (reference imagenet_info.py:22-42)."""
+    if hasattr(model_or_cfg, 'pretrained_cfg'):
+        num_classes = getattr(model_or_cfg.pretrained_cfg, 'num_classes', None) \
+            or getattr(model_or_cfg, 'num_classes', None)
+    elif isinstance(model_or_cfg, dict):
+        num_classes = model_or_cfg.get('num_classes')
+    else:
+        num_classes = getattr(model_or_cfg, 'num_classes', None)
+    return _NUM_CLASSES_TO_SUBSET.get(num_classes)
+
+
+class DatasetInfo:
+    def num_classes(self) -> int:
+        raise NotImplementedError
+
+    def label_names(self) -> List[str]:
+        raise NotImplementedError
+
+    def index_to_label_name(self, index: int) -> str:
+        raise NotImplementedError
+
+    def index_to_description(self, index: int, detailed: bool = False) -> str:
+        raise NotImplementedError
+
+    def label_name_to_description(self, label: str, detailed: bool = False) -> str:
+        raise NotImplementedError
+
+
+class ImageNetInfo(DatasetInfo):
+    """ImageNet label metadata (reference imagenet_info.py:48-95)."""
+
+    def __init__(self, subset: str = 'imagenet-1k'):
+        key = re.sub(r'[-_\s]', '', subset.lower())
+        assert key in _SUBSETS, f'Unknown imagenet subset {subset}'
+        with open(os.path.join(_INFO_DIR, _SUBSETS[key])) as f:
+            data = json.load(f)
+        self._synsets: List[str] = data['synsets']
+        self._lemmas: Dict[str, str] = data.get('lemmas', {})
+        self._definitions: Dict[str, str] = data.get('definitions', {})
+
+    def num_classes(self) -> int:
+        return len(self._synsets)
+
+    def label_names(self) -> List[str]:
+        return self._synsets
+
+    def index_to_label_name(self, index: int) -> str:
+        assert 0 <= index < len(self._synsets)
+        return self._synsets[index]
+
+    def label_name_to_description(self, label: str, detailed: bool = False) -> str:
+        lemma = self._lemmas.get(label, label)
+        if detailed and label in self._definitions:
+            return f'{lemma}: {self._definitions[label]}'
+        return lemma
+
+    def index_to_description(self, index: int, detailed: bool = False) -> str:
+        return self.label_name_to_description(self.index_to_label_name(index), detailed=detailed)
+
+
+class CustomDatasetInfo(DatasetInfo):
+    """Label metadata from an explicit mapping (reference dataset_info.py)."""
+
+    def __init__(self, label_names: Union[List[str], Dict[int, str]],
+                 label_descriptions: Optional[Dict[str, str]] = None):
+        if isinstance(label_names, dict):
+            label_names = [label_names[i] for i in sorted(label_names)]
+        self._label_names = list(label_names)
+        self._label_descriptions = label_descriptions or {}
+
+    def num_classes(self) -> int:
+        return len(self._label_names)
+
+    def label_names(self) -> List[str]:
+        return self._label_names
+
+    def index_to_label_name(self, index: int) -> str:
+        return self._label_names[index]
+
+    def label_name_to_description(self, label: str, detailed: bool = False) -> str:
+        return self._label_descriptions.get(label, label)
+
+    def index_to_description(self, index: int, detailed: bool = False) -> str:
+        return self.label_name_to_description(self.index_to_label_name(index), detailed=detailed)

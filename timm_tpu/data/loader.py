@@ -109,70 +109,67 @@ class StreamingLoader:
         stop = threading.Event()
         sample_q: 'queue.Queue' = queue.Queue(maxsize=self.prefetch * self.batch_size)
 
-        def _streams():
-            """One iterable per producer thread; multi-worker splits the
-            reader by worker stride when the reader supports it."""
+        def _worker_streams():
+            """Worker-strided reader copies (or None when unsupported)."""
             reader = getattr(self.dataset, 'reader', None)
-            transform = getattr(self.dataset, 'transform', None)
-            if (self.is_training and self.num_workers > 1 and reader is not None
+            if not (self.is_training and self.num_workers > 1 and reader is not None
                     and hasattr(reader, 'set_worker_info')):
-                import copy
+                return None
+            import copy
+            transform = getattr(self.dataset, 'transform', None)
+            target_transform = getattr(self.dataset, 'target_transform', None)
 
-                def stream(worker_reader):
-                    for img, target in worker_reader:
-                        if transform is not None:
-                            img = transform(img)
-                        yield img, target
+            def stream(worker_reader):
+                for img, target in worker_reader:
+                    if transform is not None:
+                        img = transform(img)
+                    if target_transform is not None:
+                        target = target_transform(target)
+                    yield img, target
 
-                out = []
-                for w in range(self.num_workers):
-                    r = copy.copy(reader)
-                    r.set_worker_info(w, self.num_workers)
-                    out.append(stream(r))
-                return out
-            return [iter(self.dataset)]
+            out = []
+            for w in range(self.num_workers):
+                r = copy.copy(reader)
+                r.set_worker_info(w, self.num_workers)
+                out.append(stream(r))
+            return out
 
         needed = None if target_batches is None else target_batches * self.batch_size
         emitted_lock = threading.Lock()
-        state = {'emitted': 0, 'live': 0}
+        state = {'emitted': 0}
 
-        def producer(make_stream, restartable):
+        def producer(stream):
             try:
-                while True:
-                    for sample in make_stream():
-                        if stop.is_set():
-                            return
-                        with emitted_lock:
-                            if needed is not None and state['emitted'] >= needed:
-                                return
-                            state['emitted'] += 1
-                        sample_q.put(sample)
-                    with emitted_lock:
-                        done = needed is None or state['emitted'] == 0 or state['emitted'] >= needed
-                    if done or not restartable:
+                for sample in stream:
+                    if stop.is_set():
                         return
-                    # shard slice ran short of the equalized count: cycle
-                    if hasattr(self.dataset, 'set_epoch'):
-                        self.dataset.set_epoch(self.epoch + 1000 + state['emitted'])
+                    with emitted_lock:
+                        if needed is not None and state['emitted'] >= needed:
+                            return
+                        state['emitted'] += 1
+                    sample_q.put(sample)
             except Exception as e:
                 sample_q.put(e)
 
         def run_producers():
-            streams = _streams()
-            threads = []
-            if len(streams) == 1:
-                # single stream restarts by re-iterating the dataset (cycling)
-                t = threading.Thread(
-                    target=producer, args=(lambda: iter(self.dataset), True), daemon=True)
-                t.start()
-                threads.append(t)
-            else:
+            # outer loop restarts the full stream set when the shard slice
+            # ran short of the equalized count (multi-host lockstep)
+            while True:
+                streams = _worker_streams() or [iter(self.dataset)]
+                threads = []
                 for s in streams:
-                    t = threading.Thread(target=producer, args=(lambda s=s: s, False), daemon=True)
+                    t = threading.Thread(target=producer, args=(s,), daemon=True)
                     t.start()
                     threads.append(t)
-            for t in threads:
-                t.join()
+                for t in threads:
+                    t.join()
+                with emitted_lock:
+                    done = (needed is None or state['emitted'] == 0
+                            or state['emitted'] >= needed)
+                if done or stop.is_set():
+                    break
+                if hasattr(self.dataset, 'set_epoch'):
+                    self.dataset.set_epoch(self.epoch + 1000 + state['emitted'])
             sample_q.put(None)
 
         threading.Thread(target=run_producers, daemon=True).start()

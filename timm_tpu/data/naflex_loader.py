@@ -215,7 +215,8 @@ class NaFlexLoader:
         self.cutmix_alpha = cutmix_alpha if is_training else 0.0
         self.mixup_prob = mixup_prob
         self.mixup_switch_prob = mixup_switch_prob
-        self.random_erasing = NaFlexRandomErasing(re_prob, mode=re_mode) \
+        self.random_erasing = NaFlexRandomErasing(
+            re_prob, mode=re_mode, rng=random.Random(seed * 7919 + 13)) \
             if re_prob > 0 and is_training else None
         self.seed = seed
         self.epoch = 0
@@ -339,9 +340,13 @@ def create_naflex_loader(
         grad_accum_steps: int = 1,
         **kwargs,
 ):
-    """(reference naflex_loader.py:225)."""
+    """(reference naflex_loader.py:225).
+
+    With grad accumulation the token budget scales by the accum steps so the
+    jitted step's microbatches are each `batch_size` — the effective update
+    batch matches the tuple pipeline's global batch (batch_size * accum)."""
     import jax
-    tokens_per_batch = batch_size * max_seq_len
+    tokens_per_batch = batch_size * max(1, grad_accum_steps) * max_seq_len
     seq_lens = train_seq_lens if is_training else (max_seq_len,)
     return NaFlexLoader(
         dataset,

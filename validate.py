@@ -32,6 +32,8 @@ parser.add_argument('--checkpoint', default='', type=str, metavar='PATH')
 parser.add_argument('--use-ema', dest='use_ema', action='store_true')
 parser.add_argument('-b', '--batch-size', default=256, type=int, metavar='N')
 parser.add_argument('--img-size', default=None, type=int, metavar='N')
+parser.add_argument('--device', default=None, type=str,
+                    help="jax platform override (e.g. 'cpu'); must be set before first device op")
 parser.add_argument('--input-size', default=None, nargs=3, type=int, metavar='N N N')
 parser.add_argument('--crop-pct', default=None, type=float, metavar='N')
 parser.add_argument('--crop-mode', default=None, type=str, metavar='N')
@@ -62,11 +64,13 @@ def validate(args):
     from timm_tpu.parallel import create_mesh, set_global_mesh, shard_batch
     from timm_tpu.utils import AverageMeter
 
+    if args.device:
+        # must land before the first device op; env JAX_PLATFORMS loses to the
+        # axon plugin's sitecustomize registration
+        jax.config.update('jax_platforms', args.device)
     mesh = create_mesh()
     set_global_mesh(mesh)
 
-    if args.test_pool:
-        _logger.warning('--test-pool is not supported yet; ignoring')
     dtype = jnp.bfloat16 if args.amp else None
     try:
         model = timm_tpu.create_model(
@@ -89,6 +93,16 @@ def validate(args):
     from timm_tpu.models import model_state_dict
     param_count = sum(v.size for v in model_state_dict(model, include_stats=False).values())
     _logger.info(f'Model {args.model} created, param count: {param_count/1e6:.1f}M')
+
+    test_time_pool = False
+    if args.test_pool:
+        from timm_tpu.layers import apply_test_time_pool
+        model, test_time_pool = apply_test_time_pool(model, data_config)
+        if test_time_pool:
+            data_config['crop_pct'] = 1.0  # full-image input for TTA pooling
+        else:
+            _logger.info('--test-pool requested but eval size does not exceed the '
+                         'pretrained default; using the standard head')
 
     root = args.data_dir or args.data
     dataset = create_dataset(

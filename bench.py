@@ -29,6 +29,7 @@ stale number.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import subprocess
@@ -265,7 +266,10 @@ def _measure(args) -> int:
         graphdef, params, rest = nnx.split(model, nnx.Param, ...)
         opt_state = opt.init(params)
 
-        @jax.jit
+        # donation + returning the updated state lets XLA alias the params and
+        # AdamW buffers in place (input-output aliasing): ~1 GB less HBM copy
+        # traffic per fused K-step call for ViT-B
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def multi_step(params, opt_state, x, t):
             def body(carry, _):
                 params, opt_state = carry
@@ -278,12 +282,16 @@ def _measure(args) -> int:
                 params = optax.apply_updates(params, updates)
                 return (params, opt_state), loss
             (params, opt_state), losses = jax.lax.scan(body, (params, opt_state), None, length=K)
-            return losses[-1]
+            return params, opt_state, losses[-1]
 
-        out = multi_step(params, opt_state, x, t)
-        float(out)  # compile + run once
+        # warm-up compiles + runs once; its returned state feeds the timed
+        # call (donation invalidates the inputs, and chaining state is the
+        # realistic steady-state pattern)
+        params, opt_state, out = multi_step(params, opt_state, x, t)
+        float(out)
         t0 = time.perf_counter()
-        float(multi_step(params, opt_state, x, t))
+        params, opt_state, out = multi_step(params, opt_state, x, t)
+        float(out)
         dt = time.perf_counter() - t0
         flops_mult = 3.0  # fwd + bwd
     else:

@@ -123,3 +123,38 @@ def test_resolve_data_config_priority():
     assert cfg['mean'] == (0.1, 0.1, 0.1)  # single value expanded
     assert cfg['std'] == (0.2, 0.2, 0.2)
     assert cfg['crop_pct'] == 0.8
+
+
+def test_repeat_aug_sampler_semantics(tmp_path):
+    """RepeatAugSampler: replicas see different repeats of the same shuffled
+    order; per-replica count ~len/replicas (reference distributed_sampler.py:54)."""
+    import numpy as np
+    from timm_tpu.data.loader import ThreadedLoader
+
+    class FakeDs:
+        def __len__(self):
+            return 300
+
+        def __getitem__(self, i):
+            return np.zeros((8, 8, 3), np.float32), i
+
+    per_rank = []
+    for rank in range(3):
+        loader = ThreadedLoader(
+            FakeDs(), batch_size=4, is_training=True, num_aug_repeats=3,
+            process_index=rank, process_count=3, seed=0)
+        idx = loader._shard_indices(shuffled=True)
+        per_rank.append(list(idx))
+    # reference defaults: floor(300/256*256/3) = 85 selected per rank
+    assert all(len(ix) == 85 for ix in per_rank)
+    # the three replicas start from the same repeated sequence offset by one:
+    # each sample index appears on multiple replicas (different augs per replica)
+    combined = per_rank[0] + per_rank[1] + per_rank[2]
+    from collections import Counter
+    counts = Counter(combined)
+    assert max(counts.values()) == 3, 'a sample should repeat across replicas'
+    # all replicas sample from the same shuffled epoch order
+    loader2 = ThreadedLoader(
+        FakeDs(), batch_size=4, is_training=True, num_aug_repeats=3,
+        process_index=0, process_count=3, seed=0)
+    assert list(loader2._shard_indices(shuffled=True)) == per_rank[0]

@@ -216,6 +216,7 @@ class ThreadedLoader:
             drop_last: Optional[bool] = None,
             shuffle: Optional[bool] = None,
             seed: int = 42,
+            num_aug_repeats: int = 0,
             prefetch: int = 4,
             re_prob: float = 0.0,
             re_mode: str = 'const',
@@ -242,14 +243,40 @@ class ThreadedLoader:
             num_splits=re_num_splits, mean=self.mean, std=self.std) if re_prob > 0 and is_training else None
         self.process_index = process_index
         self.process_count = process_count
+        self.num_aug_repeats = num_aug_repeats if is_training else 0
 
         self._local_indices = self._shard_indices(shuffled=False)
 
+    def _repeat_aug_indices(self, rng) -> np.ndarray:
+        """Repeated-augmentation sampling (reference distributed_sampler.py:54
+        RepeatAugSampler): each sample appears `num_repeats` times adjacent in
+        the shuffled order, replicas take interleaved slices (so each replica
+        sees a DIFFERENT augmentation of the same image), and each replica
+        truncates to ~len(dataset)/replicas samples per epoch."""
+        import math
+        n = len(self.dataset)
+        reps = self.num_aug_repeats
+        world = max(1, self.process_count)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng.shuffle(indices)
+        indices = np.repeat(indices, reps)
+        num_samples = int(math.ceil(n * reps / world))
+        total = num_samples * world
+        indices = np.concatenate([indices, indices[:total - len(indices)]])
+        local = indices[self.process_index::world]
+        # selected_round=256, selected_ratio=world (reference defaults)
+        num_selected = int(math.floor(n // 256 * 256 / world)) if n >= 256 \
+            else int(math.ceil(n / world))
+        return local[:num_selected]
+
     def _shard_indices(self, shuffled: bool):
+        rng = np.random.RandomState(self.seed + self.epoch)
+        if self.num_aug_repeats:
+            return self._repeat_aug_indices(rng)
         n = len(self.dataset)
         indices = np.arange(n)
         if shuffled:
-            rng = np.random.RandomState(self.seed + self.epoch)
             rng.shuffle(indices)
         if self.process_count > 1:
             # pad to equal per-host length (reference OrderedDistributedSampler)
@@ -307,7 +334,9 @@ class ThreadedLoader:
         # training batches collate in arrival order (indices are already a
         # fresh shuffle, and this keeps sample_q backpressure intact); eval
         # restores deterministic index order so results are reproducible.
-        ordered = not self.shuffle
+        # repeat-aug emits DUPLICATE indices, which the ordered path's
+        # pending-by-index bookkeeping cannot represent — always unordered.
+        ordered = not self.shuffle and not self.num_aug_repeats
 
         def collator():
             pending = {}
@@ -424,8 +453,8 @@ def create_loader(
     (images NHWC float32 [0,1], targets int) numpy batches."""
     import jax
 
-    if num_aug_repeats:
-        raise NotImplementedError('RepeatAugSampler (--aug-repeats) is not supported yet')
+    if num_aug_repeats and not hasattr(dataset, '__getitem__'):
+        raise ValueError('--aug-repeats requires a map-style (indexable) dataset')
     if collate_fn is not None:
         raise NotImplementedError('custom collate_fn is not supported by ThreadedLoader')
 
@@ -479,5 +508,6 @@ def create_loader(
         dataset,
         num_workers=num_workers,
         seed=seed,
+        num_aug_repeats=num_aug_repeats,
         **loader_kwargs,
     )

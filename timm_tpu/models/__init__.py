@@ -22,6 +22,7 @@ from .deit import VisionTransformerDistilled
 from .densenet import DenseNet
 from .efficientnet import EfficientNet
 from .eva import Eva
+from .inception_v3 import InceptionV3
 from .levit import Levit, LevitDistilled
 from .maxxvit import MaxxVit, MaxxVitCfg
 from .mlp_mixer import MlpMixer
@@ -30,7 +31,10 @@ from .mvitv2 import MultiScaleVit, MultiScaleVitCfg
 from .naflexvit import NaFlexVit
 from .nfnet import NfCfg, NormFreeNet
 from .regnet import RegNet
+from .res2net import Bottle2neck
+from .resnest import ResNestBottleneck
 from .resnet import ResNet
+from .sknet import SelectiveKernelBasic, SelectiveKernelBottleneck
 from .resnetv2 import ResNetV2
 from .swin_transformer import SwinTransformer
 from .swin_transformer_v2 import SwinTransformerV2

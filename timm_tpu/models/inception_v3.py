@@ -1,0 +1,298 @@
+"""Inception-V3, TPU-native NHWC
+(reference: timm/models/inception_v3.py:1-540; Szegedy et al. 2015).
+
+Classic multi-branch conv trunk; branch concats are channel-last so XLA fuses
+them into the following 1x1 projections.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import ConvNormAct, Dropout, SelectAdaptivePool2d, trunc_normal_, zeros_
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['InceptionV3']
+
+
+def _max_pool3s2(x):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), 'VALID')
+
+
+def _avg_pool3s1p1(x):
+    # torch F.avg_pool2d(3, 1, 1) default count_include_pad=True
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    s = jax.lax.reduce_window(xp, 0.0, jax.lax.add, (1, 3, 3, 1), (1, 1, 1, 1), 'VALID')
+    return s / 9.0
+
+
+class InceptionA(nnx.Module):
+    def __init__(self, in_channels, pool_features, conv_block, *, rngs):
+        self.branch1x1 = conv_block(in_channels, 64, kernel_size=1, rngs=rngs)
+        self.branch5x5_1 = conv_block(in_channels, 48, kernel_size=1, rngs=rngs)
+        self.branch5x5_2 = conv_block(48, 64, kernel_size=5, padding=2, rngs=rngs)
+        self.branch3x3dbl_1 = conv_block(in_channels, 64, kernel_size=1, rngs=rngs)
+        self.branch3x3dbl_2 = conv_block(64, 96, kernel_size=3, padding=1, rngs=rngs)
+        self.branch3x3dbl_3 = conv_block(96, 96, kernel_size=3, padding=1, rngs=rngs)
+        self.branch_pool = conv_block(in_channels, pool_features, kernel_size=1, rngs=rngs)
+
+    def __call__(self, x):
+        b1 = self.branch1x1(x)
+        b5 = self.branch5x5_2(self.branch5x5_1(x))
+        b3 = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = self.branch_pool(_avg_pool3s1p1(x))
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nnx.Module):
+    def __init__(self, in_channels, conv_block, *, rngs):
+        self.branch3x3 = conv_block(in_channels, 384, kernel_size=3, stride=2, rngs=rngs)
+        self.branch3x3dbl_1 = conv_block(in_channels, 64, kernel_size=1, rngs=rngs)
+        self.branch3x3dbl_2 = conv_block(64, 96, kernel_size=3, padding=1, rngs=rngs)
+        self.branch3x3dbl_3 = conv_block(96, 96, kernel_size=3, stride=2, rngs=rngs)
+
+    def __call__(self, x):
+        b3 = self.branch3x3(x)
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = _max_pool3s2(x)
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nnx.Module):
+    def __init__(self, in_channels, channels_7x7, conv_block, *, rngs):
+        c7 = channels_7x7
+        self.branch1x1 = conv_block(in_channels, 192, kernel_size=1, rngs=rngs)
+        self.branch7x7_1 = conv_block(in_channels, c7, kernel_size=1, rngs=rngs)
+        self.branch7x7_2 = conv_block(c7, c7, kernel_size=(1, 7), padding=(0, 3), rngs=rngs)
+        self.branch7x7_3 = conv_block(c7, 192, kernel_size=(7, 1), padding=(3, 0), rngs=rngs)
+        self.branch7x7dbl_1 = conv_block(in_channels, c7, kernel_size=1, rngs=rngs)
+        self.branch7x7dbl_2 = conv_block(c7, c7, kernel_size=(7, 1), padding=(3, 0), rngs=rngs)
+        self.branch7x7dbl_3 = conv_block(c7, c7, kernel_size=(1, 7), padding=(0, 3), rngs=rngs)
+        self.branch7x7dbl_4 = conv_block(c7, c7, kernel_size=(7, 1), padding=(3, 0), rngs=rngs)
+        self.branch7x7dbl_5 = conv_block(c7, 192, kernel_size=(1, 7), padding=(0, 3), rngs=rngs)
+        self.branch_pool = conv_block(in_channels, 192, kernel_size=1, rngs=rngs)
+
+    def __call__(self, x):
+        b1 = self.branch1x1(x)
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_5(self.branch7x7dbl_4(self.branch7x7dbl_3(
+            self.branch7x7dbl_2(self.branch7x7dbl_1(x)))))
+        bp = self.branch_pool(_avg_pool3s1p1(x))
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nnx.Module):
+    def __init__(self, in_channels, conv_block, *, rngs):
+        self.branch3x3_1 = conv_block(in_channels, 192, kernel_size=1, rngs=rngs)
+        self.branch3x3_2 = conv_block(192, 320, kernel_size=3, stride=2, rngs=rngs)
+        self.branch7x7x3_1 = conv_block(in_channels, 192, kernel_size=1, rngs=rngs)
+        self.branch7x7x3_2 = conv_block(192, 192, kernel_size=(1, 7), padding=(0, 3), rngs=rngs)
+        self.branch7x7x3_3 = conv_block(192, 192, kernel_size=(7, 1), padding=(3, 0), rngs=rngs)
+        self.branch7x7x3_4 = conv_block(192, 192, kernel_size=3, stride=2, rngs=rngs)
+
+    def __call__(self, x):
+        b3 = self.branch3x3_2(self.branch3x3_1(x))
+        b7 = self.branch7x7x3_4(self.branch7x7x3_3(self.branch7x7x3_2(self.branch7x7x3_1(x))))
+        bp = _max_pool3s2(x)
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nnx.Module):
+    def __init__(self, in_channels, conv_block, *, rngs):
+        self.branch1x1 = conv_block(in_channels, 320, kernel_size=1, rngs=rngs)
+        self.branch3x3_1 = conv_block(in_channels, 384, kernel_size=1, rngs=rngs)
+        self.branch3x3_2a = conv_block(384, 384, kernel_size=(1, 3), padding=(0, 1), rngs=rngs)
+        self.branch3x3_2b = conv_block(384, 384, kernel_size=(3, 1), padding=(1, 0), rngs=rngs)
+        self.branch3x3dbl_1 = conv_block(in_channels, 448, kernel_size=1, rngs=rngs)
+        self.branch3x3dbl_2 = conv_block(448, 384, kernel_size=3, padding=1, rngs=rngs)
+        self.branch3x3dbl_3a = conv_block(384, 384, kernel_size=(1, 3), padding=(0, 1), rngs=rngs)
+        self.branch3x3dbl_3b = conv_block(384, 384, kernel_size=(3, 1), padding=(1, 0), rngs=rngs)
+        self.branch_pool = conv_block(in_channels, 192, kernel_size=1, rngs=rngs)
+
+    def __call__(self, x):
+        b1 = self.branch1x1(x)
+        b3 = self.branch3x3_1(x)
+        b3 = jnp.concatenate([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], axis=-1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = jnp.concatenate([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], axis=-1)
+        bp = self.branch_pool(_avg_pool3s1p1(x))
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nnx.Module):
+    """Inception-V3 with the reference's model contract
+    (reference inception_v3.py:284-470). Aux logits are a train-time-only
+    artifact of the original recipe and are not implemented."""
+
+    def __init__(
+            self,
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            drop_rate: float = 0.0,
+            global_pool: str = 'avg',
+            aux_logits: bool = False,
+            norm_eps: float = 1e-3,
+            act_layer: str = 'relu',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert not aux_logits, 'aux_logits head not implemented'
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        from ..layers import BatchNormAct2d
+        conv_block = partial(
+            ConvNormAct, padding=0, act_layer=act_layer,
+            norm_layer=partial(BatchNormAct2d, eps=norm_eps),
+            dtype=dtype, param_dtype=param_dtype)
+
+        self.Conv2d_1a_3x3 = conv_block(in_chans, 32, kernel_size=3, stride=2, rngs=rngs)
+        self.Conv2d_2a_3x3 = conv_block(32, 32, kernel_size=3, rngs=rngs)
+        self.Conv2d_2b_3x3 = conv_block(32, 64, kernel_size=3, padding=1, rngs=rngs)
+        self.Conv2d_3b_1x1 = conv_block(64, 80, kernel_size=1, rngs=rngs)
+        self.Conv2d_4a_3x3 = conv_block(80, 192, kernel_size=3, rngs=rngs)
+        self.Mixed_5b = InceptionA(192, 32, conv_block, rngs=rngs)
+        self.Mixed_5c = InceptionA(256, 64, conv_block, rngs=rngs)
+        self.Mixed_5d = InceptionA(288, 64, conv_block, rngs=rngs)
+        self.Mixed_6a = InceptionB(288, conv_block, rngs=rngs)
+        self.Mixed_6b = InceptionC(768, 128, conv_block, rngs=rngs)
+        self.Mixed_6c = InceptionC(768, 160, conv_block, rngs=rngs)
+        self.Mixed_6d = InceptionC(768, 160, conv_block, rngs=rngs)
+        self.Mixed_6e = InceptionC(768, 192, conv_block, rngs=rngs)
+        self.Mixed_7a = InceptionD(768, conv_block, rngs=rngs)
+        self.Mixed_7b = InceptionE(1280, conv_block, rngs=rngs)
+        self.Mixed_7c = InceptionE(2048, conv_block, rngs=rngs)
+        self.feature_info = [
+            dict(num_chs=64, reduction=2, module='Conv2d_2b_3x3'),
+            dict(num_chs=192, reduction=4, module='Conv2d_4a_3x3'),
+            dict(num_chs=288, reduction=8, module='Mixed_5d'),
+            dict(num_chs=768, reduction=16, module='Mixed_6e'),
+            dict(num_chs=2048, reduction=32, module='Mixed_7c'),
+        ]
+
+        self.num_features = self.head_hidden_size = 2048
+        self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=True)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.fc = nnx.Linear(
+            2048, num_classes, kernel_init=trunc_normal_(std=0.1), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(stem=r'^Conv2d_[12]', blocks=r'^Mixed_(\d)')
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        assert not enable, 'gradient checkpointing not supported'
+
+    def get_classifier(self):
+        return self.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=True)
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.fc = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.1),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def _stages(self):
+        return [
+            lambda x: self.Conv2d_2b_3x3(self.Conv2d_2a_3x3(self.Conv2d_1a_3x3(x))),
+            lambda x: self.Conv2d_4a_3x3(self.Conv2d_3b_1x1(_max_pool3s2(x))),
+            lambda x: self.Mixed_5d(self.Mixed_5c(self.Mixed_5b(_max_pool3s2(x)))),
+            lambda x: self.Mixed_6e(self.Mixed_6d(self.Mixed_6c(self.Mixed_6b(self.Mixed_6a(x))))),
+            lambda x: self.Mixed_7c(self.Mixed_7b(self.Mixed_7a(x))),
+        ]
+
+    def forward_features(self, x):
+        for stage in self._stages():
+            x = stage(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = self.global_pool(x)
+        x = self.head_drop(x)
+        if pre_logits or self.fc is None:
+            return x
+        return self.fc(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        stages = self._stages()
+        take_indices, max_index = feature_take_indices(len(stages), indices)
+        intermediates = []
+        for i, stage in enumerate(stages):
+            if stop_early and i > max_index:
+                break
+            x = stage(x)
+            if i in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, _ = feature_take_indices(5, indices)
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    out = {k: v for k, v in state_dict.items() if not k.startswith('AuxLogits')}
+    return convert_torch_state_dict(out, model)
+
+
+def _create_inception_v3(variant, pretrained=False, **kwargs):
+    return build_model_with_cfg(
+        InceptionV3, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 299, 299), 'pool_size': (8, 8),
+        'crop_pct': 0.875, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'Conv2d_1a_3x3.conv', 'classifier': 'fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'inception_v3.tv_in1k': _cfg(hf_hub_id='timm/'),
+    'inception_v3.tf_in1k': _cfg(hf_hub_id='timm/'),
+    'inception_v3.tf_adv_in1k': _cfg(hf_hub_id='timm/'),
+    'inception_v3.gluon_in1k': _cfg(hf_hub_id='timm/', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+})
+
+
+@register_model
+def inception_v3(pretrained=False, **kwargs) -> InceptionV3:
+    return _create_inception_v3('inception_v3', pretrained=pretrained, **kwargs)

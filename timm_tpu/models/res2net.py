@@ -1,0 +1,215 @@
+"""Res2Net: multi-scale residual blocks on the ResNet trunk, TPU-native NHWC
+(reference: timm/models/res2net.py:1-240; Gao et al. 2019).
+
+The Bottle2neck splits the bottleneck width into `scale` groups processed by
+a cascade of 3x3 convs with cross-group additive feedthrough — expressed here
+as static channel slices (XLA fuses the concat back into the 1x1 projection).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNormAct2d, create_conv2d, get_act_fn
+from ..layers.drop import DropPath
+from ._builder import build_model_with_cfg
+from ._registry import generate_default_cfgs, register_model
+from .resnet import ResNet, checkpoint_filter_fn
+
+__all__ = ['Bottle2neck']
+
+
+def _avg_pool3s_pad1(x, stride: int):
+    """AvgPool2d(3, stride, padding=1) with count_include_pad=True (the
+    reference keeps torch defaults here to match original weights)."""
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    s = jax.lax.reduce_window(
+        xp, 0.0, jax.lax.add, (1, 3, 3, 1), (1, stride, stride, 1), 'VALID')
+    return s / 9.0
+
+
+class Bottle2neck(nnx.Module):
+    """Res2Net bottleneck (reference res2net.py:20-130)."""
+    expansion = 4
+
+    def __init__(
+            self,
+            inplanes: int,
+            planes: int,
+            stride: int = 1,
+            downsample=None,
+            cardinality: int = 1,
+            base_width: int = 26,
+            scale: int = 4,
+            reduce_first: int = 1,
+            dilation: int = 1,
+            first_dilation: Optional[int] = None,
+            act_layer='relu',
+            norm_layer: Callable = BatchNormAct2d,
+            attn_layer: Optional[Callable] = None,
+            drop_path: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.scale = scale
+        self.is_first = stride > 1 or downsample is not None
+        self.num_scales = max(1, scale - 1)
+        width = int(math.floor(planes * (base_width / 64.0))) * cardinality
+        self.width = width
+        outplanes = planes * self.expansion
+        first_dilation = first_dilation or dilation
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        self.conv1 = create_conv2d(inplanes, width * scale, 1, **kw)
+        self.bn1 = norm_layer(width * scale, act_layer=act_layer, **kw)
+        self.convs = nnx.List([
+            create_conv2d(width, width, 3, stride=stride, dilation=first_dilation,
+                          groups=cardinality, padding=None, **kw)
+            for _ in range(self.num_scales)
+        ])
+        self.bns = nnx.List([
+            norm_layer(width, act_layer=act_layer, **kw) for _ in range(self.num_scales)])
+        self.pool_stride = stride if self.is_first else None
+        self.conv3 = create_conv2d(width * scale, outplanes, 1, **kw)
+        self.bn3 = norm_layer(outplanes, apply_act=False, **kw)
+        self.se = attn_layer(outplanes, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
+            if attn_layer is not None else None
+        self.act = get_act_fn(act_layer)
+        self.downsample = downsample
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def zero_init_last(self):
+        if hasattr(self.bn3, 'scale'):
+            self.bn3.scale[...] = jnp.zeros_like(self.bn3.scale[...])
+
+    def __call__(self, x):
+        shortcut = x
+        out = self.bn1(self.conv1(x))
+        spx = [out[..., i * self.width:(i + 1) * self.width] for i in range(self.scale)]
+        spo = []
+        sp = spx[0]
+        for i, (conv, bn) in enumerate(zip(self.convs, self.bns)):
+            if i == 0 or self.is_first:
+                sp = spx[i]
+            else:
+                sp = sp + spx[i]
+            sp = bn(conv(sp))
+            spo.append(sp)
+        if self.scale > 1:
+            if self.pool_stride is not None:
+                spo.append(_avg_pool3s_pad1(spx[-1], self.pool_stride))
+            else:
+                spo.append(spx[-1])
+        out = jnp.concatenate(spo, axis=-1)
+        out = self.bn3(self.conv3(out))
+        if self.se is not None:
+            out = self.se(out)
+        if self.downsample is not None:
+            shortcut = self.downsample(x)
+        out = self.drop_path(out) + shortcut
+        return self.act(out)
+
+
+def _create_res2net(variant, pretrained=False, **kwargs):
+    # block_args in reference become direct block partial kwargs here
+    block_args = kwargs.pop('block_args', {})
+    block = kwargs.pop('block', Bottle2neck)
+    if block_args:
+        block = partial(block, **block_args)
+        block.expansion = Bottle2neck.expansion
+    return build_model_with_cfg(
+        ResNet, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
+        block=block,
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bilinear',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'conv1', 'classifier': 'head.fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'res2net50_26w_4s.in1k': _cfg(hf_hub_id='timm/'),
+    'res2net50_48w_2s.in1k': _cfg(hf_hub_id='timm/'),
+    'res2net50_14w_8s.in1k': _cfg(hf_hub_id='timm/'),
+    'res2net50_26w_6s.in1k': _cfg(hf_hub_id='timm/'),
+    'res2net50_26w_8s.in1k': _cfg(hf_hub_id='timm/'),
+    'res2net101_26w_4s.in1k': _cfg(hf_hub_id='timm/'),
+    'res2next50.in1k': _cfg(hf_hub_id='timm/'),
+    'res2net50d.in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+    'res2net101d.in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
+})
+
+
+@register_model
+def res2net50_26w_4s(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(layers=(3, 4, 6, 3), base_width=26, block_args=dict(scale=4))
+    return _create_res2net('res2net50_26w_4s', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def res2net101_26w_4s(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(layers=(3, 4, 23, 3), base_width=26, block_args=dict(scale=4))
+    return _create_res2net('res2net101_26w_4s', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def res2net50_26w_6s(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(layers=(3, 4, 6, 3), base_width=26, block_args=dict(scale=6))
+    return _create_res2net('res2net50_26w_6s', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def res2net50_26w_8s(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(layers=(3, 4, 6, 3), base_width=26, block_args=dict(scale=8))
+    return _create_res2net('res2net50_26w_8s', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def res2net50_48w_2s(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(layers=(3, 4, 6, 3), base_width=48, block_args=dict(scale=2))
+    return _create_res2net('res2net50_48w_2s', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def res2net50_14w_8s(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(layers=(3, 4, 6, 3), base_width=14, block_args=dict(scale=8))
+    return _create_res2net('res2net50_14w_8s', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def res2next50(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(layers=(3, 4, 6, 3), base_width=4, cardinality=8, block_args=dict(scale=4))
+    return _create_res2net('res2next50', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def res2net50d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        layers=(3, 4, 6, 3), base_width=26, stem_type='deep', avg_down=True,
+        stem_width=32, block_args=dict(scale=4))
+    return _create_res2net('res2net50d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def res2net101d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        layers=(3, 4, 23, 3), base_width=26, stem_type='deep', avg_down=True,
+        stem_width=32, block_args=dict(scale=4))
+    return _create_res2net('res2net101d', pretrained, **dict(model_args, **kwargs))

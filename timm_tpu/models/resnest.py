@@ -1,0 +1,197 @@
+"""ResNeSt: Split-Attention ResNets, TPU-native NHWC
+(reference: timm/models/resnest.py:1-270; Zhang et al. 2020).
+
+ResNet trunk with Split-Attention 3x3 convs (timm_tpu/layers/split_attn.py)
+and the 'avd' average-pool stride placement.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNormAct2d, SplitAttn, create_conv2d, get_act_fn
+from ..layers.drop import DropPath
+from ._builder import build_model_with_cfg
+from ._registry import generate_default_cfgs, register_model
+from .resnet import ResNet, checkpoint_filter_fn
+
+__all__ = ['ResNestBottleneck']
+
+
+def _avg_pool3_pad1(x, stride: int):
+    """AvgPool2d(3, stride, padding=1), count_include_pad=True (torch default
+    kept by the reference)."""
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    s = jax.lax.reduce_window(
+        xp, 0.0, jax.lax.add, (1, 3, 3, 1), (1, stride, stride, 1), 'VALID')
+    return s / 9.0
+
+
+class ResNestBottleneck(nnx.Module):
+    """(reference resnest.py:23-130)."""
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None, radix=1,
+                 cardinality=1, base_width=64, avd=False, avd_first=False,
+                 reduce_first=1, dilation=1, first_dilation=None,
+                 act_layer='relu', norm_layer: Callable = BatchNormAct2d,
+                 attn_layer=None, drop_path=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        assert reduce_first == 1
+        assert attn_layer is None, 'attn_layer not supported by ResNestBottleneck'
+        group_width = int(planes * (base_width / 64.0)) * cardinality
+        first_dilation = first_dilation or dilation
+        # reference passes is_first per block; it's exactly "this block has a
+        # downsample or strides", both of which our builder gives block 0
+        is_first = stride > 1 or downsample is not None
+        if avd and (stride > 1 or is_first):
+            self.avd_stride = stride
+            stride = 1
+        else:
+            self.avd_stride = 0
+        self.avd_first = avd_first
+        self.radix = radix
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        self.conv1 = create_conv2d(inplanes, group_width, 1, **kw)
+        self.bn1 = norm_layer(group_width, act_layer=act_layer, **kw)
+        if radix >= 1:
+            self.conv2 = SplitAttn(
+                group_width, group_width, kernel_size=3, stride=stride,
+                dilation=first_dilation, groups=cardinality, radix=radix,
+                norm_layer=norm_layer, **kw)
+            self.bn2 = None
+        else:
+            self.conv2 = create_conv2d(
+                group_width, group_width, 3, stride=stride, dilation=first_dilation,
+                groups=cardinality, padding=None, **kw)
+            self.bn2 = norm_layer(group_width, act_layer=act_layer, **kw)
+        self.conv3 = create_conv2d(group_width, planes * 4, 1, **kw)
+        self.bn3 = norm_layer(planes * 4, apply_act=False, **kw)
+        self.act = get_act_fn(act_layer)
+        self.downsample = downsample
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def zero_init_last(self):
+        if hasattr(self.bn3, 'scale'):
+            self.bn3.scale[...] = jnp.zeros_like(self.bn3.scale[...])
+
+    def __call__(self, x):
+        shortcut = x
+        out = self.bn1(self.conv1(x))
+        if self.avd_stride > 0 and self.avd_first:
+            out = _avg_pool3_pad1(out, self.avd_stride)
+        out = self.conv2(out)
+        if self.bn2 is not None:
+            out = self.bn2(out)
+        if self.avd_stride > 0 and not self.avd_first:
+            out = _avg_pool3_pad1(out, self.avd_stride)
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            shortcut = self.downsample(x)
+        out = self.drop_path(out) + shortcut
+        return self.act(out)
+
+
+def _create_resnest(variant, pretrained=False, **kwargs):
+    block_args = kwargs.pop('block_args', {})
+    block = partial(ResNestBottleneck, **block_args) if block_args else ResNestBottleneck
+    block.expansion = ResNestBottleneck.expansion
+    return build_model_with_cfg(
+        ResNet, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
+        block=block,
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bilinear',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'conv1.0', 'classifier': 'head.fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'resnest14d.gluon_in1k': _cfg(hf_hub_id='timm/'),
+    'resnest26d.gluon_in1k': _cfg(hf_hub_id='timm/'),
+    'resnest50d.in1k': _cfg(hf_hub_id='timm/'),
+    'resnest101e.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8)),
+    'resnest200e.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 320, 320), pool_size=(10, 10), crop_pct=0.909),
+    'resnest269e.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 416, 416), pool_size=(13, 13), crop_pct=0.928),
+    'resnest50d_4s2x40d.in1k': _cfg(hf_hub_id='timm/'),
+    'resnest50d_1s4x24d.in1k': _cfg(hf_hub_id='timm/'),
+})
+
+
+@register_model
+def resnest14d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        layers=(1, 1, 1, 1), stem_type='deep', stem_width=32, avg_down=True,
+        base_width=64, cardinality=1, block_args=dict(radix=2, avd=True, avd_first=False))
+    return _create_resnest('resnest14d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnest26d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        layers=(2, 2, 2, 2), stem_type='deep', stem_width=32, avg_down=True,
+        base_width=64, cardinality=1, block_args=dict(radix=2, avd=True, avd_first=False))
+    return _create_resnest('resnest26d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnest50d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        layers=(3, 4, 6, 3), stem_type='deep', stem_width=32, avg_down=True,
+        base_width=64, cardinality=1, block_args=dict(radix=2, avd=True, avd_first=False))
+    return _create_resnest('resnest50d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnest101e(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        layers=(3, 4, 23, 3), stem_type='deep', stem_width=64, avg_down=True,
+        base_width=64, cardinality=1, block_args=dict(radix=2, avd=True, avd_first=False))
+    return _create_resnest('resnest101e', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnest200e(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        layers=(3, 24, 36, 3), stem_type='deep', stem_width=64, avg_down=True,
+        base_width=64, cardinality=1, block_args=dict(radix=2, avd=True, avd_first=False))
+    return _create_resnest('resnest200e', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnest269e(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        layers=(3, 30, 48, 8), stem_type='deep', stem_width=64, avg_down=True,
+        base_width=64, cardinality=1, block_args=dict(radix=2, avd=True, avd_first=False))
+    return _create_resnest('resnest269e', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnest50d_4s2x40d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        layers=(3, 4, 6, 3), stem_type='deep', stem_width=32, avg_down=True,
+        base_width=40, cardinality=2, block_args=dict(radix=4, avd=True, avd_first=True))
+    return _create_resnest('resnest50d_4s2x40d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnest50d_1s4x24d(pretrained=False, **kwargs) -> ResNet:
+    model_args = dict(
+        layers=(3, 4, 6, 3), stem_type='deep', stem_width=32, avg_down=True,
+        base_width=24, cardinality=4, block_args=dict(radix=1, avd=True, avd_first=True))
+    return _create_resnest('resnest50d_1s4x24d', pretrained, **dict(model_args, **kwargs))

@@ -1,0 +1,189 @@
+"""SKNet: Selective-Kernel ResNets, TPU-native NHWC
+(reference: timm/models/sknet.py:1-270; Li et al. 2019).
+
+ResNet trunk with the 3x3 conv replaced by a SelectiveKernel mixer
+(timm_tpu/layers/selective_kernel.py).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNormAct2d, ConvNormAct, SelectiveKernel, get_act_fn
+from ..layers.drop import DropPath
+from ._builder import build_model_with_cfg
+from ._registry import generate_default_cfgs, register_model
+from .resnet import ResNet, checkpoint_filter_fn
+
+__all__ = ['SelectiveKernelBasic', 'SelectiveKernelBottleneck']
+
+
+class SelectiveKernelBasic(nnx.Module):
+    """(reference sknet.py:24-100)."""
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None, cardinality=1,
+                 base_width=64, sk_kwargs=None, reduce_first=1, dilation=1,
+                 first_dilation=None, act_layer='relu', norm_layer: Callable = BatchNormAct2d,
+                 attn_layer=None, drop_path=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        sk_kwargs = sk_kwargs or {}
+        assert cardinality == 1 and base_width == 64
+        first_planes = planes // reduce_first
+        outplanes = planes * self.expansion
+        first_dilation = first_dilation or dilation
+        kw = dict(act_layer=act_layer, norm_layer=norm_layer,
+                  dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv1 = SelectiveKernel(
+            inplanes, first_planes, stride=stride, dilation=first_dilation, **sk_kwargs, **kw)
+        self.conv2 = ConvNormAct(
+            first_planes, outplanes, kernel_size=3, dilation=dilation, apply_act=False, **kw)
+        self.se = attn_layer(outplanes, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
+            if attn_layer else None
+        self.act = get_act_fn(act_layer)
+        self.downsample = downsample
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def zero_init_last(self):
+        if hasattr(self.conv2.bn, 'scale'):
+            self.conv2.bn.scale[...] = jnp.zeros_like(self.conv2.bn.scale[...])
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.conv1(x)
+        x = self.conv2(x)
+        if self.se is not None:
+            x = self.se(x)
+        x = self.drop_path(x)
+        if self.downsample is not None:
+            shortcut = self.downsample(shortcut)
+        return self.act(x + shortcut)
+
+
+class SelectiveKernelBottleneck(nnx.Module):
+    """(reference sknet.py:103-176)."""
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None, cardinality=1,
+                 base_width=64, sk_kwargs=None, reduce_first=1, dilation=1,
+                 first_dilation=None, act_layer='relu', norm_layer: Callable = BatchNormAct2d,
+                 attn_layer=None, drop_path=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        sk_kwargs = sk_kwargs or {}
+        width = int(math.floor(planes * (base_width / 64)) * cardinality)
+        first_planes = width // reduce_first
+        outplanes = planes * self.expansion
+        first_dilation = first_dilation or dilation
+        kw = dict(act_layer=act_layer, norm_layer=norm_layer,
+                  dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv1 = ConvNormAct(inplanes, first_planes, kernel_size=1, **kw)
+        self.conv2 = SelectiveKernel(
+            first_planes, width, stride=stride, dilation=first_dilation,
+            groups=cardinality, **sk_kwargs, **kw)
+        self.conv3 = ConvNormAct(width, outplanes, kernel_size=1, apply_act=False, **kw)
+        self.se = attn_layer(outplanes, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
+            if attn_layer else None
+        self.act = get_act_fn(act_layer)
+        self.downsample = downsample
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def zero_init_last(self):
+        if hasattr(self.conv3.bn, 'scale'):
+            self.conv3.bn.scale[...] = jnp.zeros_like(self.conv3.bn.scale[...])
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.conv1(x)
+        x = self.conv2(x)
+        x = self.conv3(x)
+        if self.se is not None:
+            x = self.se(x)
+        x = self.drop_path(x)
+        if self.downsample is not None:
+            shortcut = self.downsample(shortcut)
+        return self.act(x + shortcut)
+
+
+def _create_skresnet(variant, pretrained=False, **kwargs):
+    block_args = kwargs.pop('block_args', {})
+    block = kwargs.pop('block')
+    expansion = block.expansion
+    if block_args:
+        block = partial(block, **block_args)
+        block.expansion = expansion
+    return build_model_with_cfg(
+        ResNet, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
+        block=block,
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'conv1', 'classifier': 'head.fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'skresnet18.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'skresnet34.ra_in1k': _cfg(hf_hub_id='timm/'),
+    'skresnet50.untrained': _cfg(),
+    'skresnet50d.untrained': _cfg(first_conv='conv1.0'),
+    'skresnext50_32x4d.ra_in1k': _cfg(hf_hub_id='timm/'),
+})
+
+
+@register_model
+def skresnet18(pretrained=False, **kwargs) -> ResNet:
+    sk_kwargs = dict(rd_ratio=1 / 8, rd_divisor=16, split_input=True)
+    model_args = dict(
+        block=SelectiveKernelBasic, layers=(2, 2, 2, 2), block_args=dict(sk_kwargs=sk_kwargs),
+        zero_init_last=False)
+    return _create_skresnet('skresnet18', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def skresnet34(pretrained=False, **kwargs) -> ResNet:
+    sk_kwargs = dict(rd_ratio=1 / 8, rd_divisor=16, split_input=True)
+    model_args = dict(
+        block=SelectiveKernelBasic, layers=(3, 4, 6, 3), block_args=dict(sk_kwargs=sk_kwargs),
+        zero_init_last=False)
+    return _create_skresnet('skresnet34', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def skresnet50(pretrained=False, **kwargs) -> ResNet:
+    sk_kwargs = dict(split_input=True)
+    model_args = dict(
+        block=SelectiveKernelBottleneck, layers=(3, 4, 6, 3), block_args=dict(sk_kwargs=sk_kwargs),
+        zero_init_last=False)
+    return _create_skresnet('skresnet50', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def skresnet50d(pretrained=False, **kwargs) -> ResNet:
+    sk_kwargs = dict(split_input=True)
+    model_args = dict(
+        block=SelectiveKernelBottleneck, layers=(3, 4, 6, 3), stem_width=32, stem_type='deep',
+        avg_down=True, block_args=dict(sk_kwargs=sk_kwargs), zero_init_last=False)
+    return _create_skresnet('skresnet50d', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def skresnext50_32x4d(pretrained=False, **kwargs) -> ResNet:
+    sk_kwargs = dict(rd_ratio=1 / 16, rd_divisor=32, split_input=False)
+    model_args = dict(
+        block=SelectiveKernelBottleneck, layers=(3, 4, 6, 3), cardinality=32, base_width=4,
+        block_args=dict(sk_kwargs=sk_kwargs), zero_init_last=False)
+    return _create_skresnet('skresnext50_32x4d', pretrained, **dict(model_args, **kwargs))

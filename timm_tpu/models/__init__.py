@@ -22,9 +22,11 @@ from .deit import VisionTransformerDistilled
 from .densenet import DenseNet
 from .efficientnet import EfficientNet
 from .eva import Eva
+from .ghostnet import GhostNet
 from .inception_v3 import InceptionV3
 from .levit import Levit, LevitDistilled
 from .maxxvit import MaxxVit, MaxxVitCfg
+from .metaformer import MetaFormer
 from .mlp_mixer import MlpMixer
 from .mobilenetv3 import MobileNetV3
 from .mvitv2 import MultiScaleVit, MultiScaleVitCfg

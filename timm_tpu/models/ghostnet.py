@@ -1,0 +1,429 @@
+"""GhostNet v1/v2, TPU-native NHWC
+(reference: timm/models/ghostnet.py:1-1020; Han et al. 2020, Tang et al. 2022).
+
+Ghost modules generate half the channels with a cheap depthwise conv over the
+primary conv's output; v2 adds a decoupled-fully-connected attention branch
+computed at half resolution and nearest-upsampled as a gate. GhostNetV3's
+train-time re-parameterization variant is not implemented.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    BatchNorm2d, Dropout, SelectAdaptivePool2d, SqueezeExcite, get_act_fn,
+    make_divisible, trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['GhostNet']
+
+_SE_LAYER = partial(
+    SqueezeExcite, gate_layer='hard_sigmoid', rd_round_fn=partial(make_divisible, divisor=4))
+
+
+def _conv(in_chs, out_chs, k, stride=1, groups=1, *, rngs, **kw):
+    pad = k // 2 if isinstance(k, int) else tuple(x // 2 for x in k)
+    ks = (k, k) if isinstance(k, int) else k
+    pads = [(pad, pad), (pad, pad)] if isinstance(pad, int) else [(pad[0], pad[0]), (pad[1], pad[1])]
+    return nnx.Conv(in_chs, out_chs, kernel_size=ks, strides=stride, padding=pads,
+                    feature_group_count=groups, use_bias=False, rngs=rngs, **kw)
+
+
+def _avg_pool2(x):
+    B, H, W, C = x.shape
+    x = x[:, :2 * (H // 2), :2 * (W // 2)]
+    return x.reshape(B, H // 2, 2, W // 2, 2, C).mean(axis=(2, 4))
+
+
+class GhostModule(nnx.Module):
+    """(reference ghostnet.py:36-71)."""
+
+    def __init__(self, in_chs, out_chs, kernel_size=1, ratio=2, dw_size=3, stride=1,
+                 act_layer='relu', *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.out_chs = out_chs
+        init_chs = math.ceil(out_chs / ratio)
+        new_chs = init_chs * (ratio - 1)
+        kw = dict(dtype=dtype, param_dtype=param_dtype)
+        self.primary_conv = _conv(in_chs, init_chs, kernel_size, stride, rngs=rngs, **kw)
+        self.primary_bn = BatchNorm2d(init_chs, rngs=rngs)
+        self.cheap_conv = _conv(init_chs, new_chs, dw_size, 1, groups=init_chs, rngs=rngs, **kw)
+        self.cheap_bn = BatchNorm2d(new_chs, rngs=rngs)
+        self.act = get_act_fn(act_layer) if act_layer is not None else None
+
+    def _primary(self, x):
+        x = self.primary_bn(self.primary_conv(x))
+        return self.act(x) if self.act is not None else x
+
+    def _cheap(self, x):
+        x = self.cheap_bn(self.cheap_conv(x))
+        return self.act(x) if self.act is not None else x
+
+    def __call__(self, x):
+        x1 = self._primary(x)
+        x2 = self._cheap(x1)
+        return jnp.concatenate([x1, x2], axis=-1)[..., :self.out_chs]
+
+
+class GhostModuleV2(GhostModule):
+    """Ghost module + DFC attention gate (reference ghostnet.py:74-119)."""
+
+    def __init__(self, in_chs, out_chs, kernel_size=1, ratio=2, dw_size=3, stride=1,
+                 act_layer='relu', *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        super().__init__(in_chs, out_chs, kernel_size, ratio, dw_size, stride,
+                         act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        kw = dict(dtype=dtype, param_dtype=param_dtype)
+        self.short_conv1 = _conv(in_chs, out_chs, kernel_size, stride, rngs=rngs, **kw)
+        self.short_bn1 = BatchNorm2d(out_chs, rngs=rngs)
+        self.short_conv2 = _conv(out_chs, out_chs, (1, 5), 1, groups=out_chs, rngs=rngs, **kw)
+        self.short_bn2 = BatchNorm2d(out_chs, rngs=rngs)
+        self.short_conv3 = _conv(out_chs, out_chs, (5, 1), 1, groups=out_chs, rngs=rngs, **kw)
+        self.short_bn3 = BatchNorm2d(out_chs, rngs=rngs)
+
+    def __call__(self, x):
+        res = _avg_pool2(x)
+        res = self.short_bn1(self.short_conv1(res))
+        res = self.short_bn2(self.short_conv2(res))
+        res = self.short_bn3(self.short_conv3(res))
+        x1 = self._primary(x)
+        x2 = self._cheap(x1)
+        out = jnp.concatenate([x1, x2], axis=-1)[..., :self.out_chs]
+        gate = jax.nn.sigmoid(res)
+        gate = jax.image.resize(gate, (gate.shape[0], out.shape[1], out.shape[2], gate.shape[3]),
+                                method='nearest')
+        return out * gate
+
+
+class GhostBottleneck(nnx.Module):
+    """(reference ghostnet.py:357-446)."""
+
+    def __init__(self, in_chs, mid_chs, out_chs, dw_kernel_size=3, stride=1,
+                 act_layer='relu', se_ratio=0.0, mode='original',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        has_se = se_ratio is not None and se_ratio > 0.0
+        self.stride = stride
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        ghost_cls = GhostModule if mode == 'original' else GhostModuleV2
+        self.ghost1 = ghost_cls(in_chs, mid_chs, act_layer=act_layer, **kw)
+        if stride > 1:
+            self.conv_dw = _conv(mid_chs, mid_chs, dw_kernel_size, stride, groups=mid_chs,
+                                 rngs=rngs, dtype=dtype, param_dtype=param_dtype)
+            self.bn_dw = BatchNorm2d(mid_chs, rngs=rngs)
+        else:
+            self.conv_dw = None
+            self.bn_dw = None
+        self.se = _SE_LAYER(mid_chs, rd_ratio=se_ratio, **kw) if has_se else None
+        self.ghost2 = GhostModule(mid_chs, out_chs, act_layer=None, **kw)
+        if in_chs == out_chs and stride == 1:
+            self.shortcut_dw = None
+        else:
+            self.shortcut_dw = _conv(in_chs, in_chs, dw_kernel_size, stride, groups=in_chs,
+                                     rngs=rngs, dtype=dtype, param_dtype=param_dtype)
+            self.shortcut_bn1 = BatchNorm2d(in_chs, rngs=rngs)
+            self.shortcut_pw = _conv(in_chs, out_chs, 1, 1, rngs=rngs,
+                                     dtype=dtype, param_dtype=param_dtype)
+            self.shortcut_bn2 = BatchNorm2d(out_chs, rngs=rngs)
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.ghost1(x)
+        if self.conv_dw is not None:
+            x = self.bn_dw(self.conv_dw(x))
+        if self.se is not None:
+            x = self.se(x)
+        x = self.ghost2(x)
+        if self.shortcut_dw is None:
+            return x + shortcut
+        s = self.shortcut_bn1(self.shortcut_dw(shortcut))
+        s = self.shortcut_bn2(self.shortcut_pw(s))
+        return x + s
+
+
+class _ConvBnAct(nnx.Module):
+    def __init__(self, in_chs, out_chs, k, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.conv = _conv(in_chs, out_chs, k, 1, rngs=rngs, dtype=dtype, param_dtype=param_dtype)
+        self.bn1 = BatchNorm2d(out_chs, rngs=rngs)
+
+    def __call__(self, x):
+        return nnx.relu(self.bn1(self.conv(x)))
+
+
+class GhostNet(nnx.Module):
+    """GhostNet with the reference's model contract (reference ghostnet.py:641-945)."""
+
+    def __init__(
+            self,
+            cfgs,
+            num_classes: int = 1000,
+            width: float = 1.0,
+            in_chans: int = 3,
+            output_stride: int = 32,
+            global_pool: str = 'avg',
+            drop_rate: float = 0.2,
+            version: str = 'v1',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert output_stride == 32
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        self.grad_checkpointing = False
+        self.feature_info = []
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        stem_chs = make_divisible(16 * width, 4)
+        self.conv_stem = _conv(in_chans, stem_chs, 3, 2, rngs=rngs, dtype=dtype, param_dtype=param_dtype)
+        self.feature_info.append(dict(num_chs=stem_chs, reduction=2, module='conv_stem'))
+        self.bn1 = BatchNorm2d(stem_chs, rngs=rngs)
+        prev_chs = stem_chs
+
+        stages = []
+        stage_idx = 0
+        layer_idx = 0
+        net_stride = 2
+        exp_size = 16
+        self.stage_ends = []  # block-stage index for each post-stem feature entry
+        for cfg in cfgs:
+            layers = []
+            s = 1
+            for k, exp_size, c, se_ratio, s in cfg:
+                out_chs = make_divisible(c * width, 4)
+                mid_chs = make_divisible(exp_size * width, 4)
+                mode = 'attn' if (version == 'v2' and layer_idx > 1) else 'original'
+                layers.append(GhostBottleneck(
+                    prev_chs, mid_chs, out_chs, k, s, se_ratio=se_ratio, mode=mode, **kw))
+                prev_chs = out_chs
+                layer_idx += 1
+            if s > 1:
+                net_stride *= 2
+                self.feature_info.append(dict(
+                    num_chs=prev_chs, reduction=net_stride, module=f'blocks.{stage_idx}'))
+                self.stage_ends.append(stage_idx)
+            stages.append(nnx.List(layers))
+            stage_idx += 1
+        out_chs = make_divisible(exp_size * width, 4)
+        stages.append(nnx.List([_ConvBnAct(prev_chs, out_chs, 1, **kw)]))
+        self.blocks = nnx.List(stages)
+        prev_chs = out_chs
+
+        self.num_features = prev_chs
+        self.head_hidden_size = 1280
+        self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=False)
+        self.conv_head = nnx.Conv(
+            prev_chs, 1280, kernel_size=(1, 1), use_bias=True,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.classifier = nnx.Linear(
+            1280, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^conv_stem|bn1',
+            blocks=[
+                (r'^blocks\.(\d+)' if coarse else r'^blocks\.(\d+)\.(\d+)', None),
+                (r'conv_head', (99999,)),
+            ],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.classifier
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=False)
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.classifier = nnx.Linear(
+            self.head_hidden_size, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        from ._manipulate import checkpoint_seq
+        x = nnx.relu(self.bn1(self.conv_stem(x)))
+        for stage in self.blocks:
+            if self.grad_checkpointing:
+                x = checkpoint_seq(stage, x)
+            else:
+                for b in stage:
+                    x = b(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = self.global_pool(x)
+        if x.ndim == 2:
+            x = x[:, None, None, :]
+        x = nnx.relu(self.conv_head(x))
+        x = x.reshape(x.shape[0], -1)
+        x = self.head_drop(x)
+        if pre_logits or self.classifier is None:
+            return x
+        return self.classifier(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        # indices address FEATURE entries (stem + one per stride change),
+        # mapped onto block-stage indices via self.stage_ends
+        assert output_fmt == 'NHWC'
+        num_features = 1 + len(self.stage_ends)
+        take_indices, max_index = feature_take_indices(num_features, indices)
+        take_stages = {self.stage_ends[i - 1]: i for i in take_indices if i > 0}
+        max_stage = self.stage_ends[max_index - 1] if max_index > 0 else -1
+        x = nnx.relu(self.bn1(self.conv_stem(x)))
+        intermediates = []
+        if 0 in take_indices:
+            intermediates.append(x)
+        for i, stage in enumerate(self.blocks):
+            if stop_early and i > max_stage:
+                break
+            for b in stage:
+                x = b(x)
+            if i in take_stages:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        num_features = 1 + len(self.stage_ends)
+        take_indices, max_index = feature_take_indices(num_features, indices)
+        max_stage = self.stage_ends[max_index - 1] if max_index > 0 else 0
+        self.blocks = nnx.List(list(self.blocks)[:max_stage + 1])
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    import re
+    out = {}
+    remap = [
+        (r'\.primary_conv\.0\.', '.primary_conv.'),
+        (r'\.primary_conv\.1\.', '.primary_bn.'),
+        (r'\.cheap_operation\.0\.', '.cheap_conv.'),
+        (r'\.cheap_operation\.1\.', '.cheap_bn.'),
+        (r'\.short_conv\.0\.', '.short_conv1.'),
+        (r'\.short_conv\.1\.', '.short_bn1.'),
+        (r'\.short_conv\.2\.', '.short_conv2.'),
+        (r'\.short_conv\.3\.', '.short_bn2.'),
+        (r'\.short_conv\.4\.', '.short_conv3.'),
+        (r'\.short_conv\.5\.', '.short_bn3.'),
+        (r'\.shortcut\.0\.', '.shortcut_dw.'),
+        (r'\.shortcut\.1\.', '.shortcut_bn1.'),
+        (r'\.shortcut\.2\.', '.shortcut_pw.'),
+        (r'\.shortcut\.3\.', '.shortcut_bn2.'),
+        (r'\.se\.conv_reduce\.', '.se.fc1.'),
+        (r'\.se\.conv_expand\.', '.se.fc2.'),
+    ]
+    for k, v in state_dict.items():
+        for pat, rep in remap:
+            k = re.sub(pat, rep, k)
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_ghostnet(variant, width=1.0, pretrained=False, **kwargs):
+    cfgs = [
+        # k, t, c, SE, s
+        [[3, 16, 16, 0, 1]],
+        [[3, 48, 24, 0, 2]],
+        [[3, 72, 24, 0, 1]],
+        [[5, 72, 40, 0.25, 2]],
+        [[5, 120, 40, 0.25, 1]],
+        [[3, 240, 80, 0, 2]],
+        [[3, 200, 80, 0, 1],
+         [3, 184, 80, 0, 1],
+         [3, 184, 80, 0, 1],
+         [3, 480, 112, 0.25, 1],
+         [3, 672, 112, 0.25, 1]],
+        [[5, 672, 160, 0.25, 2]],
+        [[5, 960, 160, 0, 1],
+         [5, 960, 160, 0.25, 1],
+         [5, 960, 160, 0, 1],
+         [5, 960, 160, 0.25, 1]],
+    ]
+    return build_model_with_cfg(
+        GhostNet, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(flatten_sequential=True),
+        cfgs=cfgs, width=width,
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'conv_stem', 'classifier': 'classifier',
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'ghostnet_050.untrained': _cfg(),
+    'ghostnet_100.in1k': _cfg(hf_hub_id='timm/'),
+    'ghostnet_130.untrained': _cfg(),
+    'ghostnetv2_100.in1k': _cfg(hf_hub_id='timm/'),
+    'ghostnetv2_130.in1k': _cfg(hf_hub_id='timm/'),
+    'ghostnetv2_160.in1k': _cfg(hf_hub_id='timm/'),
+})
+
+
+@register_model
+def ghostnet_050(pretrained=False, **kwargs) -> GhostNet:
+    return _create_ghostnet('ghostnet_050', width=0.5, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def ghostnet_100(pretrained=False, **kwargs) -> GhostNet:
+    return _create_ghostnet('ghostnet_100', width=1.0, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def ghostnet_130(pretrained=False, **kwargs) -> GhostNet:
+    return _create_ghostnet('ghostnet_130', width=1.3, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def ghostnetv2_100(pretrained=False, **kwargs) -> GhostNet:
+    return _create_ghostnet('ghostnetv2_100', width=1.0, pretrained=pretrained, version='v2', **kwargs)
+
+
+@register_model
+def ghostnetv2_130(pretrained=False, **kwargs) -> GhostNet:
+    return _create_ghostnet('ghostnetv2_130', width=1.3, pretrained=pretrained, version='v2', **kwargs)
+
+
+@register_model
+def ghostnetv2_160(pretrained=False, **kwargs) -> GhostNet:
+    return _create_ghostnet('ghostnetv2_160', width=1.6, pretrained=pretrained, version='v2', **kwargs)

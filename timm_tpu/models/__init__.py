@@ -20,6 +20,7 @@ from .cait import Cait
 from .convnext import ConvNeXt
 from .deit import VisionTransformerDistilled
 from .densenet import DenseNet
+from .dpn import DPN
 from .efficientnet import EfficientNet
 from .eva import Eva
 from .ghostnet import GhostNet
@@ -36,6 +37,7 @@ from .regnet import RegNet
 from .res2net import Bottle2neck
 from .resnest import ResNestBottleneck
 from .resnet import ResNet
+from .rexnet import RexNet
 from .sknet import SelectiveKernelBasic, SelectiveKernelBottleneck
 from .resnetv2 import ResNetV2
 from .swin_transformer import SwinTransformer

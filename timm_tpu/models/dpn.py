@@ -1,0 +1,371 @@
+"""DPN: Dual Path Networks, TPU-native NHWC
+(reference: timm/models/dpn.py:1-400; Chen et al. 2017).
+
+Blocks carry a (residual, dense) tuple; the dense path grows by `inc`
+channels per block via concat — all static NHWC slices/concats.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    BatchNormAct2d, ConvNormAct, Dropout, Pool2d, SelectAdaptivePool2d,
+    create_conv2d, trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['DPN']
+
+
+class CatBnAct(nnx.Module):
+    def __init__(self, in_chs, act_layer='relu', *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.bn = BatchNormAct2d(in_chs, eps=0.001, act_layer=act_layer,
+                                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        if isinstance(x, tuple):
+            x = jnp.concatenate(x, axis=-1)
+        return self.bn(x)
+
+
+class BnActConv2d(nnx.Module):
+    def __init__(self, in_chs, out_chs, kernel_size, stride, groups=1, act_layer='relu',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.bn = BatchNormAct2d(in_chs, eps=0.001, act_layer=act_layer,
+                                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv = create_conv2d(in_chs, out_chs, kernel_size, stride=stride, groups=groups,
+                                  dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        return self.conv(self.bn(x))
+
+
+class DualPathBlock(nnx.Module):
+    """(reference dpn.py:86-186)."""
+
+    def __init__(self, in_chs, num_1x1_a, num_3x3_b, num_1x1_c, inc, groups,
+                 block_type='normal', b=False, act_layer='relu',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.num_1x1_c = num_1x1_c
+        self.inc = inc
+        self.b = b
+        kw = dict(act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        if block_type == 'proj':
+            self.key_stride = 1
+            has_proj = True
+        elif block_type == 'down':
+            self.key_stride = 2
+            has_proj = True
+        else:
+            assert block_type == 'normal'
+            self.key_stride = 1
+            has_proj = False
+
+        # distinct names for stride variants match the reference's checkpoint keys
+        if has_proj and self.key_stride == 2:
+            self.c1x1_w_s2 = BnActConv2d(in_chs, num_1x1_c + 2 * inc, 1, 2, **kw)
+            self.c1x1_w_s1 = None
+        elif has_proj:
+            self.c1x1_w_s1 = BnActConv2d(in_chs, num_1x1_c + 2 * inc, 1, 1, **kw)
+            self.c1x1_w_s2 = None
+        else:
+            self.c1x1_w_s1 = None
+            self.c1x1_w_s2 = None
+
+        self.c1x1_a = BnActConv2d(in_chs, num_1x1_a, 1, 1, **kw)
+        self.c3x3_b = BnActConv2d(num_1x1_a, num_3x3_b, 3, self.key_stride, groups=groups, **kw)
+        if b:
+            self.c1x1_c = CatBnAct(num_3x3_b, act_layer=act_layer,
+                                   dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.c1x1_c1 = create_conv2d(num_3x3_b, num_1x1_c, 1,
+                                         dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.c1x1_c2 = create_conv2d(num_3x3_b, inc, 1,
+                                         dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        else:
+            self.c1x1_c = BnActConv2d(num_3x3_b, num_1x1_c + inc, 1, 1, **kw)
+            self.c1x1_c1 = None
+            self.c1x1_c2 = None
+
+    def __call__(self, x):
+        x_in = jnp.concatenate(x, axis=-1) if isinstance(x, tuple) else x
+        if self.c1x1_w_s1 is None and self.c1x1_w_s2 is None:
+            x_s1, x_s2 = x
+        else:
+            x_s = self.c1x1_w_s1(x_in) if self.c1x1_w_s1 is not None else self.c1x1_w_s2(x_in)
+            x_s1 = x_s[..., :self.num_1x1_c]
+            x_s2 = x_s[..., self.num_1x1_c:]
+        y = self.c1x1_a(x_in)
+        y = self.c3x3_b(y)
+        y = self.c1x1_c(y)
+        if self.c1x1_c1 is not None:
+            out1 = self.c1x1_c1(y)
+            out2 = self.c1x1_c2(y)
+        else:
+            out1 = y[..., :self.num_1x1_c]
+            out2 = y[..., self.num_1x1_c:]
+        resid = x_s1 + out1
+        dense = jnp.concatenate([x_s2, out2], axis=-1)
+        return resid, dense
+
+
+class DPN(nnx.Module):
+    """DPN with the reference's model contract (reference dpn.py:189-330)."""
+
+    def __init__(
+            self,
+            k_sec: Tuple[int, ...] = (3, 4, 20, 3),
+            inc_sec: Tuple[int, ...] = (16, 32, 24, 128),
+            k_r: int = 96,
+            groups: int = 32,
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            output_stride: int = 32,
+            global_pool: str = 'avg',
+            small: bool = False,
+            num_init_features: int = 64,
+            b: bool = False,
+            drop_rate: float = 0.0,
+            act_layer: str = 'relu',
+            fc_act_layer: str = 'elu',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert output_stride == 32
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        self.b = b
+        self.grad_checkpointing = False
+        kw = dict(act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        bw_factor = 1 if small else 4
+
+        blocks = OrderedDict()
+        blocks['conv1_1'] = ConvNormAct(
+            in_chans, num_init_features, kernel_size=3 if small else 7, stride=2,
+            norm_layer=partial(BatchNormAct2d, eps=0.001), act_layer=act_layer,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.feature_info = [dict(num_chs=num_init_features, reduction=2, module='features.conv1_1')]
+
+        in_chs = num_init_features
+        for sec, (bw_mult, block_count, inc) in enumerate(zip((64, 128, 256, 512), k_sec, inc_sec)):
+            bw = bw_mult * bw_factor
+            r = (k_r * bw) // (64 * bw_factor)
+            btype = 'proj' if sec == 0 else 'down'
+            blocks[f'conv{sec + 2}_1'] = DualPathBlock(in_chs, r, r, bw, inc, groups, btype, b, **kw)
+            in_chs = bw + 3 * inc
+            for i in range(2, block_count + 1):
+                blocks[f'conv{sec + 2}_{i}'] = DualPathBlock(
+                    in_chs, r, r, bw, inc, groups, 'normal', b, **kw)
+                in_chs += inc
+            self.feature_info += [dict(
+                num_chs=in_chs, reduction=4 * 2 ** sec, module=f'features.conv{sec + 2}_{block_count}')]
+        # reference quirk preserved: fc_act_layer is silently dropped upstream
+        # (get_norm_act_layer receives an already-act-bound partial), so the
+        # final norm-act actually runs act_layer (relu) — verified empirically
+        blocks['conv5_bn_ac'] = CatBnAct(in_chs, act_layer=act_layer,
+                                         dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self._block_names = list(blocks.keys())
+        for name, mod in blocks.items():
+            setattr(self, f'features_{name}', mod)
+
+        self.num_features = self.head_hidden_size = in_chs
+        self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=False)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        # 1x1-conv classifier (reference uses conv fc for extra pooling schemes)
+        self.classifier = nnx.Conv(
+            in_chs, num_classes, kernel_size=(1, 1), use_bias=True,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(stem=r'^features_conv1', blocks=r'^features_conv(\d+)')
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        assert not enable, 'gradient checkpointing not supported'
+
+    def get_classifier(self):
+        return self.classifier
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=False)
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.classifier = nnx.Conv(
+            self.num_features, num_classes, kernel_size=(1, 1), use_bias=True,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def _run_blocks(self, x, collect=None, stop_at=None):
+        pool = Pool2d('max', 3, 2, 1)
+        intermediates = []
+        for name in self._block_names:
+            mod = getattr(self, f'features_{name}')
+            x = mod(x)
+            if collect is not None and name in collect:
+                # stem feature is the PRE-pool conv output (reference collects
+                # features.conv1_1, with conv1_pool a separate module)
+                intermediates.append(jnp.concatenate(x, axis=-1) if isinstance(x, tuple) else x)
+            if name == 'conv1_1':
+                x = pool(x)
+            if stop_at is not None and name == stop_at:
+                break
+        return x, intermediates
+
+    def forward_features(self, x):
+        x, _ = self._run_blocks(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        pooled = not self.global_pool.is_identity()
+        x = self.global_pool(x)
+        if x.ndim == 2:
+            x = x[:, None, None, :]
+        x = self.head_drop(x)
+        if pre_logits or self.classifier is None:
+            return x.reshape(x.shape[0], -1) if pooled else x
+        x = self.classifier(x)
+        # conv classifier yields a spatial logit map when pooling is disabled
+        return x.reshape(x.shape[0], -1) if pooled else x
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.feature_info), indices)
+        collect = {self.feature_info[i]['module'].split('.')[-1] for i in take_indices}
+        stop_at = self.feature_info[max_index]['module'].split('.')[-1] if stop_early else None
+        x, intermediates = self._run_blocks(x, collect=collect, stop_at=stop_at)
+        if intermediates_only:
+            return intermediates
+        if isinstance(x, tuple):
+            x = jnp.concatenate(x, axis=-1)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, _ = feature_take_indices(len(self.feature_info), indices)
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    out = {}
+    for k, v in state_dict.items():
+        # torch Sequential(OrderedDict) 'features.convX_Y.*' → flat attrs
+        if k.startswith('features.'):
+            rest = k[len('features.'):]
+            name, _, tail = rest.partition('.')
+            k = f'features_{name}.{tail}'
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_dpn(variant, pretrained=False, **kwargs):
+    return build_model_with_cfg(
+        DPN, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(feature_concat=True, flatten_sequential=True),
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bicubic',
+        'mean': (124 / 255, 117 / 255, 104 / 255), 'std': (1 / (0.0167 * 255),) * 3,
+        'first_conv': 'features_conv1_1.conv', 'classifier': 'classifier',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'dpn48b.untrained': _cfg(mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'dpn68.mx_in1k': _cfg(hf_hub_id='timm/'),
+    'dpn68b.ra_in1k': _cfg(
+        hf_hub_id='timm/', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225),
+        crop_pct=0.95, test_input_size=(3, 288, 288), test_crop_pct=1.0),
+    'dpn92.mx_in1k': _cfg(hf_hub_id='timm/'),
+    'dpn98.mx_in1k': _cfg(hf_hub_id='timm/'),
+    'dpn131.mx_in1k': _cfg(hf_hub_id='timm/'),
+    'dpn107.mx_in1k': _cfg(hf_hub_id='timm/'),
+})
+
+
+@register_model
+def dpn48b(pretrained=False, **kwargs) -> DPN:
+    model_args = dict(
+        small=True, num_init_features=10, k_r=128, groups=32,
+        b=True, k_sec=(3, 4, 6, 3), inc_sec=(16, 32, 32, 64), act_layer='silu')
+    return _create_dpn('dpn48b', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def dpn68(pretrained=False, **kwargs) -> DPN:
+    model_args = dict(
+        small=True, num_init_features=10, k_r=128, groups=32,
+        k_sec=(3, 4, 12, 3), inc_sec=(16, 32, 32, 64))
+    return _create_dpn('dpn68', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def dpn68b(pretrained=False, **kwargs) -> DPN:
+    model_args = dict(
+        small=True, num_init_features=10, k_r=128, groups=32,
+        b=True, k_sec=(3, 4, 12, 3), inc_sec=(16, 32, 32, 64))
+    return _create_dpn('dpn68b', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def dpn92(pretrained=False, **kwargs) -> DPN:
+    model_args = dict(
+        num_init_features=64, k_r=96, groups=32,
+        k_sec=(3, 4, 20, 3), inc_sec=(16, 32, 24, 128))
+    return _create_dpn('dpn92', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def dpn98(pretrained=False, **kwargs) -> DPN:
+    model_args = dict(
+        num_init_features=96, k_r=160, groups=40,
+        k_sec=(3, 6, 20, 3), inc_sec=(16, 32, 32, 128))
+    return _create_dpn('dpn98', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def dpn131(pretrained=False, **kwargs) -> DPN:
+    model_args = dict(
+        num_init_features=128, k_r=160, groups=40,
+        k_sec=(4, 8, 28, 3), inc_sec=(16, 32, 32, 128))
+    return _create_dpn('dpn131', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def dpn107(pretrained=False, **kwargs) -> DPN:
+    model_args = dict(
+        num_init_features=128, k_r=200, groups=50,
+        k_sec=(4, 8, 20, 3), inc_sec=(20, 64, 64, 128))
+    return _create_dpn('dpn107', pretrained=pretrained, **dict(model_args, **kwargs))

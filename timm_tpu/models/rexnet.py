@@ -1,0 +1,320 @@
+"""ReXNet, TPU-native NHWC
+(reference: timm/models/rexnet.py:1-610; Han et al. 2020).
+
+Linearly growing channel schedule over MBConv-style blocks with partial
+residual adds (only the first in_chs channels are residual) — the channel
+slice+concat is a static NHWC op XLA folds away.
+"""
+from __future__ import annotations
+
+from functools import partial
+from math import ceil
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    BatchNorm2d, ClassifierHead, ConvNormAct, SEModule, get_act_fn, make_divisible,
+)
+from ..layers.drop import DropPath
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['RexNet']
+
+SEWithNorm = partial(SEModule, norm_layer=BatchNorm2d)
+
+
+class LinearBottleneck(nnx.Module):
+    """(reference rexnet.py:28-133)."""
+
+    def __init__(self, in_chs, out_chs, stride, dilation=(1, 1), exp_ratio=1.0,
+                 se_ratio=0.0, ch_div=1, act_layer='swish', dw_act_layer='relu6',
+                 drop_path=0.0, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.use_shortcut = stride == 1 and dilation[0] == dilation[1] and in_chs <= out_chs
+        self.in_channels = in_chs
+        self.out_channels = out_chs
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        if exp_ratio != 1.0:
+            dw_chs = make_divisible(round(in_chs * exp_ratio), divisor=ch_div)
+            self.conv_exp = ConvNormAct(in_chs, dw_chs, act_layer=act_layer, **kw)
+        else:
+            dw_chs = in_chs
+            self.conv_exp = None
+        self.conv_dw = ConvNormAct(
+            dw_chs, dw_chs, kernel_size=3, stride=stride, dilation=dilation[0],
+            groups=dw_chs, apply_act=False, **kw)
+        if se_ratio > 0:
+            self.se = SEWithNorm(
+                dw_chs, rd_channels=make_divisible(int(dw_chs * se_ratio), ch_div), **kw)
+        else:
+            self.se = None
+        self.act_dw = get_act_fn(dw_act_layer)
+        self.conv_pwl = ConvNormAct(dw_chs, out_chs, 1, apply_act=False, **kw)
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def feat_channels(self, exp: bool = False) -> int:
+        return self.out_channels
+
+    def __call__(self, x):
+        shortcut = x
+        if self.conv_exp is not None:
+            x = self.conv_exp(x)
+        x = self.conv_dw(x)
+        if self.se is not None:
+            x = self.se(x)
+        x = self.act_dw(x)
+        x = self.conv_pwl(x)
+        if self.use_shortcut:
+            x = self.drop_path(x)
+            # partial residual: only the leading in_chs channels add the input
+            head = x[..., :self.in_channels] + shortcut
+            x = jnp.concatenate([head, x[..., self.in_channels:]], axis=-1)
+        return x
+
+
+def _block_cfg(width_mult=1.0, depth_mult=1.0, initial_chs=16, final_chs=180,
+               se_ratio=0.0, ch_div=1):
+    """(reference rexnet.py:136-173)."""
+    layers = [1, 2, 2, 3, 3, 5]
+    strides = [1, 2, 2, 2, 1, 2]
+    layers = [ceil(el * depth_mult) for el in layers]
+    strides = sum([[el] + [1] * (layers[i] - 1) for i, el in enumerate(strides)], [])
+    exp_ratios = [1] * layers[0] + [6] * sum(layers[1:])
+    depth = sum(layers) * 3
+    base_chs = initial_chs / width_mult if width_mult < 1.0 else initial_chs
+    out_chs_list = []
+    for _ in range(depth // 3):
+        out_chs_list.append(make_divisible(round(base_chs * width_mult), divisor=ch_div))
+        base_chs += final_chs / (depth // 3 * 1.0)
+    se_ratios = [0.0] * (layers[0] + layers[1]) + [se_ratio] * sum(layers[2:])
+    return list(zip(out_chs_list, exp_ratios, strides, se_ratios))
+
+
+class RexNet(nnx.Module):
+    """ReXNet with the reference's model contract (reference rexnet.py:243-470)."""
+
+    def __init__(
+            self,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            output_stride: int = 32,
+            initial_chs: int = 16,
+            final_chs: int = 180,
+            width_mult: float = 1.0,
+            depth_mult: float = 1.0,
+            se_ratio: float = 1 / 12.0,
+            ch_div: int = 1,
+            act_layer: str = 'swish',
+            dw_act_layer: str = 'relu6',
+            drop_rate: float = 0.2,
+            drop_path_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert output_stride == 32
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        self.grad_checkpointing = False
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        stem_base_chs = 32 / width_mult if width_mult < 1.0 else 32
+        stem_chs = make_divisible(round(stem_base_chs * width_mult), divisor=ch_div)
+        self.stem = ConvNormAct(in_chans, stem_chs, 3, stride=2, act_layer=act_layer, **kw)
+
+        block_cfg = _block_cfg(width_mult, depth_mult, initial_chs, final_chs, se_ratio, ch_div)
+        feat_chs = [stem_chs]
+        self.feature_info = []
+        curr_stride = 2
+        features = []
+        num_blocks = len(block_cfg)
+        prev_chs = stem_chs
+        for block_idx, (chs, exp_ratio, stride, block_se) in enumerate(block_cfg):
+            if stride > 1:
+                fname = 'stem' if block_idx == 0 else f'features.{block_idx - 1}'
+                self.feature_info += [dict(num_chs=feat_chs[-1], reduction=curr_stride, module=fname)]
+            block_dpr = drop_path_rate * block_idx / (num_blocks - 1)
+            features.append(LinearBottleneck(
+                in_chs=prev_chs, out_chs=chs, exp_ratio=exp_ratio, stride=stride,
+                se_ratio=block_se, ch_div=ch_div, act_layer=act_layer,
+                dw_act_layer=dw_act_layer, drop_path=block_dpr, **kw))
+            curr_stride *= stride
+            prev_chs = chs
+            feat_chs += [features[-1].feat_channels()]
+        pen_chs = make_divisible(1280 * width_mult, divisor=ch_div)
+        self.feature_info += [dict(
+            num_chs=feat_chs[-1], reduction=curr_stride, module=f'features.{len(features) - 1}')]
+        features.append(ConvNormAct(prev_chs, pen_chs, act_layer=act_layer, **kw))
+        self.features = nnx.List(features)
+        self.num_features = self.head_hidden_size = pen_chs
+        self.head = ClassifierHead(self.num_features, num_classes, global_pool, drop_rate, **kw)
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(stem=r'^stem', blocks=r'^features\.(\d+)')
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        self.head.reset(num_classes, pool_type=global_pool, rngs=rngs)
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        from ._manipulate import checkpoint_seq
+        x = self.stem(x)
+        if self.grad_checkpointing:
+            x = checkpoint_seq(self.features, x)
+        else:
+            for f in self.features:
+                x = f(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        return self.head(x, pre_logits=pre_logits)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        # feature entries address stride-change boundaries recorded in
+        # feature_info; map them onto flat feature-block indices
+        module_ids = []
+        for fi in self.feature_info:
+            m = fi['module']
+            module_ids.append(-1 if m == 'stem' else int(m.split('.')[1]))
+        take_indices, max_index = feature_take_indices(len(module_ids), indices)
+        take_blocks = {module_ids[i]: i for i in take_indices}
+        max_block = module_ids[max_index]
+        x = self.stem(x)
+        intermediates = []
+        if -1 in take_blocks:
+            intermediates.append(x)
+        for i, f in enumerate(self.features):
+            if stop_early and i > max_block:
+                break
+            x = f(x)
+            if i in take_blocks:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        module_ids = [
+            -1 if fi['module'] == 'stem' else int(fi['module'].split('.')[1])
+            for fi in self.feature_info]
+        take_indices, max_index = feature_take_indices(len(module_ids), indices)
+        self.features = nnx.List(list(self.features)[:module_ids[max_index] + 1])
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    return convert_torch_state_dict(state_dict, model)
+
+
+def _create_rexnet(variant, pretrained=False, **kwargs):
+    return build_model_with_cfg(
+        RexNet, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(flatten_sequential=True),
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'stem.conv', 'classifier': 'head.fc',
+        'license': 'mit',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'rexnet_100.nav_in1k': _cfg(hf_hub_id='timm/'),
+    'rexnet_130.nav_in1k': _cfg(hf_hub_id='timm/'),
+    'rexnet_150.nav_in1k': _cfg(hf_hub_id='timm/'),
+    'rexnet_200.nav_in1k': _cfg(hf_hub_id='timm/'),
+    'rexnet_300.nav_in1k': _cfg(hf_hub_id='timm/'),
+    'rexnetr_100.untrained': _cfg(),
+    'rexnetr_130.untrained': _cfg(),
+    'rexnetr_150.untrained': _cfg(),
+    'rexnetr_200.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/', crop_pct=0.95,
+                                         test_input_size=(3, 288, 288), test_crop_pct=1.0),
+    'rexnetr_300.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/', crop_pct=0.95,
+                                         test_input_size=(3, 288, 288), test_crop_pct=1.0),
+})
+
+
+@register_model
+def rexnet_100(pretrained=False, **kwargs) -> RexNet:
+    return _create_rexnet('rexnet_100', pretrained, **kwargs)
+
+
+@register_model
+def rexnet_130(pretrained=False, **kwargs) -> RexNet:
+    return _create_rexnet('rexnet_130', pretrained, width_mult=1.3, **kwargs)
+
+
+@register_model
+def rexnet_150(pretrained=False, **kwargs) -> RexNet:
+    return _create_rexnet('rexnet_150', pretrained, width_mult=1.5, **kwargs)
+
+
+@register_model
+def rexnet_200(pretrained=False, **kwargs) -> RexNet:
+    return _create_rexnet('rexnet_200', pretrained, width_mult=2.0, **kwargs)
+
+
+@register_model
+def rexnet_300(pretrained=False, **kwargs) -> RexNet:
+    return _create_rexnet('rexnet_300', pretrained, width_mult=3.0, **kwargs)
+
+
+@register_model
+def rexnetr_100(pretrained=False, **kwargs) -> RexNet:
+    return _create_rexnet('rexnetr_100', pretrained, ch_div=8, **kwargs)
+
+
+@register_model
+def rexnetr_130(pretrained=False, **kwargs) -> RexNet:
+    return _create_rexnet('rexnetr_130', pretrained, width_mult=1.3, ch_div=8, **kwargs)
+
+
+@register_model
+def rexnetr_150(pretrained=False, **kwargs) -> RexNet:
+    return _create_rexnet('rexnetr_150', pretrained, width_mult=1.5, ch_div=8, **kwargs)
+
+
+@register_model
+def rexnetr_200(pretrained=False, **kwargs) -> RexNet:
+    return _create_rexnet('rexnetr_200', pretrained, width_mult=2.0, ch_div=8, **kwargs)
+
+
+@register_model
+def rexnetr_300(pretrained=False, **kwargs) -> RexNet:
+    return _create_rexnet('rexnetr_300', pretrained, width_mult=3.0, ch_div=16, **kwargs)

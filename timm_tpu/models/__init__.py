@@ -21,6 +21,7 @@ from .convnext import ConvNeXt
 from .deit import VisionTransformerDistilled
 from .densenet import DenseNet
 from .dpn import DPN
+from .edgenext import EdgeNeXt
 from .efficientnet import EfficientNet
 from .eva import Eva
 from .ghostnet import GhostNet

@@ -1,0 +1,443 @@
+"""EdgeNeXt, TPU-native NHWC
+(reference: timm/models/edgenext.py:1-712; Maaz et al. 2022).
+
+ConvNeXt-style local blocks + Split-Transpose global blocks: a Res2Net-like
+depthwise cascade over channel splits followed by cross-covariance (channel)
+attention. Reuses XCiT's Fourier positional encoding.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    ClassifierHead, DropPath, Dropout, LayerNorm, Mlp, NormMlpClassifierHead,
+    calculate_drop_path_rates, create_conv2d, trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+from .xcit import PositionalEncodingFourier
+
+__all__ = ['EdgeNeXt']
+
+
+class ConvBlock(nnx.Module):
+    """ConvNeXt-style block w/ optional down-stride (reference edgenext.py:84)."""
+
+    def __init__(self, dim, dim_out=None, kernel_size=7, stride=1, conv_bias=True,
+                 expand_ratio=4.0, ls_init_value=1e-6, act_layer='gelu', drop_path=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        dim_out = dim_out or dim
+        self.shortcut_after_dw = stride > 1 or dim != dim_out
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv_dw = create_conv2d(
+            dim, dim_out, kernel_size=kernel_size, stride=stride, depthwise=True,
+            bias=conv_bias, **kw)
+        self.norm = LayerNorm(dim_out, eps=1e-6, rngs=rngs)
+        self.mlp = Mlp(dim_out, int(expand_ratio * dim_out), act_layer=act_layer, **kw)
+        self.gamma = nnx.Param(jnp.full((dim_out,), ls_init_value, param_dtype)) \
+            if ls_init_value > 0 else None
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.conv_dw(x)
+        if self.shortcut_after_dw:
+            shortcut = x
+        x = self.mlp(self.norm(x))
+        if self.gamma is not None:
+            x = self.gamma[...].astype(x.dtype) * x
+        return shortcut + self.drop_path(x)
+
+
+class CrossCovarianceAttn(nnx.Module):
+    """Channel (C x C) attention (reference edgenext.py:141)."""
+
+    def __init__(self, dim, num_heads=8, qkv_bias=False, attn_drop=0.0, proj_drop=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.num_heads = num_heads
+        self.temperature = nnx.Param(jnp.ones((num_heads, 1, 1), param_dtype))
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.qkv = linear(dim, dim * 3, use_bias=qkv_bias)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = linear(dim, dim)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x):
+        B, N, C = x.shape
+        d = C // self.num_heads
+        qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, d).transpose(2, 0, 3, 4, 1)
+        q, k, v = qkv[0], qkv[1], qkv[2]  # (B, h, d, N)
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True), 1e-12)
+        k = k / jnp.maximum(jnp.linalg.norm(k, axis=-1, keepdims=True), 1e-12)
+        attn = jnp.einsum('bhdn,bhen->bhde', q, k) * self.temperature[...].astype(q.dtype)
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = self.attn_drop(attn)
+        x = jnp.einsum('bhde,bhen->bhdn', attn, v)
+        x = x.transpose(0, 3, 1, 2).reshape(B, N, C)
+        return self.proj_drop(self.proj(x))
+
+    def no_weight_decay(self):
+        return {'temperature'}
+
+
+class SplitTransposeBlock(nnx.Module):
+    """Res2Net-style split conv cascade + XCA + MLP (reference edgenext.py:183)."""
+
+    def __init__(self, dim, num_scales=1, num_heads=8, expand_ratio=4.0, use_pos_emb=True,
+                 conv_bias=True, qkv_bias=True, ls_init_value=1e-6, act_layer='gelu',
+                 drop_path=0.0, attn_drop=0.0, proj_drop=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        width = max(int(math.ceil(dim / num_scales)), int(math.floor(dim // num_scales)))
+        self.width = width
+        self.num_scales = max(1, num_scales - 1)
+        self.dim = dim
+        self.convs = nnx.List([
+            create_conv2d(width, width, kernel_size=3, depthwise=True, bias=conv_bias, **kw)
+            for _ in range(self.num_scales)
+        ])
+        self.pos_embd = PositionalEncodingFourier(dim=dim, **kw) if use_pos_emb else None
+        self.norm_xca = LayerNorm(dim, eps=1e-6, rngs=rngs)
+        self.gamma_xca = nnx.Param(jnp.full((dim,), ls_init_value, param_dtype)) \
+            if ls_init_value > 0 else None
+        self.xca = CrossCovarianceAttn(
+            dim, num_heads=num_heads, qkv_bias=qkv_bias, attn_drop=attn_drop,
+            proj_drop=proj_drop, **kw)
+        self.norm = LayerNorm(dim, eps=1e-6, rngs=rngs)
+        self.mlp = Mlp(dim, int(expand_ratio * dim), act_layer=act_layer, **kw)
+        self.gamma = nnx.Param(jnp.full((dim,), ls_init_value, param_dtype)) \
+            if ls_init_value > 0 else None
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        shortcut = x
+        B, H, W, C = x.shape
+        # torch chunk(n) may yield a short last chunk; channel dims here are
+        # sized so the even split matches the reference
+        n_chunks = len(self.convs) + 1
+        chunk = -(-C // n_chunks)
+        spx = [x[..., i * chunk:(i + 1) * chunk] for i in range(n_chunks)]
+        spo = []
+        sp = spx[0]
+        for i, conv in enumerate(self.convs):
+            if i > 0:
+                sp = sp + spx[i]
+            sp = conv(sp)
+            spo.append(sp)
+        spo.append(spx[-1])
+        x = jnp.concatenate(spo, axis=-1)
+
+        x = x.reshape(B, H * W, C)
+        if self.pos_embd is not None:
+            pos = self.pos_embd(H, W).reshape(1, -1, C)
+            x = x + pos.astype(x.dtype)
+        y = self.xca(self.norm_xca(x))
+        if self.gamma_xca is not None:
+            y = self.gamma_xca[...].astype(y.dtype) * y
+        x = x + self.drop_path(y)
+        x = x.reshape(B, H, W, C)
+
+        y = self.mlp(self.norm(x))
+        if self.gamma is not None:
+            y = self.gamma[...].astype(y.dtype) * y
+        return shortcut + self.drop_path(y)
+
+
+class _DownsampleNormConv(nnx.Module):
+    def __init__(self, in_chs, out_chs, conv_bias, *, dtype=None, param_dtype=jnp.float32, rngs):
+        self.norm = LayerNorm(in_chs, eps=1e-6, rngs=rngs)
+        self.conv = nnx.Conv(
+            in_chs, out_chs, kernel_size=(2, 2), strides=2, padding='VALID', use_bias=conv_bias,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        return self.conv(self.norm(x))
+
+
+class EdgeNeXtStage(nnx.Module):
+    def __init__(self, in_chs, out_chs, stride=2, depth=2, num_global_blocks=1,
+                 num_heads=4, scales=2, kernel_size=7, expand_ratio=4.0,
+                 use_pos_emb=False, downsample_block=False, conv_bias=True,
+                 ls_init_value=1.0, drop_path_rates=None, act_layer='gelu',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.grad_checkpointing = False
+        if downsample_block or stride == 1:
+            self.downsample = None
+        else:
+            self.downsample = _DownsampleNormConv(in_chs, out_chs, conv_bias, **kw)
+            in_chs = out_chs
+        blocks = []
+        for i in range(depth):
+            if i < depth - num_global_blocks:
+                blocks.append(ConvBlock(
+                    dim=in_chs, dim_out=out_chs,
+                    stride=stride if downsample_block and i == 0 else 1,
+                    conv_bias=conv_bias, kernel_size=kernel_size,
+                    expand_ratio=expand_ratio, ls_init_value=ls_init_value,
+                    act_layer=act_layer, drop_path=drop_path_rates[i], **kw))
+            else:
+                blocks.append(SplitTransposeBlock(
+                    dim=in_chs, num_scales=scales, num_heads=num_heads,
+                    expand_ratio=expand_ratio, use_pos_emb=use_pos_emb,
+                    conv_bias=conv_bias, ls_init_value=ls_init_value,
+                    drop_path=drop_path_rates[i], act_layer=act_layer, **kw))
+            in_chs = out_chs
+        self.blocks = nnx.List(blocks)
+
+    def __call__(self, x):
+        if self.downsample is not None:
+            x = self.downsample(x)
+        if self.grad_checkpointing:
+            x = checkpoint_seq(self.blocks, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
+        return x
+
+
+class _Stem(nnx.Module):
+    def __init__(self, in_chans, dim, stem_type, conv_bias, *, dtype=None,
+                 param_dtype=jnp.float32, rngs):
+        if stem_type == 'patch':
+            self.conv = nnx.Conv(in_chans, dim, kernel_size=(4, 4), strides=4, padding='VALID',
+                                 use_bias=conv_bias, kernel_init=trunc_normal_(std=0.02),
+                                 bias_init=zeros_, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        else:  # overlap
+            self.conv = nnx.Conv(in_chans, dim, kernel_size=(9, 9), strides=4,
+                                 padding=[(4, 4), (4, 4)], use_bias=conv_bias,
+                                 kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+                                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm = LayerNorm(dim, eps=1e-6, rngs=rngs)
+
+    def __call__(self, x):
+        return self.norm(self.conv(x))
+
+
+class EdgeNeXt(nnx.Module):
+    """EdgeNeXt with the reference's model contract (reference edgenext.py:355-560)."""
+
+    def __init__(
+            self,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            dims: Tuple[int, ...] = (24, 48, 88, 168),
+            depths: Tuple[int, ...] = (3, 3, 9, 3),
+            global_block_counts: Tuple[int, ...] = (0, 1, 1, 1),
+            kernel_sizes: Tuple[int, ...] = (3, 5, 7, 9),
+            heads: Tuple[int, ...] = (8, 8, 8, 8),
+            d2_scales: Tuple[int, ...] = (2, 2, 3, 4),
+            use_pos_emb: Tuple[bool, ...] = (False, True, False, False),
+            ls_init_value: float = 1e-6,
+            head_init_scale: float = 1.0,
+            expand_ratio: float = 4.0,
+            downsample_block: bool = False,
+            conv_bias: bool = True,
+            stem_type: str = 'patch',
+            head_norm_first: bool = False,
+            act_layer: str = 'gelu',
+            drop_path_rate: float = 0.0,
+            drop_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert stem_type in ('patch', 'overlap')
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.drop_rate = drop_rate
+        self.feature_info = []
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        self.stem = _Stem(in_chans, dims[0], stem_type, conv_bias, **kw)
+        curr_stride = 4
+        dp_rates = calculate_drop_path_rates(drop_path_rate, list(depths), stagewise=True)
+        stages = []
+        in_chs = dims[0]
+        for i in range(4):
+            stride = 2 if curr_stride == 2 or i > 0 else 1
+            curr_stride *= stride
+            stages.append(EdgeNeXtStage(
+                in_chs=in_chs, out_chs=dims[i], stride=stride, depth=depths[i],
+                num_global_blocks=global_block_counts[i], num_heads=heads[i],
+                drop_path_rates=dp_rates[i], scales=d2_scales[i],
+                expand_ratio=expand_ratio, kernel_size=kernel_sizes[i],
+                use_pos_emb=use_pos_emb[i], ls_init_value=ls_init_value,
+                downsample_block=downsample_block, conv_bias=conv_bias,
+                act_layer=act_layer, **kw))
+            in_chs = dims[i]
+            self.feature_info += [dict(num_chs=in_chs, reduction=curr_stride, module=f'stages.{i}')]
+        self.stages = nnx.List(stages)
+
+        self.num_features = self.head_hidden_size = dims[-1]
+        if head_norm_first:
+            self.norm_pre = LayerNorm(self.num_features, eps=1e-6, rngs=rngs)
+            self.head = ClassifierHead(
+                self.num_features, num_classes, pool_type=global_pool, drop_rate=drop_rate, **kw)
+        else:
+            self.norm_pre = None
+            self.head = NormMlpClassifierHead(
+                self.num_features, num_classes, pool_type=global_pool, drop_rate=drop_rate,
+                norm_layer=partial(LayerNorm, eps=1e-6), **kw)
+        if head_init_scale != 1.0 and self.head.fc is not None:
+            self.head.fc.kernel[...] = self.head.fc.kernel[...] * head_init_scale
+            self.head.fc.bias[...] = self.head.fc.bias[...] * head_init_scale
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'temperature'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^stem',
+            blocks=r'^stages\.(\d+)' if coarse else [
+                (r'^stages\.(\d+)\.downsample', (0,)),
+                (r'^stages\.(\d+)\.blocks\.(\d+)', None),
+                (r'^norm_pre', (99999,)),
+            ],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        for s in self.stages:
+            s.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        self.head.reset(num_classes, pool_type=global_pool, rngs=rngs)
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.stem(x)
+        for stage in self.stages:
+            x = stage(x)
+        if self.norm_pre is not None:
+            x = self.norm_pre(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        return self.head(x, pre_logits=pre_logits)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        x = self.stem(x)
+        intermediates = []
+        stages = self.stages if not stop_early else list(self.stages)[:max_index + 1]
+        for i, stage in enumerate(stages):
+            x = stage(x)
+            if i in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        if self.norm_pre is not None:
+            x = self.norm_pre(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        self.stages = nnx.List(list(self.stages)[:max_index + 1])
+        if prune_norm:
+            self.norm_pre = None
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    import re
+    out = {}
+    for k, v in state_dict.items():
+        # torch Sequentials: stem.{0,1}, stages.N.downsample.{0,1}
+        k = re.sub(r'^stem\.0\.', 'stem.conv.', k)
+        k = re.sub(r'^stem\.1\.', 'stem.norm.', k)
+        k = re.sub(r'\.downsample\.0\.', '.downsample.norm.', k)
+        k = re.sub(r'\.downsample\.1\.', '.downsample.conv.', k)
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_edgenext(variant, pretrained=False, **kwargs):
+    return build_model_with_cfg(
+        EdgeNeXt, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=(0, 1, 2, 3)),
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs) -> Dict[str, Any]:
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 256, 256), 'pool_size': (8, 8),
+        'crop_pct': 0.9, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'stem.conv', 'classifier': 'head.fc',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'edgenext_xx_small.in1k': _cfg(hf_hub_id='timm/', test_input_size=(3, 288, 288), test_crop_pct=1.0),
+    'edgenext_x_small.in1k': _cfg(hf_hub_id='timm/', test_input_size=(3, 288, 288), test_crop_pct=1.0),
+    'edgenext_small.usi_in1k': _cfg(
+        hf_hub_id='timm/', crop_pct=0.95, test_input_size=(3, 320, 320), test_crop_pct=1.0),
+    'edgenext_base.usi_in1k': _cfg(
+        hf_hub_id='timm/', crop_pct=0.95, test_input_size=(3, 320, 320), test_crop_pct=1.0),
+    'edgenext_small_rw.sw_in1k': _cfg(
+        hf_hub_id='timm/', test_input_size=(3, 320, 320), test_crop_pct=1.0),
+})
+
+
+@register_model
+def edgenext_xx_small(pretrained=False, **kwargs) -> EdgeNeXt:
+    model_args = dict(depths=(2, 2, 6, 2), dims=(24, 48, 88, 168), heads=(4, 4, 4, 4))
+    return _create_edgenext('edgenext_xx_small', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def edgenext_x_small(pretrained=False, **kwargs) -> EdgeNeXt:
+    model_args = dict(depths=(3, 3, 9, 3), dims=(32, 64, 100, 192), heads=(4, 4, 4, 4))
+    return _create_edgenext('edgenext_x_small', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def edgenext_small(pretrained=False, **kwargs) -> EdgeNeXt:
+    model_args = dict(depths=(3, 3, 9, 3), dims=(48, 96, 160, 304))
+    return _create_edgenext('edgenext_small', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def edgenext_base(pretrained=False, **kwargs) -> EdgeNeXt:
+    model_args = dict(depths=(3, 3, 9, 3), dims=(80, 160, 288, 584))
+    return _create_edgenext('edgenext_base', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def edgenext_small_rw(pretrained=False, **kwargs) -> EdgeNeXt:
+    model_args = dict(
+        depths=(3, 3, 9, 3), dims=(48, 96, 192, 384),
+        downsample_block=True, conv_bias=False, stem_type='overlap')
+    return _create_edgenext('edgenext_small_rw', pretrained=pretrained, **dict(model_args, **kwargs))

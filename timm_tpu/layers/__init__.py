@@ -51,3 +51,4 @@ from .pos_embed_sincos import (
 )
 from .squeeze_excite import EffectiveSEModule, SEModule, SqueezeExcite
 from .weight_init import lecun_normal_, ones_, trunc_normal_, trunc_normal_tf_, variance_scaling_, zeros_
+from .hybrid_embed import HybridEmbed

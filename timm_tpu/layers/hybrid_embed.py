@@ -1,0 +1,131 @@
+"""CNN-backbone patch embedding for hybrid ViTs (NHWC).
+
+Wraps an arbitrary CNN backbone, takes its (last) feature map, and projects
+patches of it to the transformer embedding dim. Mirrors the behavior of
+reference timm/layers/hybrid_embed.py:32-199 (HybridEmbed): when
+``feature_size`` is not given it is discovered by running the backbone once
+on a zero image — the most reliable way to handle arbitrary backbones — and
+the projection is a ``patch_size``-strided conv over the feature map.
+
+TPU notes: the discovery forward runs eagerly at construction (outside jit),
+so it costs one CPU/TPU eager pass at build time and nothing afterwards; the
+runtime path is a single static-shape conv + reshape that XLA fuses.
+"""
+from typing import Callable, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from .helpers import to_2tuple
+from .weight_init import lecun_normal_, zeros_
+
+__all__ = ['HybridEmbed']
+
+
+class HybridEmbed(nnx.Module):
+    """Extract feature map from a CNN, flatten, project to embedding dim.
+
+    Reference: timm/layers/hybrid_embed.py:32 (HybridEmbed).
+    """
+
+    def __init__(
+            self,
+            backbone: nnx.Module,
+            img_size: Union[int, Tuple[int, int]] = 224,
+            patch_size: Union[int, Tuple[int, int]] = 1,
+            feature_size: Optional[Union[int, Tuple[int, int]]] = None,
+            feature_ratio: Optional[Union[int, Tuple[int, int]]] = None,
+            in_chans: int = 3,
+            embed_dim: int = 768,
+            bias: bool = True,
+            proj: bool = True,
+            flatten: bool = True,
+            strict_img_size: bool = True,
+            dynamic_img_pad: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.backbone = backbone
+        self.in_chans = in_chans
+        self.img_size = to_2tuple(img_size)
+        self.patch_size = to_2tuple(patch_size)
+        if feature_size is None:
+            # Run the backbone once on zeros to discover the feature map shape
+            # (reference hybrid_embed.py:103-116 does the same with torch).
+            # Eval mode so BatchNorm running stats aren't polluted by the
+            # zero-image pass; freshly-built modules default to train mode,
+            # which we restore after.
+            if hasattr(backbone, 'eval'):
+                backbone.eval()
+            o = self._backbone_fwd(jnp.zeros((1, *self.img_size, in_chans), jnp.float32))
+            if hasattr(backbone, 'train'):
+                backbone.train()
+            feature_size = o.shape[1:3]
+            feature_dim = o.shape[-1]
+        else:
+            feature_size = to_2tuple(feature_size)
+            if feature_ratio is None:
+                feature_ratio = tuple(i // f for i, f in zip(self.img_size, feature_size))
+            if hasattr(backbone, 'feature_info'):
+                feature_dim = backbone.feature_info[-1]['num_chs']
+            else:
+                feature_dim = getattr(backbone, 'num_features')
+        self.feature_size = feature_size
+        self.feature_ratio = to_2tuple(feature_ratio) if feature_ratio is not None else \
+            tuple(i // f for i, f in zip(self.img_size, feature_size))
+        self.feature_dim = feature_dim
+        if not dynamic_img_pad:
+            assert feature_size[0] % self.patch_size[0] == 0 and feature_size[1] % self.patch_size[1] == 0
+        self.grid_size = tuple(f // p for f, p in zip(feature_size, self.patch_size))
+        self.num_patches = self.grid_size[0] * self.grid_size[1]
+        self.flatten = flatten
+        self.strict_img_size = strict_img_size
+        self.dynamic_img_pad = dynamic_img_pad
+
+        if proj:
+            self.proj = nnx.Conv(
+                feature_dim, embed_dim,
+                kernel_size=self.patch_size, strides=self.patch_size, padding='VALID',
+                use_bias=bias, dtype=dtype, param_dtype=param_dtype,
+                kernel_init=lecun_normal_(), bias_init=zeros_, rngs=rngs)
+        else:
+            assert feature_dim == embed_dim, \
+                f'feature dim ({feature_dim}) must match embed dim ({embed_dim}) with proj disabled'
+            self.proj = None
+
+    def _backbone_fwd(self, x):
+        if hasattr(self.backbone, 'forward_features'):
+            out = self.backbone.forward_features(x)
+        else:
+            out = self.backbone(x)
+        if isinstance(out, (list, tuple)):
+            out = out[-1]  # last feature if backbone outputs a pyramid
+        return out
+
+    def feat_ratio(self, as_scalar: bool = True):
+        """Total input→token reduction: backbone stride x patch size
+        (reference hybrid_embed.py:166-171)."""
+        total = tuple(r * p for r, p in zip(self.feature_ratio, self.patch_size))
+        return max(total) if as_scalar else total
+
+    def dynamic_feat_size(self, img_size: Tuple[int, int]) -> Tuple[int, int]:
+        """Expected grid (feature) size for a given image size."""
+        feat = tuple(i // r for i, r in zip(img_size, self.feature_ratio))
+        if self.dynamic_img_pad:
+            return tuple(-(-f // p) for f, p in zip(feat, self.patch_size))
+        return tuple(f // p for f, p in zip(feat, self.patch_size))
+
+    def __call__(self, x):
+        x = self._backbone_fwd(x)  # (B, H', W', C)
+        if self.dynamic_img_pad:
+            ph, pw = self.patch_size
+            pad_h = (ph - x.shape[1] % ph) % ph
+            pad_w = (pw - x.shape[2] % pw) % pw
+            x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+        if self.proj is not None:
+            x = self.proj(x)
+        if self.flatten:
+            x = x.reshape(x.shape[0], -1, x.shape[-1])  # (B, N, C)
+        return x

@@ -47,3 +47,4 @@ from .vgg import VGG
 from .volo import VOLO
 from .xcit import Xcit
 from .vision_transformer import VisionTransformer
+from .vision_transformer_hybrid import *  # noqa: F401,F403 — registers hybrid vit entrypoints

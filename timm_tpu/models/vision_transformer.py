@@ -232,7 +232,13 @@ class VisionTransformer(nnx.Module):
             **embed_args,
         )
         num_patches = self.patch_embed.num_patches
-        reduction = self.patch_embed.patch_size[0] if hasattr(self.patch_embed, 'patch_size') else 16
+        if hasattr(self.patch_embed, 'feat_ratio'):
+            # hybrid embeds: backbone stride x patch size (reference vision_transformer.py:552)
+            reduction = self.patch_embed.feat_ratio()
+        elif hasattr(self.patch_embed, 'patch_size'):
+            reduction = self.patch_embed.patch_size[0]
+        else:
+            reduction = 16
 
         self.cls_token = nnx.Param(
             jnp.zeros((1, 1, embed_dim), param_dtype)) if class_token else None

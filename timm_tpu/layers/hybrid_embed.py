@@ -22,6 +22,31 @@ from .weight_init import lecun_normal_, zeros_
 __all__ = ['HybridEmbed']
 
 
+def _is_training(mod) -> bool:
+    """Infer a module tree's train/eval mode from its first stateful-mode
+    submodule (BatchNorm use_running_average / Dropout deterministic).
+    Freshly-built nnx modules default to train; returns True when no
+    mode-carrying module exists (mode is then irrelevant)."""
+    stack, seen = [mod], set()
+    while stack:
+        m = stack.pop()
+        if id(m) in seen:
+            continue
+        seen.add(id(m))
+        ura = getattr(m, 'use_running_average', None)
+        if isinstance(ura, bool):
+            return not ura
+        det = getattr(m, 'deterministic', None)
+        if isinstance(det, bool):
+            return not det
+        for v in vars(m).values():
+            if isinstance(v, nnx.Module):
+                stack.append(v)
+            elif isinstance(v, (list, tuple, nnx.List)):
+                stack.extend(c for c in v if isinstance(c, nnx.Module))
+    return True
+
+
 class HybridEmbed(nnx.Module):
     """Extract feature map from a CNN, flatten, project to embedding dim.
 
@@ -55,12 +80,12 @@ class HybridEmbed(nnx.Module):
             # Run the backbone once on zeros to discover the feature map shape
             # (reference hybrid_embed.py:103-116 does the same with torch).
             # Eval mode so BatchNorm running stats aren't polluted by the
-            # zero-image pass; freshly-built modules default to train mode,
-            # which we restore after.
+            # zero-image pass; the prior train/eval mode is restored after.
+            was_training = _is_training(backbone)
             if hasattr(backbone, 'eval'):
                 backbone.eval()
             o = self._backbone_fwd(jnp.zeros((1, *self.img_size, in_chans), jnp.float32))
-            if hasattr(backbone, 'train'):
+            if was_training and hasattr(backbone, 'train'):
                 backbone.train()
             feature_size = o.shape[1:3]
             feature_dim = o.shape[-1]

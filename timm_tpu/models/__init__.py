@@ -35,6 +35,7 @@ from .mvitv2 import MultiScaleVit, MultiScaleVitCfg
 from .naflexvit import NaFlexVit
 from .nfnet import NfCfg, NormFreeNet
 from .regnet import RegNet
+from .repvit import RepVit
 from .res2net import Bottle2neck
 from .resnest import ResNestBottleneck
 from .resnet import ResNet

@@ -1,0 +1,430 @@
+"""RepViT — mobile CNN revisited from a ViT perspective (NHWC / nnx).
+
+Re-implements reference timm/models/repvit.py:1-693 (RepVit): a pure-conv
+four-stage net whose blocks split token mixing (reparameterizable dw conv
+branch sum) from channel mixing (1x1 conv MLP), with SE every other block and
+an optional distillation head.
+
+TPU notes: the train-time three-branch token mixer (dw kxk + dw 1x1 + id)
+is kept un-fused — XLA fuses the branch adds into the BN epilogue anyway, and
+keeping the branches preserves checkpoint round-tripping; all convs run NHWC
+on the MXU. Inference-time structural fusion (reference repvit.py:53-71
+``fuse()``) is a torch deploy-path optimization that XLA's constant folding
+makes unnecessary here.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from flax import nnx
+
+from timm_tpu.data.constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+from ..layers import BatchNorm2d, Dropout, SqueezeExcite, get_act_fn, to_ntuple
+from ..layers.weight_init import trunc_normal_, zeros_
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['RepVit']
+
+
+class ConvNorm(nnx.Module):
+    """Conv (no bias, named ``c`` to match checkpoints) + BN
+    (reference repvit.py:32-71)."""
+
+    def __init__(self, in_dim, out_dim, ks=1, stride=1, pad=0, dilation=1, groups=1,
+                 bn_weight_init=1.0, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.c = nnx.Conv(
+            in_dim, out_dim, kernel_size=(ks, ks), strides=stride,
+            padding=[(pad, pad), (pad, pad)], kernel_dilation=(dilation, dilation),
+            feature_group_count=groups, use_bias=False,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn = BatchNorm2d(out_dim, rngs=rngs)
+        if bn_weight_init != 1.0:
+            self.bn.scale[...] = jnp.full_like(self.bn.scale[...], bn_weight_init)
+
+    def __call__(self, x):
+        return self.bn(self.c(x))
+
+
+class NormLinear(nnx.Module):
+    """BN1d (named ``bn``) + Linear (named ``l``) classifier
+    (reference repvit.py:74-105)."""
+
+    def __init__(self, in_dim, out_dim, bias=True, std=0.02,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.bn = BatchNorm2d(in_dim, rngs=rngs)
+        self.l = nnx.Linear(
+            in_dim, out_dim, use_bias=bias, kernel_init=trunc_normal_(std=std),
+            bias_init=zeros_, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        B, C = x.shape
+        x = self.bn(x.reshape(B, 1, 1, C)).reshape(B, C)
+        return self.l(x)
+
+
+class RepVggDw(nnx.Module):
+    """Reparameterizable dw token mixer: dw kxk + dw 1x1 + identity, then BN
+    (reference repvit.py:108-166). Legacy (m1/m2/m3) folds BN into each branch
+    instead of applying one after the sum."""
+
+    def __init__(self, ed, kernel_size, legacy=False,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv = ConvNorm(ed, ed, kernel_size, 1, (kernel_size - 1) // 2, groups=ed, **kw)
+        self.legacy = legacy
+        if legacy:
+            self.conv1 = ConvNorm(ed, ed, 1, 1, 0, groups=ed, **kw)
+            self.bn = None
+        else:
+            self.conv1 = nnx.Conv(
+                ed, ed, kernel_size=(1, 1), strides=1, padding='VALID',
+                feature_group_count=ed, use_bias=True,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.bn = BatchNorm2d(ed, rngs=rngs)
+
+    def __call__(self, x):
+        x = self.conv(x) + self.conv1(x) + x
+        if self.bn is not None:
+            x = self.bn(x)
+        return x
+
+
+class RepVitMlp(nnx.Module):
+    """1x1 conv MLP channel mixer (reference repvit.py:169-186)."""
+
+    def __init__(self, in_dim, hidden_dim, act_layer,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv1 = ConvNorm(in_dim, hidden_dim, 1, 1, 0, **kw)
+        self.act = get_act_fn(act_layer)
+        self.conv2 = ConvNorm(hidden_dim, in_dim, 1, 1, 0, bn_weight_init=0.0, **kw)
+
+    def __call__(self, x):
+        return self.conv2(self.act(self.conv1(x)))
+
+
+class RepViTBlock(nnx.Module):
+    """Token mixer + optional SE + residual channel mixer
+    (reference repvit.py:189-212)."""
+
+    def __init__(self, in_dim, mlp_ratio, kernel_size, use_se, act_layer, legacy=False,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.token_mixer = RepVggDw(in_dim, kernel_size, legacy, **kw)
+        self.se = SqueezeExcite(in_dim, 0.25, **kw) if use_se else None
+        self.channel_mixer = RepVitMlp(in_dim, int(in_dim * mlp_ratio), act_layer, **kw)
+
+    def __call__(self, x):
+        x = self.token_mixer(x)
+        if self.se is not None:
+            x = self.se(x)
+        return x + self.channel_mixer(x)
+
+
+class RepVitStem(nnx.Module):
+    """Two strided 3x3 ConvNorms, stride 4 total (reference repvit.py:215-232)."""
+
+    def __init__(self, in_chs, out_chs, act_layer,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv1 = ConvNorm(in_chs, out_chs // 2, 3, 2, 1, **kw)
+        self.act1 = get_act_fn(act_layer)
+        self.conv2 = ConvNorm(out_chs // 2, out_chs, 3, 2, 1, **kw)
+        self.stride = 4
+
+    def __call__(self, x):
+        return self.conv2(self.act1(self.conv1(x)))
+
+
+class RepVitDownsample(nnx.Module):
+    """Pre-block + dw spatial downsample + 1x1 channel change + residual FFN
+    (reference repvit.py:235-278)."""
+
+    def __init__(self, in_dim, mlp_ratio, out_dim, kernel_size, act_layer, legacy=False,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.pre_block = RepViTBlock(in_dim, mlp_ratio, kernel_size, use_se=False,
+                                     act_layer=act_layer, legacy=legacy, **kw)
+        self.spatial_downsample = ConvNorm(
+            in_dim, in_dim, kernel_size, 2, (kernel_size - 1) // 2, groups=in_dim, **kw)
+        self.channel_downsample = ConvNorm(in_dim, out_dim, 1, 1, **kw)
+        self.ffn = RepVitMlp(out_dim, int(out_dim * mlp_ratio), act_layer, **kw)
+
+    def __call__(self, x):
+        x = self.pre_block(x)
+        x = self.spatial_downsample(x)
+        x = self.channel_downsample(x)
+        return x + self.ffn(x)
+
+
+class RepVitClassifier(nnx.Module):
+    """Dropout + NormLinear head, optionally distilled: eval averages the two
+    heads, distilled training returns both (reference repvit.py:281-326)."""
+
+    def __init__(self, dim, num_classes, distillation=False, drop=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.head_drop = Dropout(drop, rngs=rngs)
+        self.head = NormLinear(dim, num_classes, **kw) if num_classes > 0 else None
+        self.distillation = distillation
+        self.distilled_training = False
+        self.num_classes = num_classes
+        self.head_dist = NormLinear(dim, num_classes, **kw) if (distillation and num_classes > 0) else None
+
+    def __call__(self, x):
+        x = self.head_drop(x)
+        if self.head is None:
+            return x
+        if self.distillation:
+            x1, x2 = self.head(x), self.head_dist(x)
+            if self.distilled_training and not self.head_drop.deterministic:
+                return x1, x2
+            return (x1 + x2) / 2
+        return self.head(x)
+
+
+class RepVitStage(nnx.Module):
+    """Optional downsample + depth blocks with SE on alternating blocks
+    (reference repvit.py:329-370)."""
+
+    def __init__(self, in_dim, out_dim, depth, mlp_ratio, act_layer, kernel_size=3,
+                 downsample=True, legacy=False,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        if downsample:
+            self.downsample = RepVitDownsample(
+                in_dim, mlp_ratio, out_dim, kernel_size, act_layer, legacy, **kw)
+        else:
+            assert in_dim == out_dim
+            self.downsample = None
+        blocks = []
+        use_se = True
+        for _ in range(depth):
+            blocks.append(RepViTBlock(out_dim, mlp_ratio, kernel_size, use_se, act_layer, legacy, **kw))
+            use_se = not use_se
+        self.blocks = nnx.List(blocks)
+        self.grad_checkpointing = False
+
+    def __call__(self, x):
+        if self.downsample is not None:
+            x = self.downsample(x)
+        remat_blk = nnx.remat(RepViTBlock.__call__) if self.grad_checkpointing else None
+        for blk in self.blocks:
+            x = remat_blk(blk, x) if remat_blk is not None else blk(x)
+        return x
+
+
+class RepVit(nnx.Module):
+    """RepViT (reference repvit.py:373-546)."""
+
+    def __init__(
+            self,
+            in_chans: int = 3,
+            img_size: int = 224,
+            embed_dim: Tuple[int, ...] = (48,),
+            depth: Tuple[int, ...] = (2,),
+            mlp_ratio: float = 2,
+            global_pool: str = 'avg',
+            kernel_size: int = 3,
+            num_classes: int = 1000,
+            act_layer='gelu',
+            distillation: bool = True,
+            drop_rate: float = 0.0,
+            legacy: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: Optional[nnx.Rngs] = None,
+    ):
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.global_pool = global_pool
+        self.embed_dim = embed_dim
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        self.distillation = distillation
+        self._dd = dict(dtype=dtype, param_dtype=param_dtype)
+
+        in_dim = embed_dim[0]
+        self.stem = RepVitStem(in_chans, in_dim, act_layer, **kw)
+        stride = self.stem.stride
+        num_stages = len(embed_dim)
+        mlp_ratios = to_ntuple(num_stages)(mlp_ratio)
+
+        self.feature_info = []
+        stages = []
+        for i in range(num_stages):
+            downsample = i != 0
+            stages.append(RepVitStage(
+                in_dim, embed_dim[i], depth[i], mlp_ratio=mlp_ratios[i],
+                act_layer=act_layer, kernel_size=kernel_size,
+                downsample=downsample, legacy=legacy, **kw))
+            stride *= 2 if downsample else 1
+            self.feature_info += [dict(num_chs=embed_dim[i], reduction=stride, module=f'stages.{i}')]
+            in_dim = embed_dim[i]
+        self.stages = nnx.List(stages)
+
+        self.num_features = self.head_hidden_size = embed_dim[-1]
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.head = RepVitClassifier(embed_dim[-1], num_classes, distillation, **kw)
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(stem=r'^stem', blocks=[(r'^stages\.(\d+)', None)])
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        for s in self.stages:
+            s.grad_checkpointing = enable
+
+    def set_distilled_training(self, enable: bool = True):
+        self.head.distilled_training = enable
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None,
+                         distillation: bool = False, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = global_pool
+        self.head = RepVitClassifier(
+            self.embed_dim[-1], num_classes, distillation,
+            rngs=rngs if rngs is not None else nnx.Rngs(0), **self._dd)
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.stem(x)
+        for stage in self.stages:
+            x = stage(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        if self.global_pool == 'avg':
+            x = x.mean(axis=(1, 2))
+        x = self.head_drop(x)
+        if pre_logits:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(self, x, indices=None, norm: bool = False,
+                              stop_early: bool = False, output_fmt: str = 'NHWC',
+                              intermediates_only: bool = False):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        intermediates = []
+        x = self.stem(x)
+        stages = self.stages if not stop_early else self.stages[:max_index + 1]
+        for feat_idx, stage in enumerate(stages):
+            x = stage(x)
+            if feat_idx in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        self.stages = nnx.List(list(self.stages)[:max_index + 1])
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    if 'model' in state_dict:
+        state_dict = state_dict['model']
+    return convert_torch_state_dict(state_dict, model)
+
+
+def _cfg(url: str = '', **kwargs):
+    return {
+        'url': url, 'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.95, 'interpolation': 'bicubic',
+        'mean': IMAGENET_DEFAULT_MEAN, 'std': IMAGENET_DEFAULT_STD,
+        'first_conv': 'stem.conv1.c', 'classifier': ('head.head.l', 'head.head_dist.l'),
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'repvit_m1.dist_in1k': _cfg(),
+    'repvit_m2.dist_in1k': _cfg(),
+    'repvit_m3.dist_in1k': _cfg(),
+    'repvit_m0_9.dist_300e_in1k': _cfg(),
+    'repvit_m0_9.dist_450e_in1k': _cfg(),
+    'repvit_m1_0.dist_300e_in1k': _cfg(),
+    'repvit_m1_0.dist_450e_in1k': _cfg(),
+    'repvit_m1_1.dist_300e_in1k': _cfg(),
+    'repvit_m1_1.dist_450e_in1k': _cfg(),
+    'repvit_m1_5.dist_300e_in1k': _cfg(),
+    'repvit_m1_5.dist_450e_in1k': _cfg(),
+    'repvit_m2_3.dist_300e_in1k': _cfg(),
+    'repvit_m2_3.dist_450e_in1k': _cfg(),
+})
+
+
+def _create_repvit(variant, pretrained=False, **kwargs):
+    out_indices = kwargs.pop('out_indices', (0, 1, 2, 3))
+    return build_model_with_cfg(
+        RepVit, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices, feature_cls='getter'),
+        **kwargs,
+    )
+
+
+@register_model
+def repvit_m1(pretrained=False, **kwargs):
+    model_args = dict(embed_dim=(48, 96, 192, 384), depth=(2, 2, 14, 2), legacy=True)
+    return _create_repvit('repvit_m1', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def repvit_m2(pretrained=False, **kwargs):
+    model_args = dict(embed_dim=(64, 128, 256, 512), depth=(2, 2, 12, 2), legacy=True)
+    return _create_repvit('repvit_m2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def repvit_m3(pretrained=False, **kwargs):
+    model_args = dict(embed_dim=(64, 128, 256, 512), depth=(4, 4, 18, 2), legacy=True)
+    return _create_repvit('repvit_m3', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def repvit_m0_9(pretrained=False, **kwargs):
+    model_args = dict(embed_dim=(48, 96, 192, 384), depth=(2, 2, 14, 2))
+    return _create_repvit('repvit_m0_9', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def repvit_m1_0(pretrained=False, **kwargs):
+    model_args = dict(embed_dim=(56, 112, 224, 448), depth=(2, 2, 14, 2))
+    return _create_repvit('repvit_m1_0', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def repvit_m1_1(pretrained=False, **kwargs):
+    model_args = dict(embed_dim=(64, 128, 256, 512), depth=(2, 2, 12, 2))
+    return _create_repvit('repvit_m1_1', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def repvit_m1_5(pretrained=False, **kwargs):
+    model_args = dict(embed_dim=(64, 128, 256, 512), depth=(4, 4, 24, 4))
+    return _create_repvit('repvit_m1_5', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def repvit_m2_3(pretrained=False, **kwargs):
+    model_args = dict(embed_dim=(80, 160, 320, 640), depth=(6, 6, 34, 2))
+    return _create_repvit('repvit_m2_3', pretrained=pretrained, **dict(model_args, **kwargs))

@@ -77,6 +77,16 @@ class ConvStem(nnx.Module):
         return x
 
 
+def _backbone_rngs(kwargs):
+    """Backbone rngs matching the builder's seed derivation (_builder.py:218-224),
+    so `seed=N` varies the CNN half too, not just the ViT."""
+    rngs = kwargs.get('rngs')
+    if rngs is None:
+        seed = kwargs.get('seed', 0)
+        rngs = nnx.Rngs(params=seed, dropout=seed + 1)
+    return rngs
+
+
 def _resnetv2(layers=(3, 4, 9), **kwargs):
     """BiT ResNetV2 backbone helper (reference vision_transformer_hybrid.py:81-104).
 
@@ -84,7 +94,7 @@ def _resnetv2(layers=(3, 4, 9), **kwargs):
     stem_type='same' and 'same'-padded StdConv2d throughout.
     """
     conv_layer = partial(StdConv2d, eps=1e-8, padding='same')
-    rngs = kwargs.get('rngs') or nnx.Rngs(0)
+    rngs = _backbone_rngs(kwargs)
     dd = dict(dtype=kwargs.get('dtype'), param_dtype=kwargs.get('param_dtype', jnp.float32))
     if len(layers):
         return ResNetV2(
@@ -237,7 +247,7 @@ def vit_large_r50_s32_384(pretrained=False, **kwargs) -> VisionTransformer:
 @register_model
 def vit_small_resnet26d_224(pretrained=False, **kwargs) -> VisionTransformer:
     """ViT-S hybrid on ResNet26D stride-32 features (vision_transformer_hybrid.py:365-379)."""
-    backbone = resnet26d(in_chans=kwargs.get('in_chans', 3), dtype=kwargs.get('dtype'), param_dtype=kwargs.get('param_dtype', jnp.float32), features_only=True, out_indices=[4])
+    backbone = resnet26d(in_chans=kwargs.get('in_chans', 3), rngs=_backbone_rngs(kwargs), dtype=kwargs.get('dtype'), param_dtype=kwargs.get('param_dtype', jnp.float32), features_only=True, out_indices=[4])
     model_args = dict(embed_dim=768, depth=8, num_heads=8, mlp_ratio=3)
     return _create_vision_transformer_hybrid(
         'vit_small_resnet26d_224', backbone=backbone, pretrained=pretrained, **dict(model_args, **kwargs))
@@ -245,7 +255,7 @@ def vit_small_resnet26d_224(pretrained=False, **kwargs) -> VisionTransformer:
 
 @register_model
 def vit_small_resnet50d_s16_224(pretrained=False, **kwargs) -> VisionTransformer:
-    backbone = resnet50d(in_chans=kwargs.get('in_chans', 3), dtype=kwargs.get('dtype'), param_dtype=kwargs.get('param_dtype', jnp.float32), features_only=True, out_indices=[3])
+    backbone = resnet50d(in_chans=kwargs.get('in_chans', 3), rngs=_backbone_rngs(kwargs), dtype=kwargs.get('dtype'), param_dtype=kwargs.get('param_dtype', jnp.float32), features_only=True, out_indices=[3])
     model_args = dict(embed_dim=768, depth=8, num_heads=8, mlp_ratio=3)
     return _create_vision_transformer_hybrid(
         'vit_small_resnet50d_s16_224', backbone=backbone, pretrained=pretrained, **dict(model_args, **kwargs))
@@ -253,7 +263,7 @@ def vit_small_resnet50d_s16_224(pretrained=False, **kwargs) -> VisionTransformer
 
 @register_model
 def vit_base_resnet26d_224(pretrained=False, **kwargs) -> VisionTransformer:
-    backbone = resnet26d(in_chans=kwargs.get('in_chans', 3), dtype=kwargs.get('dtype'), param_dtype=kwargs.get('param_dtype', jnp.float32), features_only=True, out_indices=[4])
+    backbone = resnet26d(in_chans=kwargs.get('in_chans', 3), rngs=_backbone_rngs(kwargs), dtype=kwargs.get('dtype'), param_dtype=kwargs.get('param_dtype', jnp.float32), features_only=True, out_indices=[4])
     model_args = dict(embed_dim=768, depth=12, num_heads=12)
     return _create_vision_transformer_hybrid(
         'vit_base_resnet26d_224', backbone=backbone, pretrained=pretrained, **dict(model_args, **kwargs))
@@ -261,7 +271,7 @@ def vit_base_resnet26d_224(pretrained=False, **kwargs) -> VisionTransformer:
 
 @register_model
 def vit_base_resnet50d_224(pretrained=False, **kwargs) -> VisionTransformer:
-    backbone = resnet50d(in_chans=kwargs.get('in_chans', 3), dtype=kwargs.get('dtype'), param_dtype=kwargs.get('param_dtype', jnp.float32), features_only=True, out_indices=[4])
+    backbone = resnet50d(in_chans=kwargs.get('in_chans', 3), rngs=_backbone_rngs(kwargs), dtype=kwargs.get('dtype'), param_dtype=kwargs.get('param_dtype', jnp.float32), features_only=True, out_indices=[4])
     model_args = dict(embed_dim=768, depth=12, num_heads=12)
     return _create_vision_transformer_hybrid(
         'vit_base_resnet50d_224', backbone=backbone, pretrained=pretrained, **dict(model_args, **kwargs))
@@ -274,7 +284,7 @@ def vit_base_mci_224(pretrained=False, **kwargs) -> VisionTransformer:
         channels=(768 // 4, 768 // 4, 768), stride=(4, 2, 2), kernel_size=(4, 2, 2),
         padding=0, in_chans=kwargs.get('in_chans', 3), act_layer='gelu',
         dtype=kwargs.get('dtype'), param_dtype=kwargs.get('param_dtype', jnp.float32),
-        rngs=kwargs.get('rngs') or nnx.Rngs(0))
+        rngs=_backbone_rngs(kwargs))
     model_args = dict(embed_dim=768, depth=12, num_heads=12, no_embed_class=True)
     return _create_vision_transformer_hybrid(
         'vit_base_mci_224', backbone=backbone, embed_args=dict(proj=False),

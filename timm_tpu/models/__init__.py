@@ -43,6 +43,7 @@ from .rexnet import RexNet
 from .sknet import SelectiveKernelBasic, SelectiveKernelBottleneck
 from .resnetv2 import ResNetV2
 from .swin_transformer import SwinTransformer
+from .tiny_vit import TinyVit
 from .swin_transformer_v2 import SwinTransformerV2
 from .vgg import VGG
 from .volo import VOLO

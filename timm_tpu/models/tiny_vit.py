@@ -1,0 +1,494 @@
+"""TinyViT — fast-pretraining-distillation small ViTs (NHWC / nnx).
+
+Re-implements reference timm/models/tiny_vit.py:1-880 (TinyVit): a conv
+stem + MBConv stage followed by three windowed-attention stages with
+LeViT-style cached relative attention biases, depthwise local conv between
+attention and MLP, and a NormMlp classifier head.
+
+TPU notes: the whole network stays NHWC (the reference permutes NCHW↔NHWC at
+every stage boundary; here there is nothing to permute). Window partitioning
+is a static reshape/transpose chain, the attention bias is a static gather
+from a per-resolution index table (same machinery as levit.py), and window
+padding sizes are compile-time constants, so every attention runs as one
+batched MXU matmul over (B·windows) with no dynamic shapes.
+"""
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from timm_tpu.data.constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+from ..layers import (
+    BatchNorm2d, Dropout, DropPath, LayerNorm, LayerNorm2d, NormMlpClassifierHead,
+    calculate_drop_path_rates, get_act_fn, to_2tuple, trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+from .levit import _attention_bias_idxs
+
+__all__ = ['TinyVit']
+
+
+class ConvNorm(nnx.Module):
+    """Conv (named ``conv``) + BN (reference tiny_vit.py:29-62)."""
+
+    def __init__(self, in_chs, out_chs, ks=1, stride=1, pad=0, dilation=1, groups=1,
+                 bn_weight_init=1.0, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.conv = nnx.Conv(
+            in_chs, out_chs, kernel_size=(ks, ks), strides=stride,
+            padding=[(pad, pad), (pad, pad)], kernel_dilation=(dilation, dilation),
+            feature_group_count=groups, use_bias=False,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn = BatchNorm2d(out_chs, rngs=rngs)
+        if bn_weight_init != 1.0:
+            self.bn.scale[...] = jnp.full_like(self.bn.scale[...], bn_weight_init)
+
+    def __call__(self, x):
+        return self.bn(self.conv(x))
+
+
+class PatchEmbed(nnx.Module):
+    """Two strided 3x3 ConvNorms, stride 4 (reference tiny_vit.py:65-86)."""
+
+    def __init__(self, in_chs, out_chs, act_layer, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.stride = 4
+        self.conv1 = ConvNorm(in_chs, out_chs // 2, 3, 2, 1, **kw)
+        self.act = get_act_fn(act_layer)
+        self.conv2 = ConvNorm(out_chs // 2, out_chs, 3, 2, 1, **kw)
+
+    def __call__(self, x):
+        return self.conv2(self.act(self.conv1(x)))
+
+
+class MBConv(nnx.Module):
+    """Inverted residual with post-add act (reference tiny_vit.py:89-123)."""
+
+    def __init__(self, in_chs, out_chs, expand_ratio, act_layer, drop_path,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        mid_chs = int(in_chs * expand_ratio)
+        self.conv1 = ConvNorm(in_chs, mid_chs, ks=1, **kw)
+        self.act = get_act_fn(act_layer)
+        self.conv2 = ConvNorm(mid_chs, mid_chs, ks=3, stride=1, pad=1, groups=mid_chs, **kw)
+        self.conv3 = ConvNorm(mid_chs, out_chs, ks=1, bn_weight_init=0.0, **kw)
+        self.drop_path = DropPath(drop_path, rngs=rngs) if drop_path > 0. else None
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.act(self.conv1(x))
+        x = self.act(self.conv2(x))
+        x = self.conv3(x)
+        if self.drop_path is not None:
+            x = self.drop_path(x)
+        return self.act(x + shortcut)
+
+
+class PatchMerging(nnx.Module):
+    """1x1 expand → dw 3x3 s2 → 1x1 (reference tiny_vit.py:126-149)."""
+
+    def __init__(self, dim, out_dim, act_layer, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv1 = ConvNorm(dim, out_dim, 1, 1, 0, **kw)
+        self.act = get_act_fn(act_layer)
+        self.conv2 = ConvNorm(out_dim, out_dim, 3, 2, 1, groups=out_dim, **kw)
+        self.conv3 = ConvNorm(out_dim, out_dim, 1, 1, 0, **kw)
+
+    def __call__(self, x):
+        return self.conv3(self.act(self.conv2(self.act(self.conv1(x)))))
+
+
+class NormMlp(nnx.Module):
+    """LN → fc1 → act → drop → fc2 → drop (reference tiny_vit.py:180-212)."""
+
+    def __init__(self, in_features, hidden_features=None, out_features=None,
+                 act_layer='gelu', drop=0.0, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        out_features = out_features or in_features
+        hidden_features = hidden_features or in_features
+        linear = partial(nnx.Linear, use_bias=True, kernel_init=trunc_normal_(std=0.02),
+                         bias_init=zeros_, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm = LayerNorm(in_features, eps=1e-5, rngs=rngs)
+        self.fc1 = linear(in_features, hidden_features)
+        self.act = get_act_fn(act_layer)
+        self.drop1 = Dropout(drop, rngs=rngs)
+        self.fc2 = linear(hidden_features, out_features)
+        self.drop2 = Dropout(drop, rngs=rngs)
+
+    def __call__(self, x):
+        x = self.drop1(self.act(self.fc1(self.norm(x))))
+        return self.drop2(self.fc2(x))
+
+
+class TinyVitAttention(nnx.Module):
+    """Pre-norm multi-head attention with LeViT-style per-resolution relative
+    bias table gathered by a static index (reference tiny_vit.py:215-320).
+    The bias gather is a compile-time-constant indexed lookup — XLA folds it
+    into the attention logits add."""
+
+    def __init__(self, dim, key_dim, num_heads=8, attn_ratio=4, resolution=(14, 14),
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.num_heads = num_heads
+        self.scale = key_dim ** -0.5
+        self.key_dim = key_dim
+        self.val_dim = int(attn_ratio * key_dim)
+        self.out_dim = self.val_dim * num_heads
+        self.resolution = to_2tuple(resolution)
+
+        linear = partial(nnx.Linear, use_bias=True, kernel_init=trunc_normal_(std=0.02),
+                         bias_init=zeros_, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm = LayerNorm(dim, eps=1e-5, rngs=rngs)
+        self.qkv = linear(dim, num_heads * (self.val_dim + 2 * key_dim))
+        self.proj = linear(self.out_dim, dim)
+
+        num_offsets = self.resolution[0] * self.resolution[1]
+        self.attention_biases = nnx.Param(jnp.zeros((num_heads, num_offsets), param_dtype))
+        self._bias_idxs = jnp.asarray(_attention_bias_idxs(self.resolution))
+
+    def __call__(self, x):
+        B, N, _ = x.shape
+        bias = self.attention_biases[...][:, self._bias_idxs]  # (H, N, N)
+        x = self.norm(x)
+        qkv = self.qkv(x).reshape(B, N, self.num_heads, -1)
+        q, k, v = jnp.split(qkv, [self.key_dim, 2 * self.key_dim], axis=3)
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        attn = (q * self.scale) @ k.transpose(0, 1, 3, 2) + bias
+        attn = jax.nn.softmax(attn, axis=-1)
+        x = (attn @ v).transpose(0, 2, 1, 3).reshape(B, N, self.out_dim)
+        return self.proj(x)
+
+
+class TinyVitBlock(nnx.Module):
+    """Windowed attention + dw local conv + NormMlp, all NHWC
+    (reference tiny_vit.py:323-437)."""
+
+    def __init__(self, dim, num_heads, window_size=7, mlp_ratio=4., drop=0.,
+                 drop_path=0., local_conv_size=3, act_layer='gelu',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.dim = dim
+        self.num_heads = num_heads
+        assert window_size > 0 and dim % num_heads == 0
+        self.window_size = window_size
+        head_dim = dim // num_heads
+        self.attn = TinyVitAttention(
+            dim, head_dim, num_heads, attn_ratio=1,
+            resolution=(window_size, window_size), **kw)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs) if drop_path > 0. else None
+        self.mlp = NormMlp(dim, int(dim * mlp_ratio), act_layer=act_layer, drop=drop, **kw)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs) if drop_path > 0. else None
+        pad = local_conv_size // 2
+        self.local_conv = ConvNorm(dim, dim, ks=local_conv_size, stride=1, pad=pad, groups=dim, **kw)
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        ws = self.window_size
+        shortcut = x
+        if H == ws and W == ws:
+            x = self.attn(x.reshape(B, H * W, C)).reshape(B, H, W, C)
+        else:
+            pad_b = (ws - H % ws) % ws
+            pad_r = (ws - W % ws) % ws
+            if pad_b or pad_r:
+                x = jnp.pad(x, ((0, 0), (0, pad_b), (0, pad_r), (0, 0)))
+            pH, pW = H + pad_b, W + pad_r
+            nH, nW = pH // ws, pW // ws
+            # window partition (static reshape/transpose)
+            x = x.reshape(B, nH, ws, nW, ws, C).transpose(0, 1, 3, 2, 4, 5)
+            x = x.reshape(B * nH * nW, ws * ws, C)
+            x = self.attn(x)
+            # window reverse
+            x = x.reshape(B, nH, nW, ws, ws, C).transpose(0, 1, 3, 2, 4, 5).reshape(B, pH, pW, C)
+            if pad_b or pad_r:
+                x = x[:, :H, :W]
+        x = shortcut + (self.drop_path1(x) if self.drop_path1 is not None else x)
+
+        x = self.local_conv(x)
+        x = x.reshape(B, H * W, C)
+        y = self.mlp(x)
+        x = x + (self.drop_path2(y) if self.drop_path2 is not None else y)
+        return x.reshape(B, H, W, C)
+
+
+class ConvLayer(nnx.Module):
+    """Stage of MBConvs (reference tiny_vit.py:152-177)."""
+
+    def __init__(self, dim, depth, act_layer, drop_path=0., conv_expand_ratio=4.,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.downsample = None
+        self.blocks = nnx.List([
+            MBConv(dim, dim, conv_expand_ratio, act_layer,
+                   drop_path[i] if isinstance(drop_path, (list, tuple)) else drop_path, **kw)
+            for i in range(depth)])
+        self.grad_checkpointing = False
+
+    def __call__(self, x):
+        remat_blk = nnx.remat(MBConv.__call__) if self.grad_checkpointing else None
+        for blk in self.blocks:
+            x = remat_blk(blk, x) if remat_blk is not None else blk(x)
+        return x
+
+
+class TinyVitStage(nnx.Module):
+    """PatchMerging downsample + TinyVitBlocks (reference tiny_vit.py:440-505)."""
+
+    def __init__(self, dim, out_dim, depth, num_heads, window_size, mlp_ratio=4.,
+                 drop=0., drop_path=0., downsample=None, local_conv_size=3, act_layer='gelu',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.out_dim = out_dim
+        if downsample is not None:
+            self.downsample = downsample(dim=dim, out_dim=out_dim, act_layer=act_layer, **kw)
+        else:
+            assert dim == out_dim
+            self.downsample = None
+        self.blocks = nnx.List([
+            TinyVitBlock(
+                dim=out_dim, num_heads=num_heads, window_size=window_size,
+                mlp_ratio=mlp_ratio, drop=drop,
+                drop_path=drop_path[i] if isinstance(drop_path, (list, tuple)) else drop_path,
+                local_conv_size=local_conv_size, act_layer=act_layer, **kw)
+            for i in range(depth)])
+        self.grad_checkpointing = False
+
+    def __call__(self, x):
+        if self.downsample is not None:
+            x = self.downsample(x)
+        remat_blk = nnx.remat(TinyVitBlock.__call__) if self.grad_checkpointing else None
+        for blk in self.blocks:
+            x = remat_blk(blk, x) if remat_blk is not None else blk(x)
+        return x
+
+
+class TinyVit(nnx.Module):
+    """TinyViT (reference tiny_vit.py:508-716)."""
+
+    def __init__(
+            self,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            embed_dims: Tuple[int, ...] = (96, 192, 384, 768),
+            depths: Tuple[int, ...] = (2, 2, 6, 2),
+            num_heads: Tuple[int, ...] = (3, 6, 12, 24),
+            window_sizes: Tuple[int, ...] = (7, 7, 14, 7),
+            mlp_ratio: float = 4.,
+            drop_rate: float = 0.,
+            drop_path_rate: float = 0.1,
+            use_checkpoint: bool = False,
+            mbconv_expand_ratio: float = 4.0,
+            local_conv_size: int = 3,
+            act_layer='gelu',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: Optional[nnx.Rngs] = None,
+    ):
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.num_classes = num_classes
+        self.depths = depths
+        self.num_stages = len(depths)
+        self.mlp_ratio = mlp_ratio
+
+        self.patch_embed = PatchEmbed(in_chans, embed_dims[0], act_layer, **kw)
+
+        dpr = calculate_drop_path_rates(drop_path_rate, sum(depths))
+        stages = []
+        stride = self.patch_embed.stride
+        prev_dim = embed_dims[0]
+        self.feature_info = []
+        for stage_idx in range(self.num_stages):
+            if stage_idx == 0:
+                stage = ConvLayer(
+                    dim=prev_dim, depth=depths[0], act_layer=act_layer,
+                    drop_path=dpr[:depths[0]], conv_expand_ratio=mbconv_expand_ratio, **kw)
+            else:
+                out_dim = embed_dims[stage_idx]
+                stage = TinyVitStage(
+                    dim=embed_dims[stage_idx - 1], out_dim=out_dim, depth=depths[stage_idx],
+                    num_heads=num_heads[stage_idx], window_size=window_sizes[stage_idx],
+                    mlp_ratio=mlp_ratio, drop=drop_rate,
+                    drop_path=dpr[sum(depths[:stage_idx]):sum(depths[:stage_idx + 1])],
+                    downsample=PatchMerging, local_conv_size=local_conv_size,
+                    act_layer=act_layer, **kw)
+                prev_dim = out_dim
+                stride *= 2
+            stages.append(stage)
+            self.feature_info += [dict(num_chs=prev_dim, reduction=stride, module=f'stages.{stage_idx}')]
+        self.stages = nnx.List(stages)
+
+        self.num_features = self.head_hidden_size = embed_dims[-1]
+        self.head = NormMlpClassifierHead(
+            self.num_features, num_classes, pool_type=global_pool,
+            norm_layer=partial(LayerNorm2d, eps=1e-5), **kw)
+        if use_checkpoint:
+            self.set_grad_checkpointing(True)
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'attention_biases'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^patch_embed',
+            blocks=r'^stages\.(\d+)' if coarse else [
+                (r'^stages\.(\d+).downsample', (0,)),
+                (r'^stages\.(\d+)\.\w+\.(\d+)', None),
+            ])
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        for s in self.stages:
+            s.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        self.head.reset(num_classes, pool_type=global_pool, rngs=rngs)
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.patch_embed(x)
+        for stage in self.stages:
+            x = stage(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        return self.head(x, pre_logits=pre_logits)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(self, x, indices=None, norm: bool = False,
+                              stop_early: bool = False, output_fmt: str = 'NHWC',
+                              intermediates_only: bool = False):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        intermediates = []
+        x = self.patch_embed(x)
+        stages = self.stages if not stop_early else self.stages[:max_index + 1]
+        for feat_idx, stage in enumerate(stages):
+            x = stage(x)
+            if feat_idx in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        self.stages = nnx.List(list(self.stages)[:max_index + 1])
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._helpers import model_state_dict
+    from ._torch_convert import convert_torch_state_dict
+    if 'model' in state_dict:
+        state_dict = state_dict['model']
+    state_dict = {k: v for k, v in state_dict.items() if not k.endswith('attention_bias_idxs')}
+    # Cross-resolution loading: bilinearly resize each attention-bias table to
+    # the target window resolution (reference tiny_vit.py:719-730 via
+    # resize_rel_pos_bias_table_levit). The offset table's insertion order is
+    # row-major (dr * W + dc), so it reshapes to the (H, W) offset grid.
+    target = model_state_dict(model)
+    out = {}
+    for k, v in state_dict.items():
+        if 'attention_biases' in k and k in target and tuple(v.shape) != tuple(target[k].shape):
+            import numpy as np
+            nh, n_src = v.shape
+            n_tgt = target[k].shape[1]
+            r_src = int(round(n_src ** 0.5))
+            r_tgt = int(round(n_tgt ** 0.5))
+            grid = jnp.asarray(np.asarray(v), jnp.float32).reshape(nh, r_src, r_src)
+            grid = jax.image.resize(grid, (nh, r_tgt, r_tgt), method='bilinear')
+            v = np.asarray(grid.reshape(nh, n_tgt))
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _cfg(url: str = '', **kwargs):
+    return {
+        'url': url, 'num_classes': 1000,
+        'mean': IMAGENET_DEFAULT_MEAN, 'std': IMAGENET_DEFAULT_STD,
+        'first_conv': 'patch_embed.conv1.conv', 'classifier': 'head.fc',
+        'pool_size': (7, 7), 'input_size': (3, 224, 224), 'crop_pct': 0.95,
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'tiny_vit_5m_224.dist_in22k': _cfg(num_classes=21841),
+    'tiny_vit_5m_224.dist_in22k_ft_in1k': _cfg(),
+    'tiny_vit_5m_224.in1k': _cfg(),
+    'tiny_vit_11m_224.dist_in22k': _cfg(num_classes=21841),
+    'tiny_vit_11m_224.dist_in22k_ft_in1k': _cfg(),
+    'tiny_vit_11m_224.in1k': _cfg(),
+    'tiny_vit_21m_224.dist_in22k': _cfg(num_classes=21841),
+    'tiny_vit_21m_224.dist_in22k_ft_in1k': _cfg(),
+    'tiny_vit_21m_224.in1k': _cfg(),
+    'tiny_vit_21m_384.dist_in22k_ft_in1k': _cfg(
+        input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+    'tiny_vit_21m_512.dist_in22k_ft_in1k': _cfg(
+        input_size=(3, 512, 512), pool_size=(16, 16), crop_pct=1.0, crop_mode='squash'),
+})
+
+
+def _create_tiny_vit(variant, pretrained=False, **kwargs):
+    out_indices = kwargs.pop('out_indices', (0, 1, 2, 3))
+    return build_model_with_cfg(
+        TinyVit, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices, feature_cls='getter'),
+        **kwargs,
+    )
+
+
+@register_model
+def tiny_vit_5m_224(pretrained=False, **kwargs):
+    model_kwargs = dict(
+        embed_dims=(64, 128, 160, 320), depths=(2, 2, 6, 2),
+        num_heads=(2, 4, 5, 10), window_sizes=(7, 7, 14, 7), drop_path_rate=0.0)
+    return _create_tiny_vit('tiny_vit_5m_224', pretrained, **dict(model_kwargs, **kwargs))
+
+
+@register_model
+def tiny_vit_11m_224(pretrained=False, **kwargs):
+    model_kwargs = dict(
+        embed_dims=(64, 128, 256, 448), depths=(2, 2, 6, 2),
+        num_heads=(2, 4, 8, 14), window_sizes=(7, 7, 14, 7), drop_path_rate=0.1)
+    return _create_tiny_vit('tiny_vit_11m_224', pretrained, **dict(model_kwargs, **kwargs))
+
+
+@register_model
+def tiny_vit_21m_224(pretrained=False, **kwargs):
+    model_kwargs = dict(
+        embed_dims=(96, 192, 384, 576), depths=(2, 2, 6, 2),
+        num_heads=(3, 6, 12, 18), window_sizes=(7, 7, 14, 7), drop_path_rate=0.2)
+    return _create_tiny_vit('tiny_vit_21m_224', pretrained, **dict(model_kwargs, **kwargs))
+
+
+@register_model
+def tiny_vit_21m_384(pretrained=False, **kwargs):
+    model_kwargs = dict(
+        embed_dims=(96, 192, 384, 576), depths=(2, 2, 6, 2),
+        num_heads=(3, 6, 12, 18), window_sizes=(12, 12, 24, 12), drop_path_rate=0.1)
+    return _create_tiny_vit('tiny_vit_21m_384', pretrained, **dict(model_kwargs, **kwargs))
+
+
+@register_model
+def tiny_vit_21m_512(pretrained=False, **kwargs):
+    model_kwargs = dict(
+        embed_dims=(96, 192, 384, 576), depths=(2, 2, 6, 2),
+        num_heads=(3, 6, 12, 18), window_sizes=(16, 16, 32, 16), drop_path_rate=0.1)
+    return _create_tiny_vit('tiny_vit_21m_512', pretrained, **dict(model_kwargs, **kwargs))

@@ -82,8 +82,10 @@ def _backbone_rngs(kwargs):
     so `seed=N` varies the CNN half too, not just the ViT."""
     rngs = kwargs.get('rngs')
     if rngs is None:
+        # offset from the ViT's (seed, seed+1) streams so same-shaped params in
+        # the two halves never share an init key
         seed = kwargs.get('seed', 0)
-        rngs = nnx.Rngs(params=seed, dropout=seed + 1)
+        rngs = nnx.Rngs(params=seed + 2, dropout=seed + 3)
     return rngs
 
 

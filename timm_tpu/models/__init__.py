@@ -22,6 +22,7 @@ from .deit import VisionTransformerDistilled
 from .densenet import DenseNet
 from .dpn import DPN
 from .edgenext import EdgeNeXt
+from .efficientformer import EfficientFormer
 from .efficientnet import EfficientNet
 from .eva import Eva
 from .ghostnet import GhostNet

@@ -1,0 +1,466 @@
+"""EfficientFormer — ViTs at MobileNet speed (NHWC / nnx).
+
+Re-implements reference timm/models/efficientformer.py:1-686
+(EfficientFormer l1/l3/l7): conv stem, three pool-mixer (MetaFormer-style)
+stages, and a final stage that flattens to tokens for LeViT-style biased
+attention blocks, with a distilled dual classifier head.
+
+TPU notes: spatial blocks run NHWC end-to-end; the Flat transition is one
+reshape (channels are already last, no permute needed, unlike the NCHW
+reference). The attention bias is a static dr*W+dc gather folded by XLA into
+the logits add.
+"""
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from timm_tpu.data.constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+from ..layers import (
+    BatchNorm2d, Dropout, DropPath, LayerNorm, LayerScale, Mlp,
+    calculate_drop_path_rates, get_act_fn, to_2tuple, trunc_normal_, zeros_,
+)
+from ..layers.pool import Pool2d
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+from .levit import _attention_bias_idxs
+
+__all__ = ['EfficientFormer']
+
+EfficientFormer_width = {
+    'l1': (48, 96, 224, 448),
+    'l3': (64, 128, 320, 512),
+    'l7': (96, 192, 384, 768),
+}
+
+EfficientFormer_depth = {
+    'l1': (3, 2, 6, 4),
+    'l3': (4, 4, 12, 6),
+    'l7': (6, 6, 18, 8),
+}
+
+
+class EfficientFormerAttention(nnx.Module):
+    """LeViT-style attention whose bias table is indexed by the offset
+    ``|dr|*W + |dc|`` (reference efficientformer.py:53-119); the index table
+    is the stride-1 case of levit's helper."""
+
+    def __init__(self, dim=384, key_dim=32, num_heads=8, attn_ratio=4, resolution=7,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.num_heads = num_heads
+        self.scale = key_dim ** -0.5
+        self.key_dim = key_dim
+        self.key_attn_dim = key_dim * num_heads
+        self.val_dim = int(attn_ratio * key_dim)
+        self.val_attn_dim = self.val_dim * num_heads
+
+        linear = partial(nnx.Linear, use_bias=True, kernel_init=trunc_normal_(std=0.02),
+                         bias_init=zeros_, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.qkv = linear(dim, self.key_attn_dim * 2 + self.val_attn_dim)
+        self.proj = linear(self.val_attn_dim, dim)
+
+        resolution = to_2tuple(resolution)
+        self.attention_biases = nnx.Param(
+            jnp.zeros((num_heads, resolution[0] * resolution[1]), param_dtype))
+        self._bias_idxs = jnp.asarray(_attention_bias_idxs(resolution))
+
+    def __call__(self, x):
+        B, N, C = x.shape
+        qkv = self.qkv(x).reshape(B, N, self.num_heads, -1).transpose(0, 2, 1, 3)
+        q, k, v = jnp.split(qkv, [self.key_dim, 2 * self.key_dim], axis=3)
+        bias = self.attention_biases[...][:, self._bias_idxs].astype(q.dtype)  # (H, N, N)
+        attn = (q @ k.transpose(0, 1, 3, 2)) * self.scale + bias
+        attn = jax.nn.softmax(attn, axis=-1)
+        x = (attn @ v).transpose(0, 2, 1, 3).reshape(B, N, self.val_attn_dim)
+        return self.proj(x)
+
+
+class Stem4(nnx.Module):
+    """Two strided conv+norm+act, stride 4 (reference efficientformer.py:122-145)."""
+
+    def __init__(self, in_chs, out_chs, act_layer='relu', norm_layer=BatchNorm2d,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        conv = partial(nnx.Conv, kernel_size=(3, 3), strides=2, padding=[(1, 1), (1, 1)],
+                       use_bias=True, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.stride = 4
+        self.conv1 = conv(in_chs, out_chs // 2)
+        self.norm1 = norm_layer(out_chs // 2, rngs=rngs)
+        self.conv2 = conv(out_chs // 2, out_chs)
+        self.norm2 = norm_layer(out_chs, rngs=rngs)
+        self.act = get_act_fn(act_layer)
+
+    def __call__(self, x):
+        x = self.act(self.norm1(self.conv1(x)))
+        return self.act(self.norm2(self.conv2(x)))
+
+
+class Downsample(nnx.Module):
+    """Strided conv + norm (reference efficientformer.py:148-177)."""
+
+    def __init__(self, in_chs, out_chs, kernel_size=3, stride=2, padding=None,
+                 norm_layer=BatchNorm2d, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        if padding is None:
+            padding = kernel_size // 2
+        self.conv = nnx.Conv(
+            in_chs, out_chs, kernel_size=to_2tuple(kernel_size), strides=stride,
+            padding=[(padding, padding), (padding, padding)], use_bias=True,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm = norm_layer(out_chs, rngs=rngs)
+
+    def __call__(self, x):
+        return self.norm(self.conv(x))
+
+
+class Flat(nnx.Module):
+    """(B, H, W, C) → (B, N, C); occupies a block index so checkpoint block
+    numbering matches the reference Sequential (efficientformer.py:180-186)."""
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        return x.reshape(B, H * W, C)
+
+
+class Pooling(nnx.Module):
+    """avgpool(x) - x mixer, count_include_pad=False (reference :189-200)."""
+
+    def __init__(self, pool_size=3):
+        self.pool = Pool2d('avg', pool_size, 1, pool_size // 2)
+
+    def __call__(self, x):
+        return self.pool(x) - x
+
+
+class ConvMlpWithNorm(nnx.Module):
+    """1x1 conv MLP with norms (reference efficientformer.py:203-239)."""
+
+    def __init__(self, in_features, hidden_features=None, out_features=None,
+                 act_layer='gelu', norm_layer=BatchNorm2d, drop=0.0,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        out_features = out_features or in_features
+        hidden_features = hidden_features or in_features
+        conv = partial(nnx.Conv, kernel_size=(1, 1), use_bias=True,
+                       dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.fc1 = conv(in_features, hidden_features)
+        self.norm1 = norm_layer(hidden_features, rngs=rngs)
+        self.act = get_act_fn(act_layer)
+        self.fc2 = conv(hidden_features, out_features)
+        self.norm2 = norm_layer(out_features, rngs=rngs)
+        self.drop = Dropout(drop, rngs=rngs)
+
+    def __call__(self, x):
+        x = self.drop(self.act(self.norm1(self.fc1(x))))
+        return self.drop(self.norm2(self.fc2(x)))
+
+
+class MetaBlock1d(nnx.Module):
+    """Token block: LN → biased attention → LS, LN → MLP → LS
+    (reference efficientformer.py:242-271)."""
+
+    def __init__(self, dim, mlp_ratio=4., act_layer='gelu', norm_layer=None,
+                 proj_drop=0., drop_path=0., layer_scale_init_value=1e-5,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        norm_layer = norm_layer or partial(LayerNorm, eps=1e-5)
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        self.token_mixer = EfficientFormerAttention(dim, **kw)
+        self.ls1 = LayerScale(dim, layer_scale_init_value, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs) if drop_path > 0. else None
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp = Mlp(dim, int(dim * mlp_ratio), act_layer=act_layer, drop=proj_drop, **kw)
+        self.ls2 = LayerScale(dim, layer_scale_init_value, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs) if drop_path > 0. else None
+
+    def __call__(self, x):
+        y = self.ls1(self.token_mixer(self.norm1(x)))
+        x = x + (self.drop_path1(y) if self.drop_path1 is not None else y)
+        y = self.ls2(self.mlp(self.norm2(x)))
+        return x + (self.drop_path2(y) if self.drop_path2 is not None else y)
+
+
+class MetaBlock2d(nnx.Module):
+    """Spatial block: pool mixer → LS, conv MLP → LS (reference :274-308)."""
+
+    def __init__(self, dim, pool_size=3, mlp_ratio=4., act_layer='gelu',
+                 norm_layer=BatchNorm2d, proj_drop=0., drop_path=0.,
+                 layer_scale_init_value=1e-5,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.token_mixer = Pooling(pool_size=pool_size)
+        self.ls1 = LayerScale(dim, layer_scale_init_value, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs) if drop_path > 0. else None
+        self.mlp = ConvMlpWithNorm(dim, int(dim * mlp_ratio), act_layer=act_layer,
+                                   norm_layer=norm_layer, drop=proj_drop, **kw)
+        self.ls2 = LayerScale(dim, layer_scale_init_value, param_dtype=param_dtype, rngs=rngs)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs) if drop_path > 0. else None
+
+    def __call__(self, x):
+        y = self.ls1(self.token_mixer(x))
+        x = x + (self.drop_path1(y) if self.drop_path1 is not None else y)
+        y = self.ls2(self.mlp(x))
+        return x + (self.drop_path2(y) if self.drop_path2 is not None else y)
+
+
+class EfficientFormerStage(nnx.Module):
+    """Downsample + 2d blocks, with the last num_vit blocks running as token
+    blocks after a Flat transition (reference efficientformer.py:311-378)."""
+
+    def __init__(self, dim, dim_out, depth, downsample=True, num_vit=1, pool_size=3,
+                 mlp_ratio=4., act_layer='gelu', norm_layer=BatchNorm2d, norm_layer_cl=None,
+                 proj_drop=0., drop_path=0., layer_scale_init_value=1e-5,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.grad_checkpointing = False
+        if downsample:
+            self.downsample = Downsample(dim, dim_out, norm_layer=norm_layer, **kw)
+            dim = dim_out
+        else:
+            assert dim == dim_out
+            self.downsample = None
+
+        blocks = []
+        if num_vit and num_vit >= depth:
+            blocks.append(Flat())
+        for block_idx in range(depth):
+            remain_idx = depth - block_idx - 1
+            dp = drop_path[block_idx] if isinstance(drop_path, (list, tuple)) else drop_path
+            if num_vit and num_vit > remain_idx:
+                blocks.append(MetaBlock1d(
+                    dim, mlp_ratio=mlp_ratio, act_layer=act_layer, norm_layer=norm_layer_cl,
+                    proj_drop=proj_drop, drop_path=dp,
+                    layer_scale_init_value=layer_scale_init_value, **kw))
+            else:
+                blocks.append(MetaBlock2d(
+                    dim, pool_size=pool_size, mlp_ratio=mlp_ratio, act_layer=act_layer,
+                    norm_layer=norm_layer, proj_drop=proj_drop, drop_path=dp,
+                    layer_scale_init_value=layer_scale_init_value, **kw))
+                if num_vit and num_vit == remain_idx:
+                    blocks.append(Flat())
+        self.blocks = nnx.List(blocks)
+
+    def __call__(self, x):
+        if self.downsample is not None:
+            x = self.downsample(x)
+        remat1 = nnx.remat(MetaBlock1d.__call__) if self.grad_checkpointing else None
+        remat2 = nnx.remat(MetaBlock2d.__call__) if self.grad_checkpointing else None
+        for blk in self.blocks:
+            if self.grad_checkpointing and isinstance(blk, MetaBlock1d):
+                x = remat1(blk, x)
+            elif self.grad_checkpointing and isinstance(blk, MetaBlock2d):
+                x = remat2(blk, x)
+            else:
+                x = blk(x)
+        return x
+
+
+class EfficientFormer(nnx.Module):
+    """EfficientFormer (reference efficientformer.py:381-592)."""
+
+    def __init__(
+            self,
+            depths: Tuple[int, ...] = (3, 2, 6, 4),
+            embed_dims: Tuple[int, ...] = (48, 96, 224, 448),
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            downsamples: Optional[Tuple[bool, ...]] = None,
+            num_vit: int = 0,
+            mlp_ratios: float = 4,
+            pool_size: int = 3,
+            layer_scale_init_value: float = 1e-5,
+            act_layer='gelu',
+            norm_layer=BatchNorm2d,
+            norm_layer_cl=None,
+            drop_rate: float = 0.,
+            proj_drop_rate: float = 0.,
+            drop_path_rate: float = 0.,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: Optional[nnx.Rngs] = None,
+    ):
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        norm_layer_cl = norm_layer_cl or partial(LayerNorm, eps=1e-5)
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self._dd = dict(dtype=dtype, param_dtype=param_dtype)
+
+        self.stem = Stem4(in_chans, embed_dims[0], norm_layer=norm_layer, **kw)
+        prev_dim = embed_dims[0]
+
+        self.num_stages = len(depths)
+        last_stage = self.num_stages - 1
+        dpr = calculate_drop_path_rates(drop_path_rate, depths, stagewise=True)
+        downsamples = downsamples or (False,) + (True,) * (self.num_stages - 1)
+        stages = []
+        self.feature_info = []
+        for i in range(self.num_stages):
+            stages.append(EfficientFormerStage(
+                prev_dim, embed_dims[i], depths[i],
+                downsample=downsamples[i],
+                num_vit=num_vit if i == last_stage else 0,
+                pool_size=pool_size, mlp_ratio=mlp_ratios, act_layer=act_layer,
+                norm_layer_cl=norm_layer_cl, norm_layer=norm_layer,
+                proj_drop=proj_drop_rate, drop_path=dpr[i],
+                layer_scale_init_value=layer_scale_init_value, **kw))
+            prev_dim = embed_dims[i]
+            self.feature_info += [dict(num_chs=embed_dims[i], reduction=2 ** (i + 2), module=f'stages.{i}')]
+        self.stages = nnx.List(stages)
+
+        self.num_features = self.head_hidden_size = embed_dims[-1]
+        self.norm = norm_layer_cl(self.num_features, rngs=rngs)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        linear = partial(nnx.Linear, use_bias=True, kernel_init=trunc_normal_(std=0.02),
+                         bias_init=zeros_, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        # the released checkpoints are all distilled → dual heads, averaged at eval
+        self.head = linear(self.num_features, num_classes) if num_classes > 0 else None
+        self.head_dist = linear(self.num_features, num_classes) if num_classes > 0 else None
+        self.distilled_training = False
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'attention_biases'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(stem=r'^stem', blocks=[(r'^stages\.(\d+)', None), (r'^norm', (99999,))])
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        for s in self.stages:
+            s.grad_checkpointing = enable
+
+    def set_distilled_training(self, enable: bool = True):
+        self.distilled_training = enable
+
+    def get_classifier(self):
+        return self.head, self.head_dist
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        if num_classes > 0:
+            linear = partial(nnx.Linear, use_bias=True, kernel_init=trunc_normal_(std=0.02),
+                             bias_init=zeros_, rngs=rngs, **self._dd)
+            self.head = linear(self.num_features, num_classes)
+            self.head_dist = linear(self.num_features, num_classes)
+        else:
+            self.head = None
+            self.head_dist = None
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.stem(x)
+        for stage in self.stages:
+            x = stage(x)
+        return self.norm(x) if self.norm is not None else x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        if self.global_pool == 'avg':
+            x = x.mean(axis=1)
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        x, x_dist = self.head(x), self.head_dist(x)
+        if self.distilled_training and not self.head_drop.deterministic:
+            return x, x_dist
+        return (x + x_dist) / 2
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(self, x, indices=None, norm: bool = False,
+                              stop_early: bool = False, output_fmt: str = 'NHWC',
+                              intermediates_only: bool = False):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        intermediates = []
+        x = self.stem(x)
+        last_idx = self.num_stages - 1
+        B, H, W, C = x.shape
+        stages = self.stages if not stop_early else self.stages[:max_index + 1]
+        feat_idx = 0
+        for feat_idx, stage in enumerate(stages):
+            x = stage(x)
+            if feat_idx < last_idx:
+                B, H, W, C = x.shape
+            if feat_idx in take_indices:
+                if feat_idx == last_idx:
+                    # tokens → NHWC map at the final (post-Flat) stage
+                    x_inter = self.norm(x) if norm and self.norm is not None else x
+                    intermediates.append(x_inter.reshape(B, H // 2, W // 2, -1))
+                else:
+                    intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        if feat_idx == last_idx and self.norm is not None:
+            x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        self.stages = nnx.List(list(self.stages)[:max_index + 1])
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    if 'model' in state_dict:
+        state_dict = state_dict['model']
+    state_dict = {k: v for k, v in state_dict.items() if 'attention_bias_idxs' not in k}
+    return convert_torch_state_dict(state_dict, model)
+
+
+def _cfg(url: str = '', **kwargs):
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': None, 'fixed_input_size': True,
+        'crop_pct': .95, 'interpolation': 'bicubic',
+        'mean': IMAGENET_DEFAULT_MEAN, 'std': IMAGENET_DEFAULT_STD,
+        'first_conv': 'stem.conv1', 'classifier': ('head', 'head_dist'),
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'efficientformer_l1.snap_dist_in1k': _cfg(),
+    'efficientformer_l3.snap_dist_in1k': _cfg(),
+    'efficientformer_l7.snap_dist_in1k': _cfg(),
+})
+
+
+def _create_efficientformer(variant, pretrained=False, **kwargs):
+    out_indices = kwargs.pop('out_indices', 4)
+    return build_model_with_cfg(
+        EfficientFormer, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices, feature_cls='getter'),
+        kwargs_filter=('img_size',),  # fixed_input_size cfg, but the model is size-agnostic
+        **kwargs,
+    )
+
+
+@register_model
+def efficientformer_l1(pretrained=False, **kwargs) -> EfficientFormer:
+    model_args = dict(depths=EfficientFormer_depth['l1'], embed_dims=EfficientFormer_width['l1'], num_vit=1)
+    return _create_efficientformer('efficientformer_l1', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def efficientformer_l3(pretrained=False, **kwargs) -> EfficientFormer:
+    model_args = dict(depths=EfficientFormer_depth['l3'], embed_dims=EfficientFormer_width['l3'], num_vit=4)
+    return _create_efficientformer('efficientformer_l3', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def efficientformer_l7(pretrained=False, **kwargs) -> EfficientFormer:
+    model_args = dict(depths=EfficientFormer_depth['l7'], embed_dims=EfficientFormer_width['l7'], num_vit=8)
+    return _create_efficientformer('efficientformer_l7', pretrained=pretrained, **dict(model_args, **kwargs))

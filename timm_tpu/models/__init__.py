@@ -23,6 +23,7 @@ from .densenet import DenseNet
 from .dpn import DPN
 from .edgenext import EdgeNeXt
 from .efficientformer import EfficientFormer
+from .efficientformer_v2 import EfficientFormerV2
 from .efficientnet import EfficientNet
 from .eva import Eva
 from .ghostnet import GhostNet

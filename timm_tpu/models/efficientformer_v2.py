@@ -1,0 +1,610 @@
+"""EfficientFormer-V2 — rethinking ViTs for MobileNet size/speed (NHWC / nnx).
+
+Re-implements reference timm/models/efficientformer_v2.py:1-946
+(EfficientFormerV2 s0/s1/s2/l): conv stem, conv-MLP blocks with a mid dw conv,
+2D attention with talking heads + local-v dw branch (strided w/ bilinear
+upsample in stage 3), and attention-augmented downsampling into stage 4.
+
+TPU notes: all spatial ops run NHWC; attention q/k/v come from 1x1 convs so
+the token reshape is layout-free. The attention bias tables are per-resolution
+static gathers (reuse of levit's index helper, stride-2 for the downsample
+attention), and the stride-attention upsample is a static-shape bilinear
+resize. Talking-head mixing runs as a 1x1 NHWC conv over the head axis.
+"""
+import math
+from functools import partial
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from timm_tpu.data.constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+from ..layers import (
+    BatchNorm2d, Dropout, DropPath, LayerScale,
+    calculate_drop_path_rates, get_act_fn, to_2tuple, to_ntuple, trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+from .levit import _attention_bias_idxs
+
+__all__ = ['EfficientFormerV2']
+
+EfficientFormer_width = {
+    'L': (40, 80, 192, 384),
+    'S2': (32, 64, 144, 288),
+    'S1': (32, 48, 120, 224),
+    'S0': (32, 48, 96, 176),
+}
+
+EfficientFormer_depth = {
+    'L': (5, 5, 15, 10),
+    'S2': (4, 4, 12, 8),
+    'S1': (3, 3, 9, 6),
+    'S0': (2, 2, 6, 4),
+}
+
+EfficientFormer_expansion_ratios = {
+    'L': (4, 4, (4, 4, 4, 4, 3, 3, 3, 3, 3, 3, 3, 4, 4, 4, 4), (4, 4, 4, 3, 3, 3, 3, 4, 4, 4)),
+    'S2': (4, 4, (4, 4, 3, 3, 3, 3, 3, 3, 4, 4, 4, 4), (4, 4, 3, 3, 3, 3, 4, 4)),
+    'S1': (4, 4, (4, 4, 3, 3, 3, 3, 4, 4, 4), (4, 4, 3, 3, 4, 4)),
+    'S0': (4, 4, (4, 3, 3, 3, 4, 4), (4, 3, 3, 4)),
+}
+
+
+class ConvNorm(nnx.Module):
+    """Conv (bias, torch-symmetric padding) + BN (reference efficientformer_v2.py:69-104)."""
+
+    def __init__(self, in_chs, out_chs, kernel_size=1, stride=1, padding=None, dilation=1,
+                 groups=1, bias=True, norm_layer=BatchNorm2d,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kernel_size = to_2tuple(kernel_size)
+        if padding is None:
+            padding = tuple(((k - 1) * dilation) // 2 for k in kernel_size)
+        padding = to_2tuple(padding)
+        self.conv = nnx.Conv(
+            in_chs, out_chs, kernel_size=kernel_size, strides=stride,
+            padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+            kernel_dilation=(dilation, dilation), feature_group_count=groups, use_bias=bias,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn = norm_layer(out_chs, rngs=rngs)
+
+    def __call__(self, x):
+        return self.bn(self.conv(x))
+
+
+class ConvNormAct(nnx.Module):
+    """ConvNorm + act; children named conv/bn to match checkpoints."""
+
+    def __init__(self, in_chs, out_chs, kernel_size=1, stride=1, groups=1, bias=True,
+                 norm_layer=BatchNorm2d, act_layer='gelu',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kernel_size = to_2tuple(kernel_size)
+        padding = tuple((k - 1) // 2 for k in kernel_size)
+        self.conv = nnx.Conv(
+            in_chs, out_chs, kernel_size=kernel_size, strides=stride,
+            padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+            feature_group_count=groups, use_bias=bias,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn = norm_layer(out_chs, rngs=rngs)
+        self.act = get_act_fn(act_layer)
+
+    def __call__(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class Attention2d(nnx.Module):
+    """2D attention with talking heads, local-v dw branch, and optional
+    stride-2 operation with bilinear upsample (reference :107-230)."""
+
+    def __init__(self, dim=384, key_dim=32, num_heads=8, attn_ratio=4, resolution=7,
+                 act_layer='gelu', stride=None, norm_layer=BatchNorm2d,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(norm_layer=norm_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.num_heads = num_heads
+        self.scale = key_dim ** -0.5
+        self.key_dim = key_dim
+
+        resolution = to_2tuple(resolution)
+        if stride is not None:
+            resolution = tuple(math.ceil(r / stride) for r in resolution)
+            self.stride_conv = ConvNorm(dim, dim, kernel_size=3, stride=stride, groups=dim, **kw)
+            self.upsample_stride = stride
+        else:
+            self.stride_conv = None
+            self.upsample_stride = None
+        self.resolution = resolution
+        self.N = resolution[0] * resolution[1]
+        self.d = int(attn_ratio * key_dim)
+        self.dh = self.d * num_heads
+        kh = key_dim * num_heads
+
+        self.q = ConvNorm(dim, kh, **kw)
+        self.k = ConvNorm(dim, kh, **kw)
+        self.v = ConvNorm(dim, self.dh, **kw)
+        self.v_local = ConvNorm(self.dh, self.dh, kernel_size=3, groups=self.dh, **kw)
+        # talking heads: 1x1 convs over the head axis (attn laid out (B,N,M,heads))
+        th = partial(nnx.Conv, kernel_size=(1, 1), use_bias=True,
+                     dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.talking_head1 = th(num_heads, num_heads)
+        self.talking_head2 = th(num_heads, num_heads)
+        self.act = get_act_fn(act_layer)
+        self.proj = ConvNorm(self.dh, dim, 1, **kw)
+
+        self.attention_biases = nnx.Param(jnp.zeros((num_heads, self.N), param_dtype))
+        self._bias_idxs = jnp.asarray(_attention_bias_idxs(resolution))
+
+    def __call__(self, x):
+        B, H0, W0, C = x.shape
+        if self.stride_conv is not None:
+            x = self.stride_conv(x)
+        B, H, W, _ = x.shape
+        N = H * W
+
+        q = self.q(x).reshape(B, N, self.num_heads, self.key_dim)
+        k = self.k(x).reshape(B, N, self.num_heads, self.key_dim)
+        v_map = self.v(x)
+        v_local = self.v_local(v_map)
+        v = v_map.reshape(B, N, self.num_heads, self.d)
+
+        attn = jnp.einsum('bnhd,bmhd->bnmh', q, k) * self.scale
+        bias = self.attention_biases[...][:, self._bias_idxs].transpose(1, 2, 0)  # (N, N, H)
+        attn = attn + bias.astype(attn.dtype)
+        attn = self.talking_head1(attn)
+        attn = jax.nn.softmax(attn, axis=2)
+        attn = self.talking_head2(attn)
+
+        x = jnp.einsum('bnmh,bmhd->bnhd', attn, v).reshape(B, H, W, self.dh)
+        x = x + v_local
+        if self.upsample_stride is not None:
+            x = jax.image.resize(x, (B, H0, W0, self.dh), method='bilinear')
+        x = self.act(x)
+        return self.proj(x)
+
+
+class LocalGlobalQuery(nnx.Module):
+    """Stride-2 query: dw conv + 1x1-kernel stride-2 'pool' (a plain
+    subsample), summed then projected (reference :233-252)."""
+
+    def __init__(self, in_dim, out_dim, *, dtype=None, param_dtype=jnp.float32,
+                 norm_layer=BatchNorm2d, rngs: nnx.Rngs):
+        self.local = nnx.Conv(
+            in_dim, in_dim, kernel_size=(3, 3), strides=2, padding=[(1, 1), (1, 1)],
+            feature_group_count=in_dim, use_bias=True,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.proj = ConvNorm(in_dim, out_dim, 1, norm_layer=norm_layer,
+                             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        pool_q = x[:, ::2, ::2, :]  # AvgPool2d(1, 2, 0) == stride-2 subsample
+        local_q = self.local(x)
+        return self.proj(local_q + pool_q)
+
+
+class Attention2dDownsample(nnx.Module):
+    """Attention with stride-2 queries producing a downsampled map
+    (reference efficientformer_v2.py:255-368)."""
+
+    def __init__(self, dim=384, key_dim=16, num_heads=8, attn_ratio=4, resolution=7,
+                 out_dim=None, act_layer='gelu', norm_layer=BatchNorm2d,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(norm_layer=norm_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.num_heads = num_heads
+        self.scale = key_dim ** -0.5
+        self.key_dim = key_dim
+        self.resolution = to_2tuple(resolution)
+        self.resolution2 = tuple(math.ceil(r / 2) for r in self.resolution)
+        self.N = self.resolution[0] * self.resolution[1]
+        self.N2 = self.resolution2[0] * self.resolution2[1]
+        self.d = int(attn_ratio * key_dim)
+        self.dh = self.d * num_heads
+        self.out_dim = out_dim or dim
+        kh = key_dim * num_heads
+
+        self.q = LocalGlobalQuery(dim, kh, norm_layer=norm_layer,
+                                  dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.k = ConvNorm(dim, kh, 1, **kw)
+        self.v = ConvNorm(dim, self.dh, 1, **kw)
+        self.v_local = ConvNorm(self.dh, self.dh, kernel_size=3, stride=2, groups=self.dh, **kw)
+        self.act = get_act_fn(act_layer)
+        self.proj = ConvNorm(self.dh, self.out_dim, 1, **kw)
+
+        self.attention_biases = nnx.Param(jnp.zeros((num_heads, self.N), param_dtype))
+        self._bias_idxs = jnp.asarray(_attention_bias_idxs(self.resolution, stride=2))  # (N2, N)
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        q = self.q(x).reshape(B, self.N2, self.num_heads, self.key_dim)
+        k = self.k(x).reshape(B, self.N, self.num_heads, self.key_dim)
+        v_map = self.v(x)
+        v_local = self.v_local(v_map)
+        v = v_map.reshape(B, self.N, self.num_heads, self.d)
+
+        attn = jnp.einsum('bnhd,bmhd->bhnm', q, k) * self.scale
+        bias = self.attention_biases[...][:, self._bias_idxs]  # (H, N2, N)
+        attn = jax.nn.softmax(attn + bias.astype(attn.dtype), axis=-1)
+
+        x = jnp.einsum('bhnm,bmhd->bnhd', attn, v).reshape(
+            B, self.resolution2[0], self.resolution2[1], self.dh)
+        x = self.act(x + v_local)
+        return self.proj(x)
+
+
+class Downsample(nnx.Module):
+    """Strided ConvNorm, optionally summed with attention downsampling
+    (reference efficientformer_v2.py:371-418)."""
+
+    def __init__(self, in_chs, out_chs, kernel_size=3, stride=2, padding=1, resolution=7,
+                 use_attn=False, act_layer='gelu', norm_layer=BatchNorm2d,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.conv = ConvNorm(
+            in_chs, out_chs, kernel_size=kernel_size, stride=stride, padding=padding,
+            norm_layer=norm_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.attn = Attention2dDownsample(
+            dim=in_chs, out_dim=out_chs, resolution=resolution, act_layer=act_layer,
+            norm_layer=norm_layer, dtype=dtype, param_dtype=param_dtype,
+            rngs=rngs) if use_attn else None
+
+    def __call__(self, x):
+        out = self.conv(x)
+        if self.attn is not None:
+            return self.attn(x) + out
+        return out
+
+
+class ConvMlpWithNorm(nnx.Module):
+    """1x1 conv MLP with optional mid dw conv (reference :421-475)."""
+
+    def __init__(self, in_features, hidden_features=None, out_features=None,
+                 act_layer='gelu', norm_layer=BatchNorm2d, drop=0.0, mid_conv=False,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        out_features = out_features or in_features
+        hidden_features = hidden_features or in_features
+        kw = dict(norm_layer=norm_layer, act_layer=act_layer,
+                  dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.fc1 = ConvNormAct(in_features, hidden_features, 1, bias=True, **kw)
+        self.mid = ConvNormAct(hidden_features, hidden_features, 3,
+                               groups=hidden_features, bias=True, **kw) if mid_conv else None
+        self.drop1 = Dropout(drop, rngs=rngs)
+        self.fc2 = ConvNorm(hidden_features, out_features, 1, norm_layer=norm_layer,
+                            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop2 = Dropout(drop, rngs=rngs)
+
+    def __call__(self, x):
+        x = self.fc1(x)
+        if self.mid is not None:
+            x = self.mid(x)
+        x = self.drop1(x)
+        return self.drop2(self.fc2(x))
+
+
+class EfficientFormerV2Block(nnx.Module):
+    """Optional attention mixer + conv MLP, each with LayerScale
+    (reference efficientformer_v2.py:478-530)."""
+
+    def __init__(self, dim, mlp_ratio=4., act_layer='gelu', norm_layer=BatchNorm2d,
+                 proj_drop=0., drop_path=0., layer_scale_init_value=1e-5,
+                 resolution=7, stride=None, use_attn=True,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        if use_attn:
+            self.token_mixer = Attention2d(
+                dim, resolution=resolution, act_layer=act_layer, stride=stride,
+                norm_layer=norm_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.ls1 = LayerScale(dim, layer_scale_init_value, param_dtype=param_dtype,
+                                  rngs=rngs) if layer_scale_init_value is not None else None
+            self.drop_path1 = DropPath(drop_path, rngs=rngs) if drop_path > 0. else None
+        else:
+            self.token_mixer = None
+            self.ls1 = None
+            self.drop_path1 = None
+        self.mlp = ConvMlpWithNorm(
+            dim, int(dim * mlp_ratio), act_layer=act_layer, norm_layer=norm_layer,
+            drop=proj_drop, mid_conv=True, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.ls2 = LayerScale(dim, layer_scale_init_value, param_dtype=param_dtype,
+                              rngs=rngs) if layer_scale_init_value is not None else None
+        self.drop_path2 = DropPath(drop_path, rngs=rngs) if drop_path > 0. else None
+
+    def __call__(self, x):
+        if self.token_mixer is not None:
+            y = self.token_mixer(x)
+            y = self.ls1(y) if self.ls1 is not None else y
+            x = x + (self.drop_path1(y) if self.drop_path1 is not None else y)
+        y = self.mlp(x)
+        y = self.ls2(y) if self.ls2 is not None else y
+        return x + (self.drop_path2(y) if self.drop_path2 is not None else y)
+
+
+class Stem4(nnx.Module):
+    """Two strided ConvNormActs, stride 4 (reference :533-566)."""
+
+    def __init__(self, in_chs, out_chs, act_layer='gelu', norm_layer=BatchNorm2d,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(norm_layer=norm_layer, act_layer=act_layer,
+                  dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.stride = 4
+        self.conv1 = ConvNormAct(in_chs, out_chs // 2, kernel_size=3, stride=2, bias=True, **kw)
+        self.conv2 = ConvNormAct(out_chs // 2, out_chs, kernel_size=3, stride=2, bias=True, **kw)
+
+    def __call__(self, x):
+        return self.conv2(self.conv1(x))
+
+
+class EfficientFormerV2Stage(nnx.Module):
+    """Downsample + blocks; the last num_vit blocks attend
+    (reference efficientformer_v2.py:569-638)."""
+
+    def __init__(self, dim, dim_out, depth, resolution=7, downsample=True,
+                 block_stride=None, downsample_use_attn=False, block_use_attn=False,
+                 num_vit=1, mlp_ratio=4., proj_drop=0., drop_path=0.,
+                 layer_scale_init_value=1e-5, act_layer='gelu', norm_layer=BatchNorm2d,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(act_layer=act_layer, norm_layer=norm_layer,
+                  dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.grad_checkpointing = False
+        mlp_ratio = to_ntuple(depth)(mlp_ratio)
+        resolution = to_2tuple(resolution)
+
+        if downsample:
+            self.downsample = Downsample(
+                dim, dim_out, use_attn=downsample_use_attn, resolution=resolution, **kw)
+            dim = dim_out
+            resolution = tuple(math.ceil(r / 2) for r in resolution)
+        else:
+            assert dim == dim_out
+            self.downsample = None
+
+        blocks = []
+        for block_idx in range(depth):
+            remain_idx = depth - num_vit - 1
+            blocks.append(EfficientFormerV2Block(
+                dim, resolution=resolution, stride=block_stride,
+                mlp_ratio=mlp_ratio[block_idx],
+                use_attn=block_use_attn and block_idx > remain_idx,
+                proj_drop=proj_drop,
+                drop_path=drop_path[block_idx] if isinstance(drop_path, (list, tuple)) else drop_path,
+                layer_scale_init_value=layer_scale_init_value,
+                **kw))
+        self.blocks = nnx.List(blocks)
+
+    def __call__(self, x):
+        if self.downsample is not None:
+            x = self.downsample(x)
+        remat_blk = nnx.remat(EfficientFormerV2Block.__call__) if self.grad_checkpointing else None
+        for blk in self.blocks:
+            x = remat_blk(blk, x) if remat_blk is not None else blk(x)
+        return x
+
+
+class EfficientFormerV2(nnx.Module):
+    """EfficientFormerV2 (reference efficientformer_v2.py:641-860)."""
+
+    def __init__(
+            self,
+            depths: Tuple[int, ...],
+            in_chans: int = 3,
+            img_size: Union[int, Tuple[int, int]] = 224,
+            global_pool: str = 'avg',
+            embed_dims: Optional[Tuple[int, ...]] = None,
+            downsamples: Optional[Tuple[bool, ...]] = None,
+            mlp_ratios=4,
+            norm_layer=BatchNorm2d,
+            norm_eps: float = 1e-5,
+            act_layer='gelu',
+            num_classes: int = 1000,
+            drop_rate: float = 0.,
+            proj_drop_rate: float = 0.,
+            drop_path_rate: float = 0.,
+            layer_scale_init_value: Optional[float] = 1e-5,
+            num_vit: int = 0,
+            distillation: bool = True,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: Optional[nnx.Rngs] = None,
+    ):
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        assert global_pool in ('avg', '')
+        norm_layer = partial(norm_layer, eps=norm_eps)
+        kw = dict(act_layer=act_layer, norm_layer=norm_layer,
+                  dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.feature_info = []
+        img_size = to_2tuple(img_size)
+        self._dd = dict(dtype=dtype, param_dtype=param_dtype)
+
+        self.stem = Stem4(in_chans, embed_dims[0], **kw)
+        prev_dim = embed_dims[0]
+        stride = 4
+
+        num_stages = len(depths)
+        dpr = calculate_drop_path_rates(drop_path_rate, depths, stagewise=True)
+        downsamples = downsamples or (False,) + (True,) * (num_stages - 1)
+        mlp_ratios = to_ntuple(num_stages)(mlp_ratios)
+        stages = []
+        for i in range(num_stages):
+            curr_resolution = tuple(math.ceil(s / stride) for s in img_size)
+            stages.append(EfficientFormerV2Stage(
+                prev_dim, embed_dims[i], depth=depths[i], resolution=curr_resolution,
+                downsample=downsamples[i],
+                block_stride=2 if i == 2 else None,
+                downsample_use_attn=i >= 3,
+                block_use_attn=i >= 2,
+                num_vit=num_vit,
+                mlp_ratio=mlp_ratios[i],
+                proj_drop=proj_drop_rate,
+                drop_path=dpr[i],
+                layer_scale_init_value=layer_scale_init_value,
+                **kw))
+            if downsamples[i]:
+                stride *= 2
+            prev_dim = embed_dims[i]
+            self.feature_info += [dict(num_chs=prev_dim, reduction=stride, module=f'stages.{i}')]
+        self.stages = nnx.List(stages)
+
+        self.num_features = self.head_hidden_size = embed_dims[-1]
+        self.norm = norm_layer(embed_dims[-1], rngs=rngs)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        linear = partial(nnx.Linear, use_bias=True, kernel_init=trunc_normal_(std=0.02),
+                         bias_init=zeros_, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.head = linear(embed_dims[-1], num_classes) if num_classes > 0 else None
+        self.dist = distillation
+        self.head_dist = linear(embed_dims[-1], num_classes) if (distillation and num_classes > 0) else None
+        self.distilled_training = False
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'attention_biases'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(stem=r'^stem', blocks=[(r'^stages\.(\d+)', None), (r'^norm', (99999,))])
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        for s in self.stages:
+            s.grad_checkpointing = enable
+
+    def set_distilled_training(self, enable: bool = True):
+        self.distilled_training = enable
+
+    def get_classifier(self):
+        return self.head, self.head_dist
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        if num_classes > 0:
+            linear = partial(nnx.Linear, use_bias=True, kernel_init=trunc_normal_(std=0.02),
+                             bias_init=zeros_, rngs=rngs, **self._dd)
+            self.head = linear(self.num_features, num_classes)
+            self.head_dist = linear(self.num_features, num_classes) if self.dist else None
+        else:
+            self.head = None
+            self.head_dist = None
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.stem(x)
+        for stage in self.stages:
+            x = stage(x)
+        return self.norm(x) if self.norm is not None else x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        if self.global_pool == 'avg':
+            x = x.mean(axis=(1, 2))
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        if self.head_dist is None:
+            return self.head(x)
+        x, x_dist = self.head(x), self.head_dist(x)
+        if self.distilled_training and not self.head_drop.deterministic:
+            return x, x_dist
+        return (x + x_dist) / 2
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(self, x, indices=None, norm: bool = False,
+                              stop_early: bool = False, output_fmt: str = 'NHWC',
+                              intermediates_only: bool = False):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        intermediates = []
+        x = self.stem(x)
+        last_idx = len(self.stages) - 1
+        stages = self.stages if not stop_early else self.stages[:max_index + 1]
+        feat_idx = 0
+        for feat_idx, stage in enumerate(stages):
+            x = stage(x)
+            if feat_idx in take_indices:
+                if feat_idx == last_idx and norm and self.norm is not None:
+                    intermediates.append(self.norm(x))
+                else:
+                    intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        if feat_idx == last_idx and self.norm is not None:
+            x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        self.stages = nnx.List(list(self.stages)[:max_index + 1])
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    if 'model' in state_dict:
+        state_dict = state_dict['model']
+    state_dict = {k: v for k, v in state_dict.items() if 'attention_bias_idxs' not in k}
+    return convert_torch_state_dict(state_dict, model)
+
+
+def _cfg(url: str = '', **kwargs):
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': None, 'fixed_input_size': True,
+        'crop_pct': .95, 'interpolation': 'bicubic',
+        'mean': IMAGENET_DEFAULT_MEAN, 'std': IMAGENET_DEFAULT_STD,
+        'classifier': ('head', 'head_dist'), 'first_conv': 'stem.conv1.conv',
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'efficientformerv2_s0.snap_dist_in1k': _cfg(),
+    'efficientformerv2_s1.snap_dist_in1k': _cfg(),
+    'efficientformerv2_s2.snap_dist_in1k': _cfg(),
+    'efficientformerv2_l.snap_dist_in1k': _cfg(),
+})
+
+
+def _create_efficientformerv2(variant, pretrained=False, **kwargs):
+    out_indices = kwargs.pop('out_indices', (0, 1, 2, 3))
+    return build_model_with_cfg(
+        EfficientFormerV2, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices, feature_cls='getter'),
+        **kwargs,
+    )
+
+
+@register_model
+def efficientformerv2_s0(pretrained=False, **kwargs) -> EfficientFormerV2:
+    model_args = dict(
+        depths=EfficientFormer_depth['S0'], embed_dims=EfficientFormer_width['S0'],
+        num_vit=2, drop_path_rate=0.0, mlp_ratios=EfficientFormer_expansion_ratios['S0'])
+    return _create_efficientformerv2('efficientformerv2_s0', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def efficientformerv2_s1(pretrained=False, **kwargs) -> EfficientFormerV2:
+    model_args = dict(
+        depths=EfficientFormer_depth['S1'], embed_dims=EfficientFormer_width['S1'],
+        num_vit=2, drop_path_rate=0.0, mlp_ratios=EfficientFormer_expansion_ratios['S1'])
+    return _create_efficientformerv2('efficientformerv2_s1', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def efficientformerv2_s2(pretrained=False, **kwargs) -> EfficientFormerV2:
+    model_args = dict(
+        depths=EfficientFormer_depth['S2'], embed_dims=EfficientFormer_width['S2'],
+        num_vit=4, drop_path_rate=0.02, mlp_ratios=EfficientFormer_expansion_ratios['S2'])
+    return _create_efficientformerv2('efficientformerv2_s2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def efficientformerv2_l(pretrained=False, **kwargs) -> EfficientFormerV2:
+    model_args = dict(
+        depths=EfficientFormer_depth['L'], embed_dims=EfficientFormer_width['L'],
+        num_vit=6, drop_path_rate=0.1, mlp_ratios=EfficientFormer_expansion_ratios['L'])
+    return _create_efficientformerv2('efficientformerv2_l', pretrained=pretrained, **dict(model_args, **kwargs))

@@ -33,6 +33,7 @@ from .maxxvit import MaxxVit, MaxxVitCfg
 from .metaformer import MetaFormer
 from .mlp_mixer import MlpMixer
 from .mobilenetv3 import MobileNetV3
+from .mobilevit import *  # noqa: F401,F403 — registers mobilevit entrypoints
 from .mvitv2 import MultiScaleVit, MultiScaleVitCfg
 from .naflexvit import NaFlexVit
 from .nfnet import NfCfg, NormFreeNet

@@ -1,0 +1,419 @@
+"""MobileViT v1/v2 — mobile conv-transformer hybrids on ByobNet (NHWC / nnx).
+
+Re-implements reference timm/models/mobilevit.py:1-710: MobileViT stacks
+inverted-residual ByobNet stages with blocks that unfold the feature map into
+non-overlapping patches, run transformers across patches, and fold back. V1
+uses standard MHSA across patch positions (one sequence per intra-patch
+pixel); V2 uses separable linear self-attention in a (B, P, N, C) layout.
+
+TPU notes: unfold/fold are static reshape/transpose chains (channels-last, so
+no NCHW permutes); the v2 linear attention is elementwise + two reductions
+over the patch axis — XLA fuses it into a handful of kernels. The rare
+non-divisible resize path uses statically-built bilinear weight matrices
+(einsum), exact for both align_corners conventions.
+"""
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from ..layers import ConvMlp, Dropout, DropPath, GroupNorm1, LayerNorm, make_divisible, to_2tuple
+from ._builder import build_model_with_cfg
+from ._registry import generate_default_cfgs, register_model
+from .byobnet import ByoBlockCfg, ByoModelCfg, ByobNet, LayerFn, num_groups, register_block
+from .vision_transformer import Block as TransformerBlock
+
+__all__ = []
+
+
+def _inverted_residual_block(d, c, s, br=4.0):
+    return ByoBlockCfg(
+        type='bottle', d=d, c=c, s=s, gs=1, br=br,
+        block_kwargs=dict(bottle_in=True, linear_out=True))
+
+
+def _mobilevit_block(d, c, s, transformer_dim, transformer_depth, patch_size=4, br=4.0):
+    return (
+        _inverted_residual_block(d=d, c=c, s=s, br=br),
+        ByoBlockCfg(
+            type='mobilevit', d=1, c=c, s=1,
+            block_kwargs=dict(
+                transformer_dim=transformer_dim,
+                transformer_depth=transformer_depth,
+                patch_size=patch_size)),
+    )
+
+
+def _mobilevitv2_block(d, c, s, transformer_depth, patch_size=2, br=2.0, transformer_br=0.5):
+    return (
+        _inverted_residual_block(d=d, c=c, s=s, br=br),
+        ByoBlockCfg(
+            type='mobilevit2', d=1, c=c, s=1, br=transformer_br, gs=1,
+            block_kwargs=dict(
+                transformer_depth=transformer_depth,
+                patch_size=patch_size)),
+    )
+
+
+def _mobilevitv2_cfg(multiplier=1.0):
+    chs = (64, 128, 256, 384, 512)
+    if multiplier != 1.0:
+        chs = tuple([int(c * multiplier) for c in chs])
+    return ByoModelCfg(
+        blocks=(
+            _inverted_residual_block(d=1, c=chs[0], s=1, br=2.0),
+            _inverted_residual_block(d=2, c=chs[1], s=2, br=2.0),
+            _mobilevitv2_block(d=1, c=chs[2], s=2, transformer_depth=2),
+            _mobilevitv2_block(d=1, c=chs[3], s=2, transformer_depth=4),
+            _mobilevitv2_block(d=1, c=chs[4], s=2, transformer_depth=3),
+        ),
+        stem_chs=int(32 * multiplier),
+        stem_type='3x3',
+        stem_pool='',
+        downsample='',
+        act_layer='silu',
+    )
+
+
+model_cfgs = dict(
+    mobilevit_xxs=ByoModelCfg(
+        blocks=(
+            _inverted_residual_block(d=1, c=16, s=1, br=2.0),
+            _inverted_residual_block(d=3, c=24, s=2, br=2.0),
+            _mobilevit_block(d=1, c=48, s=2, transformer_dim=64, transformer_depth=2, patch_size=2, br=2.0),
+            _mobilevit_block(d=1, c=64, s=2, transformer_dim=80, transformer_depth=4, patch_size=2, br=2.0),
+            _mobilevit_block(d=1, c=80, s=2, transformer_dim=96, transformer_depth=3, patch_size=2, br=2.0),
+        ),
+        stem_chs=16, stem_type='3x3', stem_pool='', downsample='',
+        act_layer='silu', num_features=320,
+    ),
+    mobilevit_xs=ByoModelCfg(
+        blocks=(
+            _inverted_residual_block(d=1, c=32, s=1),
+            _inverted_residual_block(d=3, c=48, s=2),
+            _mobilevit_block(d=1, c=64, s=2, transformer_dim=96, transformer_depth=2, patch_size=2),
+            _mobilevit_block(d=1, c=80, s=2, transformer_dim=120, transformer_depth=4, patch_size=2),
+            _mobilevit_block(d=1, c=96, s=2, transformer_dim=144, transformer_depth=3, patch_size=2),
+        ),
+        stem_chs=16, stem_type='3x3', stem_pool='', downsample='',
+        act_layer='silu', num_features=384,
+    ),
+    mobilevit_s=ByoModelCfg(
+        blocks=(
+            _inverted_residual_block(d=1, c=32, s=1),
+            _inverted_residual_block(d=3, c=64, s=2),
+            _mobilevit_block(d=1, c=96, s=2, transformer_dim=144, transformer_depth=2, patch_size=2),
+            _mobilevit_block(d=1, c=128, s=2, transformer_dim=192, transformer_depth=4, patch_size=2),
+            _mobilevit_block(d=1, c=160, s=2, transformer_dim=240, transformer_depth=3, patch_size=2),
+        ),
+        stem_chs=16, stem_type='3x3', stem_pool='', downsample='',
+        act_layer='silu', num_features=640,
+    ),
+    mobilevitv2_050=_mobilevitv2_cfg(.50),
+    mobilevitv2_075=_mobilevitv2_cfg(.75),
+    mobilevitv2_125=_mobilevitv2_cfg(1.25),
+    mobilevitv2_100=_mobilevitv2_cfg(1.0),
+    mobilevitv2_150=_mobilevitv2_cfg(1.5),
+    mobilevitv2_175=_mobilevitv2_cfg(1.75),
+    mobilevitv2_200=_mobilevitv2_cfg(2.0),
+)
+
+
+def _bilinear_resize(x, out_h, out_w, align_corners: bool):
+    """Exact bilinear resize via static weight matrices (NHWC einsum).
+
+    Shapes are compile-time constants, so the (out, in) weight matrices are
+    numpy-built at trace time; supports align_corners=True (v2 blocks) which
+    jax.image.resize does not."""
+    B, H, W, C = x.shape
+    if H == out_h and W == out_w:
+        return x
+
+    def weights(n_in, n_out):
+        w = np.zeros((n_out, n_in), np.float32)
+        for o in range(n_out):
+            if align_corners and n_out > 1:
+                pos = o * (n_in - 1) / (n_out - 1)
+            else:
+                pos = max((o + 0.5) * n_in / n_out - 0.5, 0.0)
+            lo = min(int(math.floor(pos)), n_in - 1)
+            hi = min(lo + 1, n_in - 1)
+            frac = pos - lo
+            w[o, lo] += 1.0 - frac
+            w[o, hi] += frac
+        return jnp.asarray(w)
+
+    wh = weights(H, out_h)
+    ww = weights(W, out_w)
+    x = jnp.einsum('oh,bhwc->bowc', wh.astype(x.dtype), x)
+    return jnp.einsum('pw,bowc->bopc', ww.astype(x.dtype), x)
+
+
+class MobileVitBlock(nnx.Module):
+    """Local conv + patch-unfolded transformer + fold + fusion
+    (reference mobilevit.py:165-280)."""
+
+    def __init__(
+            self, in_chs, out_chs=None, kernel_size=3, stride=1, bottle_ratio=1.0,
+            group_size=None, dilation=(1, 1), mlp_ratio=2.0, transformer_dim=None,
+            transformer_depth=2, patch_size=8, num_heads=4, attn_drop=0., drop=0.,
+            no_fusion=False, drop_path_rate=0., layers: LayerFn = None,
+            transformer_norm_layer=partial(LayerNorm, eps=1e-5),  # torch nn.LayerNorm default
+            *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs, **kwargs):
+        layers = layers or LayerFn()
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        groups = num_groups(group_size, in_chs)
+        out_chs = out_chs or in_chs
+        transformer_dim = transformer_dim or make_divisible(bottle_ratio * in_chs)
+
+        self.conv_kxk = layers.conv_norm_act(
+            in_chs, in_chs, kernel_size=kernel_size, stride=stride,
+            groups=groups, dilation=dilation[0], **dd)
+        self.conv_1x1 = nnx.Conv(
+            in_chs, transformer_dim, kernel_size=(1, 1), use_bias=False,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.transformer = nnx.List([
+            TransformerBlock(
+                transformer_dim, mlp_ratio=mlp_ratio, num_heads=num_heads, qkv_bias=True,
+                attn_drop=attn_drop, proj_drop=drop, drop_path=drop_path_rate,
+                act_layer=layers.act, norm_layer=transformer_norm_layer, **dd)
+            for _ in range(transformer_depth)])
+        self.norm = transformer_norm_layer(transformer_dim, rngs=rngs)
+        self.conv_proj = layers.conv_norm_act(transformer_dim, out_chs, kernel_size=1, stride=1, **dd)
+        self.conv_fusion = None if no_fusion else layers.conv_norm_act(
+            in_chs + out_chs, out_chs, kernel_size=kernel_size, stride=1, **dd)
+        self.patch_size = to_2tuple(patch_size)
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.conv_kxk(x)
+        x = self.conv_1x1(x)
+
+        ph, pw = self.patch_size
+        B, H, W, C = x.shape
+        new_h, new_w = math.ceil(H / ph) * ph, math.ceil(W / pw) * pw
+        nh, nw = new_h // ph, new_w // pw
+        interpolate = new_h != H or new_w != W
+        if interpolate:
+            x = _bilinear_resize(x, new_h, new_w, align_corners=False)
+
+        # unfold: one sequence of N patches per intra-patch pixel (B*P, N, C)
+        x = x.reshape(B, nh, ph, nw, pw, C).transpose(0, 2, 4, 1, 3, 5)
+        x = x.reshape(B * ph * pw, nh * nw, C)
+        for blk in self.transformer:
+            x = blk(x)
+        x = self.norm(x)
+        # fold back
+        x = x.reshape(B, ph, pw, nh, nw, C).transpose(0, 3, 1, 4, 2, 5)
+        x = x.reshape(B, new_h, new_w, C)
+        if interpolate:
+            x = _bilinear_resize(x, H, W, align_corners=False)
+
+        x = self.conv_proj(x)
+        if self.conv_fusion is not None:
+            x = self.conv_fusion(jnp.concatenate([shortcut, x], axis=-1))
+        return x
+
+
+class LinearSelfAttention(nnx.Module):
+    """Separable linear self-attention over the patch axis; input laid out
+    (B, P, N, C) with 1x1 convs over C (reference mobilevit.py:281-402)."""
+
+    def __init__(self, embed_dim, attn_drop=0.0, proj_drop=0.0, bias=True,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.embed_dim = embed_dim
+        self.qkv_proj = nnx.Conv(
+            embed_dim, 1 + 2 * embed_dim, kernel_size=(1, 1), use_bias=bias,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.out_proj = nnx.Conv(
+            embed_dim, embed_dim, kernel_size=(1, 1), use_bias=bias,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.out_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x):
+        # x: (B, P, N, C)
+        qkv = self.qkv_proj(x)
+        query, key, value = jnp.split(qkv, [1, 1 + self.embed_dim], axis=-1)
+        context_scores = jax.nn.softmax(query, axis=2)  # softmax over patches N
+        context_scores = self.attn_drop(context_scores)
+        context_vector = (key * context_scores).sum(axis=2, keepdims=True)  # (B, P, 1, d)
+        out = jax.nn.relu(value) * context_vector
+        return self.out_drop(self.out_proj(out))
+
+
+class LinearTransformerBlock(nnx.Module):
+    """Pre-norm linear-attention transformer in (B, P, N, C)
+    (reference mobilevit.py:405-465)."""
+
+    def __init__(self, embed_dim, mlp_ratio=2.0, drop=0.0, attn_drop=0.0, drop_path=0.0,
+                 act_layer=None, norm_layer=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        act_layer = act_layer or 'silu'
+        norm_layer = norm_layer or GroupNorm1
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm1 = norm_layer(embed_dim, rngs=rngs)
+        self.attn = LinearSelfAttention(embed_dim, attn_drop=attn_drop, proj_drop=drop, **dd)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs)
+        self.norm2 = norm_layer(embed_dim, rngs=rngs)
+        self.mlp = ConvMlp(embed_dim, int(embed_dim * mlp_ratio), act_layer=act_layer, drop=drop, **dd)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        x = x + self.drop_path1(self.attn(self.norm1(x)))
+        return x + self.drop_path2(self.mlp(self.norm2(x)))
+
+
+class MobileVitV2Block(nnx.Module):
+    """MobileViTv2 block with separable linear attention
+    (reference mobilevit.py:468-571)."""
+
+    def __init__(
+            self, in_chs, out_chs=None, kernel_size=3, bottle_ratio=1.0, group_size=1,
+            dilation=(1, 1), mlp_ratio=2.0, transformer_dim=None, transformer_depth=2,
+            patch_size=8, attn_drop=0., drop=0., drop_path_rate=0.,
+            layers: LayerFn = None, transformer_norm_layer=GroupNorm1,
+            *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs, **kwargs):
+        layers = layers or LayerFn()
+        dd = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        groups = num_groups(group_size, in_chs)
+        out_chs = out_chs or in_chs
+        transformer_dim = transformer_dim or make_divisible(bottle_ratio * in_chs)
+
+        self.conv_kxk = layers.conv_norm_act(
+            in_chs, in_chs, kernel_size=kernel_size, stride=1,
+            groups=groups, dilation=dilation[0], **dd)
+        self.conv_1x1 = nnx.Conv(
+            in_chs, transformer_dim, kernel_size=(1, 1), use_bias=False,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.transformer = nnx.List([
+            LinearTransformerBlock(
+                transformer_dim, mlp_ratio=mlp_ratio, attn_drop=attn_drop, drop=drop,
+                drop_path=drop_path_rate, act_layer=layers.act,
+                norm_layer=transformer_norm_layer, **dd)
+            for _ in range(transformer_depth)])
+        self.norm = transformer_norm_layer(transformer_dim, rngs=rngs)
+        self.conv_proj = layers.conv_norm_act(
+            transformer_dim, out_chs, kernel_size=1, stride=1, apply_act=False, **dd)
+        self.patch_size = to_2tuple(patch_size)
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        ph, pw = self.patch_size
+        new_h, new_w = math.ceil(H / ph) * ph, math.ceil(W / pw) * pw
+        nh, nw = new_h // ph, new_w // pw
+        if new_h != H or new_w != W:
+            x = _bilinear_resize(x, new_h, new_w, align_corners=True)
+
+        x = self.conv_kxk(x)
+        x = self.conv_1x1(x)
+
+        # unfold to (B, P, N, C)
+        C = x.shape[-1]
+        x = x.reshape(B, nh, ph, nw, pw, C).transpose(0, 2, 4, 1, 3, 5)
+        x = x.reshape(B, ph * pw, nh * nw, C)
+        for blk in self.transformer:
+            x = blk(x)
+        x = self.norm(x)
+        # fold back
+        x = x.reshape(B, ph, pw, nh, nw, C).transpose(0, 3, 1, 4, 2, 5)
+        x = x.reshape(B, new_h, new_w, C)
+
+        return self.conv_proj(x)
+
+
+register_block('mobilevit', MobileVitBlock)
+register_block('mobilevit2', MobileVitV2Block)
+
+
+def _create_mobilevit(variant, cfg_variant=None, pretrained=False, **kwargs):
+    return build_model_with_cfg(
+        ByobNet, variant, pretrained,
+        model_cfg=model_cfgs[variant] if not cfg_variant else model_cfgs[cfg_variant],
+        feature_cfg=dict(flatten_sequential=True),
+        **kwargs)
+
+
+def _cfg(url: str = '', **kwargs):
+    return {
+        'url': url, 'num_classes': 1000, 'input_size': (3, 256, 256), 'pool_size': (8, 8),
+        'crop_pct': 0.9, 'interpolation': 'bicubic',
+        'mean': (0., 0., 0.), 'std': (1., 1., 1.),
+        'first_conv': 'stem.conv', 'classifier': 'head.fc',
+        'fixed_input_size': False, 'license': 'cvnets-license',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'mobilevit_xxs.cvnets_in1k': _cfg(),
+    'mobilevit_xs.cvnets_in1k': _cfg(),
+    'mobilevit_s.cvnets_in1k': _cfg(),
+    'mobilevitv2_050.cvnets_in1k': _cfg(crop_pct=0.888),
+    'mobilevitv2_075.cvnets_in1k': _cfg(crop_pct=0.888),
+    'mobilevitv2_100.cvnets_in1k': _cfg(crop_pct=0.888),
+    'mobilevitv2_125.cvnets_in1k': _cfg(crop_pct=0.888),
+    'mobilevitv2_150.cvnets_in1k': _cfg(crop_pct=0.888),
+    'mobilevitv2_175.cvnets_in1k': _cfg(crop_pct=0.888),
+    'mobilevitv2_200.cvnets_in1k': _cfg(crop_pct=0.888),
+    'mobilevitv2_150.cvnets_in22k_ft_in1k': _cfg(crop_pct=0.888),
+    'mobilevitv2_175.cvnets_in22k_ft_in1k': _cfg(crop_pct=0.888),
+    'mobilevitv2_200.cvnets_in22k_ft_in1k': _cfg(crop_pct=0.888),
+    'mobilevitv2_150.cvnets_in22k_ft_in1k_384': _cfg(input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+    'mobilevitv2_175.cvnets_in22k_ft_in1k_384': _cfg(input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+    'mobilevitv2_200.cvnets_in22k_ft_in1k_384': _cfg(input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0),
+})
+
+
+@register_model
+def mobilevit_xxs(pretrained=False, **kwargs) -> ByobNet:
+    return _create_mobilevit('mobilevit_xxs', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilevit_xs(pretrained=False, **kwargs) -> ByobNet:
+    return _create_mobilevit('mobilevit_xs', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilevit_s(pretrained=False, **kwargs) -> ByobNet:
+    return _create_mobilevit('mobilevit_s', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilevitv2_050(pretrained=False, **kwargs) -> ByobNet:
+    return _create_mobilevit('mobilevitv2_050', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilevitv2_075(pretrained=False, **kwargs) -> ByobNet:
+    return _create_mobilevit('mobilevitv2_075', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilevitv2_100(pretrained=False, **kwargs) -> ByobNet:
+    return _create_mobilevit('mobilevitv2_100', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilevitv2_125(pretrained=False, **kwargs) -> ByobNet:
+    return _create_mobilevit('mobilevitv2_125', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilevitv2_150(pretrained=False, **kwargs) -> ByobNet:
+    return _create_mobilevit('mobilevitv2_150', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilevitv2_175(pretrained=False, **kwargs) -> ByobNet:
+    return _create_mobilevit('mobilevitv2_175', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilevitv2_200(pretrained=False, **kwargs) -> ByobNet:
+    return _create_mobilevit('mobilevitv2_200', pretrained=pretrained, **kwargs)

@@ -67,7 +67,7 @@ FAMILY_BACKWARD_MODELS = [
     'beit_base_patch16_224', 'cait_xxs24_224', 'xcit_nano_12_p16_224',
     'levit_128s', 'volo_d1_224', 'mvitv2_tiny', 'swin_tiny_patch4_window7_224', 'edgenext_xx_small',
     'repvit_m0_9', 'tiny_vit_5m_224', 'efficientformer_l1', 'efficientformerv2_s0',
-    'mobilevit_xxs', 'mobilevitv2_050',
+    'mobilevit_xxs', 'mobilevitv2_050', 'twins_svt_small',
     'swinv2_tiny_window8_256', 'coatnet_pico_rw_224', 'maxvit_pico_rw_256',
     'mixer_s32_224', 'convnext_atto', 'resnet18', 'resnetv2_50', 'nf_resnet50',
     'regnetx_002', 'vgg11', 'densenet121', 'efficientnet_lite0',

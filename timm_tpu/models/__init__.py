@@ -48,6 +48,7 @@ from .resnetv2 import ResNetV2
 from .swin_transformer import SwinTransformer
 from .tiny_vit import TinyVit
 from .swin_transformer_v2 import SwinTransformerV2
+from .twins import Twins
 from .vgg import VGG
 from .volo import VOLO
 from .xcit import Xcit

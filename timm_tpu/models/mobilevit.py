@@ -14,8 +14,6 @@ non-divisible resize path uses statically-built bilinear weight matrices
 """
 import math
 from functools import partial
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np

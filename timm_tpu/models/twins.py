@@ -1,0 +1,455 @@
+"""Twins — spatially separable attention ViTs, PCPVT + SVT (NHWC / nnx).
+
+Re-implements reference timm/models/twins.py:1-630 (Twins): a four-stage
+pyramid with per-stage patch embeds, conditional position encoding (PEG conv
+after the first block of each stage), and blocks alternating locally-grouped
+window attention (LSA) with global sub-sampled attention (GSA, keys/values
+from an sr-strided conv summary).
+
+TPU notes: tokens carry their (H, W) size as static Python ints so every
+window partition / sr-conv reshape is a static reshape-transpose; LSA runs as
+one batched matmul over (B x windows) and GSA's kv summary is a strided conv
+on the MXU. PEG is a 3x3 depthwise conv on the NHWC token grid.
+"""
+import math
+from functools import partial
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from timm_tpu.data.constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+from ..layers import (
+    Dropout, DropPath, LayerNorm, Mlp, calculate_drop_path_rates, to_2tuple,
+    trunc_normal_, zeros_,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['Twins']
+
+Size_ = Tuple[int, int]
+
+
+def _linear(in_f, out_f, bias=True, *, dtype, param_dtype, rngs):
+    return nnx.Linear(in_f, out_f, use_bias=bias, kernel_init=trunc_normal_(std=0.02),
+                      bias_init=zeros_, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+
+def _conv(in_c, out_c, k, s, p=0, groups=1, *, dtype, param_dtype, rngs):
+    # torch reference init (twins.py:449-451): plain normal, std=sqrt(2/fan_out)
+    # with fan_out divided by groups — flax's variance_scaling would compute
+    # fan_out from the full kernel and under-scale depthwise (PEG) convs
+    fan_out = (k * k * out_c) // groups
+    kernel_init = jax.nn.initializers.normal(stddev=math.sqrt(2.0 / fan_out))
+    return nnx.Conv(
+        in_c, out_c, kernel_size=(k, k), strides=s, padding=[(p, p), (p, p)],
+        feature_group_count=groups, use_bias=True,
+        kernel_init=kernel_init, bias_init=zeros_,
+        dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+
+class LocallyGroupedAttn(nnx.Module):
+    """LSA: self-attention within ws x ws windows (reference twins.py:36-106)."""
+
+    def __init__(self, dim, num_heads=8, attn_drop=0., proj_drop=0., ws=1,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        assert ws != 1 and dim % num_heads == 0
+        self.dim = dim
+        self.num_heads = num_heads
+        self.scale = (dim // num_heads) ** -0.5
+        self.ws = ws
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.qkv = _linear(dim, dim * 3, **kw)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = _linear(dim, dim, **kw)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x, size: Size_):
+        B, N, C = x.shape
+        H, W = size
+        ws = self.ws
+        x = x.reshape(B, H, W, C)
+        pad_r = (ws - W % ws) % ws
+        pad_b = (ws - H % ws) % ws
+        if pad_r or pad_b:
+            x = jnp.pad(x, ((0, 0), (0, pad_b), (0, pad_r), (0, 0)))
+        Hp, Wp = H + pad_b, W + pad_r
+        _h, _w = Hp // ws, Wp // ws
+        x = x.reshape(B, _h, ws, _w, ws, C).transpose(0, 1, 3, 2, 4, 5)  # (B,_h,_w,ws,ws,C)
+        qkv = self.qkv(x).reshape(B, _h * _w, ws * ws, 3, self.num_heads, C // self.num_heads)
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]  # (B,G,P,nh,hd)
+        attn = jnp.einsum('bgnhd,bgmhd->bghnm', q, k) * self.scale
+        attn = self.attn_drop(jax.nn.softmax(attn, axis=-1))
+        x = jnp.einsum('bghnm,bgmhd->bgnhd', attn, v).reshape(B, _h, _w, ws, ws, C)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, Hp, Wp, C)
+        if pad_r or pad_b:
+            x = x[:, :H, :W]
+        x = self.proj(x.reshape(B, N, C))
+        return self.proj_drop(x)
+
+
+class GlobalSubSampleAttn(nnx.Module):
+    """GSA: queries over all tokens, keys/values from an sr-strided conv
+    summary (reference twins.py:145-210)."""
+
+    def __init__(self, dim, num_heads=8, attn_drop=0., proj_drop=0., sr_ratio=1,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        assert dim % num_heads == 0
+        self.dim = dim
+        self.num_heads = num_heads
+        self.scale = (dim // num_heads) ** -0.5
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.q = _linear(dim, dim, **kw)
+        self.kv = _linear(dim, dim * 2, **kw)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = _linear(dim, dim, **kw)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+        self.sr_ratio = sr_ratio
+        if sr_ratio > 1:
+            self.sr = _conv(dim, dim, sr_ratio, sr_ratio, **kw)
+            self.norm = LayerNorm(dim, eps=1e-5, rngs=rngs)  # plain nn.LayerNorm in reference
+        else:
+            self.sr = None
+            self.norm = None
+
+    def __call__(self, x, size: Size_):
+        B, N, C = x.shape
+        hd = C // self.num_heads
+        q = self.q(x).reshape(B, N, self.num_heads, hd)
+        if self.sr is not None:
+            x = self.sr(x.reshape(B, *size, C)).reshape(B, -1, C)
+            x = self.norm(x)
+        kv = self.kv(x).reshape(B, -1, 2, self.num_heads, hd)
+        k, v = kv[:, :, 0], kv[:, :, 1]
+        attn = jnp.einsum('bnhd,bmhd->bhnm', q, k) * self.scale
+        attn = self.attn_drop(jax.nn.softmax(attn, axis=-1))
+        x = jnp.einsum('bhnm,bmhd->bnhd', attn, v).reshape(B, N, C)
+        return self.proj_drop(self.proj(x))
+
+
+class TwinsBlock(nnx.Module):
+    """Pre-norm block with LSA/GSA mixer (reference twins.py:212-262)."""
+
+    def __init__(self, dim, num_heads, mlp_ratio=4., proj_drop=0., attn_drop=0.,
+                 drop_path=0., act_layer='gelu', norm_layer=None, sr_ratio=1, ws=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        norm_layer = norm_layer or partial(LayerNorm, eps=1e-6)
+        self.norm1 = norm_layer(dim, rngs=rngs)
+        assert ws is not None, 'Twins entrypoints always set ws (1 = GSA)'
+        if ws == 1:
+            self.attn = GlobalSubSampleAttn(dim, num_heads, attn_drop, proj_drop, sr_ratio, **kw)
+        else:
+            self.attn = LocallyGroupedAttn(dim, num_heads, attn_drop, proj_drop, ws, **kw)
+        self.drop_path1 = DropPath(drop_path, rngs=rngs) if drop_path > 0. else None
+        self.norm2 = norm_layer(dim, rngs=rngs)
+        self.mlp = Mlp(dim, int(dim * mlp_ratio), act_layer=act_layer, drop=proj_drop, **kw)
+        self.drop_path2 = DropPath(drop_path, rngs=rngs) if drop_path > 0. else None
+
+    def __call__(self, x, size: Size_):
+        y = self.attn(self.norm1(x), size)
+        x = x + (self.drop_path1(y) if self.drop_path1 is not None else y)
+        y = self.mlp(self.norm2(x))
+        return x + (self.drop_path2(y) if self.drop_path2 is not None else y)
+
+
+class PosConv(nnx.Module):
+    """PEG conditional position encoding: 3x3 dw conv over the token grid,
+    residual at stride 1 (reference twins.py:265-292)."""
+
+    def __init__(self, in_chans, embed_dim=768, stride=1,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        # single conv wrapped in a list to mirror the torch nn.Sequential key (proj.0)
+        self.proj = nnx.List([
+            _conv(in_chans, embed_dim, 3, stride, 1, groups=embed_dim,
+                  dtype=dtype, param_dtype=param_dtype, rngs=rngs)])
+        self.stride = stride
+
+    def __call__(self, x, size: Size_):
+        B, N, C = x.shape
+        feat = x.reshape(B, *size, C)
+        out = self.proj[0](feat)
+        if self.stride == 1:
+            out = out + feat
+        return out.reshape(B, N, C)
+
+
+class TwinsPatchEmbed(nnx.Module):
+    """Per-stage conv patch embed + LN (reference twins.py:295-332)."""
+
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        img_size = to_2tuple(img_size)
+        patch_size = to_2tuple(patch_size)
+        assert img_size[0] % patch_size[0] == 0 and img_size[1] % patch_size[1] == 0
+        self.img_size = img_size
+        self.patch_size = patch_size
+        self.H, self.W = img_size[0] // patch_size[0], img_size[1] // patch_size[1]
+        self.num_patches = self.H * self.W
+        fan_out = patch_size[0] * patch_size[1] * embed_dim
+        self.proj = nnx.Conv(
+            in_chans, embed_dim, kernel_size=patch_size, strides=patch_size, padding='VALID',
+            kernel_init=jax.nn.initializers.normal(stddev=math.sqrt(2.0 / fan_out)),
+            bias_init=zeros_, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.norm = LayerNorm(embed_dim, eps=1e-5, rngs=rngs)  # plain nn.LayerNorm
+
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        x = self.proj(x)
+        out_size = (H // self.patch_size[0], W // self.patch_size[1])
+        x = x.reshape(B, -1, x.shape[-1])
+        return self.norm(x), out_size
+
+
+class Twins(nnx.Module):
+    """Twins PCPVT / SVT (reference twins.py:335-549)."""
+
+    def __init__(
+            self,
+            img_size: Union[int, Tuple[int, int]] = 224,
+            patch_size: int = 4,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            embed_dims: Tuple[int, ...] = (64, 128, 256, 512),
+            num_heads: Tuple[int, ...] = (1, 2, 4, 8),
+            mlp_ratios: Tuple[float, ...] = (4, 4, 4, 4),
+            depths: Tuple[int, ...] = (3, 4, 6, 3),
+            sr_ratios: Tuple[int, ...] = (8, 4, 2, 1),
+            wss: Optional[Tuple[int, ...]] = None,
+            drop_rate: float = 0.,
+            pos_drop_rate: float = 0.,
+            proj_drop_rate: float = 0.,
+            attn_drop_rate: float = 0.,
+            drop_path_rate: float = 0.,
+            norm_layer=partial(LayerNorm, eps=1e-6),
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: Optional[nnx.Rngs] = None,
+    ):
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.depths = depths
+        self.embed_dims = embed_dims
+        self.num_features = self.head_hidden_size = embed_dims[-1]
+        self._dd = dict(dtype=dtype, param_dtype=param_dtype)
+
+        img_size = to_2tuple(img_size)
+        prev_chs = in_chans
+        patch_embeds = []
+        pos_drops = []
+        ps = patch_size
+        for i in range(len(depths)):
+            patch_embeds.append(TwinsPatchEmbed(img_size, ps, prev_chs, embed_dims[i], **kw))
+            pos_drops.append(Dropout(pos_drop_rate, rngs=rngs))
+            prev_chs = embed_dims[i]
+            img_size = tuple(t // ps for t in img_size)
+            ps = 2
+        self.patch_embeds = nnx.List(patch_embeds)
+        self.pos_drops = nnx.List(pos_drops)
+
+        blocks = []
+        self.feature_info = []
+        dpr = calculate_drop_path_rates(drop_path_rate, sum(depths))
+        cur = 0
+        for k in range(len(depths)):
+            stage_blocks = nnx.List([
+                TwinsBlock(
+                    dim=embed_dims[k], num_heads=num_heads[k], mlp_ratio=mlp_ratios[k],
+                    proj_drop=proj_drop_rate, attn_drop=attn_drop_rate,
+                    drop_path=dpr[cur + i], norm_layer=norm_layer, sr_ratio=sr_ratios[k],
+                    ws=1 if wss is None or i % 2 == 1 else wss[k], **kw)
+                for i in range(depths[k])])
+            blocks.append(stage_blocks)
+            self.feature_info += [dict(module=f'block.{k}', num_chs=embed_dims[k], reduction=2 ** (2 + k))]
+            cur += depths[k]
+        self.blocks = nnx.List(blocks)
+
+        self.pos_block = nnx.List([
+            PosConv(embed_dim, embed_dim, **kw) for embed_dim in embed_dims])
+        self.norm = norm_layer(self.num_features, rngs=rngs)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.head = _linear(self.num_features, num_classes, **kw) if num_classes > 0 else None
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'pos_block'}
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^patch_embeds.0',
+            blocks=[
+                (r'^(?:blocks|patch_embeds|pos_block)\.(\d+)', None),
+                (r'^norm', (99999,)),
+            ] if coarse else [
+                (r'^blocks\.(\d+)\.(\d+)', None),
+                (r'^(?:patch_embeds|pos_block)\.(\d+)', (0,)),
+                (r'^norm', (99999,)),
+            ])
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        assert not enable, 'gradient checkpointing not supported'
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            assert global_pool in ('', 'avg')
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = _linear(self.num_features, num_classes, rngs=rngs, **self._dd) \
+            if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def _stage(self, x, i):
+        """One stage: embed → blocks (PEG after block 0) → back to NHWC map."""
+        B = x.shape[0]
+        x, size = self.patch_embeds[i](x)
+        x = self.pos_drops[i](x)
+        for j, blk in enumerate(self.blocks[i]):
+            x = blk(x, size)
+            if j == 0:
+                x = self.pos_block[i](x, size)
+        if i < len(self.depths) - 1:
+            x = x.reshape(B, *size, -1)
+        return x, size
+
+    def forward_features(self, x):
+        for i in range(len(self.depths)):
+            x, _ = self._stage(x, i)
+        return self.norm(x) if self.norm is not None else x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        if self.global_pool == 'avg':
+            x = x.mean(axis=1)
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(self, x, indices=None, norm: bool = False,
+                              stop_early: bool = False, output_fmt: str = 'NHWC',
+                              intermediates_only: bool = False):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        intermediates = []
+        B = x.shape[0]
+        last = len(self.depths) - 1
+        for i in range(len(self.depths)):
+            x, size = self._stage(x, i)
+            if i in take_indices:
+                if i == last:
+                    feat = self.norm(x) if norm and self.norm is not None else x
+                    intermediates.append(feat.reshape(B, *size, -1))
+                else:
+                    intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        x = self.norm(x) if self.norm is not None else x
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, _ = feature_take_indices(len(self.blocks), indices)
+        if prune_norm:
+            self.norm = None
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    if 'model' in state_dict:
+        state_dict = state_dict['model']
+    return convert_torch_state_dict(state_dict, model)
+
+
+def _create_twins(variant, pretrained=False, **kwargs):
+    out_indices = kwargs.pop('out_indices', 4)
+    return build_model_with_cfg(
+        Twins, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices, feature_cls='getter'),
+        **kwargs,
+    )
+
+
+def _cfg(url: str = '', **kwargs):
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': None,
+        'crop_pct': .9, 'interpolation': 'bicubic', 'fixed_input_size': True,
+        'mean': IMAGENET_DEFAULT_MEAN, 'std': IMAGENET_DEFAULT_STD,
+        'first_conv': 'patch_embeds.0.proj', 'classifier': 'head',
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'twins_pcpvt_small.in1k': _cfg(),
+    'twins_pcpvt_base.in1k': _cfg(),
+    'twins_pcpvt_large.in1k': _cfg(),
+    'twins_svt_small.in1k': _cfg(),
+    'twins_svt_base.in1k': _cfg(),
+    'twins_svt_large.in1k': _cfg(),
+})
+
+
+@register_model
+def twins_pcpvt_small(pretrained=False, **kwargs) -> Twins:
+    model_args = dict(
+        patch_size=4, embed_dims=(64, 128, 320, 512), num_heads=(1, 2, 5, 8), mlp_ratios=(8, 8, 4, 4),
+        depths=(3, 4, 6, 3), sr_ratios=(8, 4, 2, 1))
+    return _create_twins('twins_pcpvt_small', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def twins_pcpvt_base(pretrained=False, **kwargs) -> Twins:
+    model_args = dict(
+        patch_size=4, embed_dims=(64, 128, 320, 512), num_heads=(1, 2, 5, 8), mlp_ratios=(8, 8, 4, 4),
+        depths=(3, 4, 18, 3), sr_ratios=(8, 4, 2, 1))
+    return _create_twins('twins_pcpvt_base', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def twins_pcpvt_large(pretrained=False, **kwargs) -> Twins:
+    model_args = dict(
+        patch_size=4, embed_dims=(64, 128, 320, 512), num_heads=(1, 2, 5, 8), mlp_ratios=(8, 8, 4, 4),
+        depths=(3, 8, 27, 3), sr_ratios=(8, 4, 2, 1))
+    return _create_twins('twins_pcpvt_large', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def twins_svt_small(pretrained=False, **kwargs) -> Twins:
+    model_args = dict(
+        patch_size=4, embed_dims=(64, 128, 256, 512), num_heads=(2, 4, 8, 16), mlp_ratios=(4, 4, 4, 4),
+        depths=(2, 2, 10, 4), wss=(7, 7, 7, 7), sr_ratios=(8, 4, 2, 1))
+    return _create_twins('twins_svt_small', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def twins_svt_base(pretrained=False, **kwargs) -> Twins:
+    model_args = dict(
+        patch_size=4, embed_dims=(96, 192, 384, 768), num_heads=(3, 6, 12, 24), mlp_ratios=(4, 4, 4, 4),
+        depths=(2, 2, 18, 2), wss=(7, 7, 7, 7), sr_ratios=(8, 4, 2, 1))
+    return _create_twins('twins_svt_base', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def twins_svt_large(pretrained=False, **kwargs) -> Twins:
+    model_args = dict(
+        patch_size=4, embed_dims=(128, 256, 512, 1024), num_heads=(4, 8, 16, 32), mlp_ratios=(4, 4, 4, 4),
+        depths=(2, 2, 18, 2), wss=(7, 7, 7, 7), sr_ratios=(8, 4, 2, 1))
+    return _create_twins('twins_svt_large', pretrained=pretrained, **dict(model_args, **kwargs))

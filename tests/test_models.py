@@ -67,7 +67,7 @@ FAMILY_BACKWARD_MODELS = [
     'beit_base_patch16_224', 'cait_xxs24_224', 'xcit_nano_12_p16_224',
     'levit_128s', 'volo_d1_224', 'mvitv2_tiny', 'swin_tiny_patch4_window7_224', 'edgenext_xx_small',
     'repvit_m0_9', 'tiny_vit_5m_224', 'efficientformer_l1', 'efficientformerv2_s0',
-    'mobilevit_xxs', 'mobilevitv2_050', 'twins_svt_small',
+    'mobilevit_xxs', 'mobilevitv2_050', 'twins_svt_small', 'mambaout_femto',
     'swinv2_tiny_window8_256', 'coatnet_pico_rw_224', 'maxvit_pico_rw_256',
     'mixer_s32_224', 'convnext_atto', 'resnet18', 'resnetv2_50', 'nf_resnet50',
     'regnetx_002', 'vgg11', 'densenet121', 'efficientnet_lite0',
@@ -155,7 +155,10 @@ def test_model_classifier_reset(model_name):
     # pre-logits / identity head
     model.reset_classifier(0)
     out = model(x)
-    assert out.ndim == 2 and out.shape[-1] == model.num_features
+    # heads with a pre-logits MLP keep it on reset (reference ClNormMlpClassifierHead
+    # semantics: reset() without reset_other preserves hidden layers)
+    want = {model.num_features, getattr(model, 'head_hidden_size', model.num_features)}
+    assert out.ndim == 2 and out.shape[-1] in want
     # new head size
     model.reset_classifier(7)
     assert model(x).shape == (1, 7)

@@ -1,6 +1,6 @@
 from .attention import Attention, AttentionRope, maybe_add_mask, scaled_dot_product_attention
 from .attention_pool import AttentionPool2d, AttentionPoolLatent, RotAttentionPool2d
-from .classifier import ClassifierHead, NormMlpClassifierHead, create_classifier
+from .classifier import ClNormMlpClassifierHead, ClassifierHead, NormMlpClassifierHead, create_classifier
 from .config import (
     is_exportable, is_scriptable, set_exportable, set_scriptable,
     set_fused_attn, use_fused_attn,

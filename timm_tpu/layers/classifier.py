@@ -13,7 +13,7 @@ from .norm import LayerNorm
 from .pool import SelectAdaptivePool2d
 from .weight_init import trunc_normal_, zeros_
 
-__all__ = ['ClassifierHead', 'NormMlpClassifierHead', 'create_classifier']
+__all__ = ['ClNormMlpClassifierHead', 'ClassifierHead', 'NormMlpClassifierHead', 'create_classifier']
 
 
 def create_classifier(
@@ -136,6 +136,93 @@ class NormMlpClassifierHead(nnx.Module):
         x = self.norm(x)
         if self.pre_logits_fc is not None:
             x = self.pre_logits_act(self.pre_logits_fc(x))
+        x = self.drop(x)
+        if pre_logits or self.fc is None:
+            return x
+        return self.fc(x)
+
+
+class _FcAct(nnx.Module):
+    """fc + act pre-logits submodule (keys: pre_logits.fc / pre_logits.act)."""
+
+    def __init__(self, in_features, hidden_size, act_layer='gelu',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.fc = nnx.Linear(
+            in_features, hidden_size, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.act = get_act_fn(act_layer)
+
+    def __call__(self, x):
+        return self.act(self.fc(x))
+
+
+class ClNormMlpClassifierHead(nnx.Module):
+    """Pool → norm → (fc+act) → drop → fc for channels-last tensors
+    (reference classifier.py:223-300 ClNormMlpClassifierHead)."""
+
+    def __init__(
+            self,
+            in_features: int,
+            num_classes: int,
+            hidden_size: Optional[int] = None,
+            pool_type: str = 'avg',
+            drop_rate: float = 0.0,
+            norm_layer: Union[str, Callable] = LayerNorm,
+            act_layer: Union[str, Callable] = 'gelu',
+            input_fmt: str = 'NHWC',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert pool_type in ('', 'avg', 'max', 'avgmax')
+        assert input_fmt in ('NHWC', 'NLC')
+        self.in_features = in_features
+        self.hidden_size = hidden_size
+        self.num_features = hidden_size or in_features
+        self.num_classes = num_classes
+        self.pool_type = pool_type
+        self.pool_dim = (1,) if input_fmt == 'NLC' else (1, 2)
+        self._dd = dict(dtype=dtype, param_dtype=param_dtype)
+
+        self.norm = norm_layer(in_features, rngs=rngs)
+        self.pre_logits = _FcAct(in_features, hidden_size, act_layer,
+                                 dtype=dtype, param_dtype=param_dtype, rngs=rngs) if hidden_size else None
+        self.drop = Dropout(drop_rate, rngs=rngs)
+        self.fc = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    def reset(self, num_classes: int, pool_type: Optional[str] = None,
+              reset_other: bool = False, *, rngs: Optional[nnx.Rngs] = None):
+        self.num_classes = num_classes
+        if pool_type is not None:
+            self.pool_type = pool_type
+        if reset_other:
+            self.pre_logits = None
+            self.norm = None
+            self.num_features = self.in_features
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.fc = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            rngs=rngs, **self._dd) if num_classes > 0 else None
+
+    def _global_pool(self, x):
+        if self.pool_type:
+            if self.pool_type == 'avg':
+                x = x.mean(axis=self.pool_dim)
+            elif self.pool_type == 'max':
+                x = x.max(axis=self.pool_dim)
+            elif self.pool_type == 'avgmax':
+                x = 0.5 * (x.mean(axis=self.pool_dim) + x.max(axis=self.pool_dim))
+        return x
+
+    def __call__(self, x, pre_logits: bool = False):
+        x = self._global_pool(x)
+        if self.norm is not None:
+            x = self.norm(x)
+        if self.pre_logits is not None:
+            x = self.pre_logits(x)
         x = self.drop(x)
         if pre_logits or self.fc is None:
             return x

@@ -29,6 +29,7 @@ from .eva import Eva
 from .ghostnet import GhostNet
 from .inception_v3 import InceptionV3
 from .levit import Levit, LevitDistilled
+from .mambaout import MambaOut
 from .maxxvit import MaxxVit, MaxxVitCfg
 from .metaformer import MetaFormer
 from .mlp_mixer import MlpMixer

@@ -1,6 +1,6 @@
 #!/usr/bin/env python3
-"""Driver benchmark: prints ONE JSON line
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+"""Driver benchmark: prints JSON status lines; the LAST line is always a valid
+result `{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}`.
 
 Headline: ViT-B/16 @224 train-step throughput (img/s/chip), bf16, batch 128
 per chip, AdamW — vs the reference's published train throughput for the same
@@ -11,20 +11,24 @@ params/opt-state), so the measurement is pure device time — host dispatch and
 transfer latency (large through the axon relay) is excluded, matching how the
 reference's CUDA-event timing excludes host overhead (benchmark.py:149-157).
 
-Relay-wedge hardening (rounds 1+2 both recorded 0.0 because a wedged tile
-lease made every device op hang): the parent process never touches the device.
-It probes in throwaway subprocesses with growing cooldowns (~10.5 min budget),
-then runs the real measurement in a fresh subprocess (twice if needed) under a
-hard timeout — a fresh process can succeed where a stale probe process wedged.
+Driver-window contract (the round-4 failure was rc=124 with an EMPTY tail —
+the old layout printed its one JSON line only at the very end of a worst-case
+~40-minute run):
+  * A status JSON line is printed IMMEDIATELY at process start, then replaced
+    at every phase boundary and every ~25s while the measurement child runs.
+    Whenever the driver kills this process, the tail is a parseable JSON line
+    saying exactly which phase was reached.
+  * Total wall-clock is capped at BENCH_TOTAL_BUDGET seconds (default 420,
+    i.e. 7 minutes): one short probe, then the measurement child gets whatever
+    budget remains.
 
-Fallback policy: ONLY when the device is provably unreachable (all probes
-failed AND the fresh-process attempts failed) does it replay the most recent
+Fallback policy: ONLY when the device is provably unreachable (probe failed
+AND the fresh-process bench attempt failed) does it replay the most recent
 self-measured result from BENCH_SELF.json — clearly labelled with
 `replay: true`, the original measurement timestamp, and a NONZERO exit code so
-automated consumers can distinguish it from a live measurement. If probes
-succeed but the bench child fails, that is a genuine code regression: it
-reports value 0.0, a nonzero exit code, and the child's stderr tail — never a
-stale number.
+automated consumers can distinguish it from a live measurement. If the probe
+succeeds but the bench child fails, that is a genuine code regression: it
+reports value 0.0 and a nonzero exit code — never a stale number.
 """
 from __future__ import annotations
 
@@ -52,7 +56,23 @@ CHIP_PEAK = {'v5e': 197e12, 'v5litepod': 197e12, 'v4': 275e12, 'v5p': 459e12, 'v
 
 SELF_RESULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'BENCH_SELF.json')
 
+TOTAL_BUDGET = int(os.environ.get('BENCH_TOTAL_BUDGET', '420'))
+
+_START = time.time()
 _WATCHDOG = None
+
+
+def _status(phase: str, **extra):
+    """Print a status line that is ALSO a valid result schema, so that if the
+    driver kills us right now its recorded tail still parses."""
+    d = {'metric': f'bench status: {phase} (t+{time.time() - _START:.0f}s)',
+         'value': 0.0, 'unit': 'img/s/chip', 'vs_baseline': None}
+    d.update(extra)
+    print(json.dumps(d), flush=True)
+
+
+def _remaining() -> float:
+    return TOTAL_BUDGET - (time.time() - _START)
 
 
 def _arm_watchdog(seconds: int):
@@ -74,7 +94,7 @@ def _arm_watchdog(seconds: int):
     _WATCHDOG.start()
 
 
-def _probe_device(timeout_s: int = 120) -> bool:
+def _probe_device(timeout_s: int) -> bool:
     """Run a tiny device op in a SUBPROCESS so a wedged relay can't hang us."""
     code = (
         'import jax, jax.numpy as jnp\n'
@@ -89,30 +109,9 @@ def _probe_device(timeout_s: int = 120) -> bool:
         return False
 
 
-def _probe_with_backoff(total_budget_s: int = 630) -> bool:
-    """Up to 6 probe attempts with linearly growing cooldowns, all bounded by
-    total_budget_s (default 630s ≈ 10.5 min worst case: cooldowns and probe
-    timeouts are both shrunk to fit the remaining budget).
-    Returns True as soon as one succeeds."""
-    cooldowns = [0, 30, 60, 90, 120, 150]
-    start = time.time()
-    for cd in cooldowns:
-        remaining = total_budget_s - (time.time() - start)
-        if remaining <= 0:
-            break
-        if cd:
-            time.sleep(min(cd, remaining))
-        remaining = total_budget_s - (time.time() - start)
-        if remaining <= 0:
-            break
-        if _probe_device(timeout_s=int(min(120, max(30, remaining)))):
-            return True
-    return False
-
-
 def _replay_self_result(reason: str) -> int:
     """Last-resort fallback, used ONLY when the device is provably unreachable
-    (all probes failed): replay the most recent self-measured result committed
+    (probe failed): replay the most recent self-measured result committed
     during the round. The output is explicitly labelled (`replay: true`,
     original timestamp in `measured_at`) and the exit code is nonzero (3) so
     automated consumers can tell it apart from a live driver-time measurement."""
@@ -136,22 +135,55 @@ def _replay_self_result(reason: str) -> int:
 
 
 def _run_child(args, timeout_s: int) -> dict | None:
-    """Run the actual measurement in a FRESH subprocess; return parsed JSON
-    result line or None on failure/timeout."""
+    """Run the actual measurement in a FRESH subprocess, polling it and
+    printing a heartbeat status every ~25s; return the parsed JSON result line
+    or None on failure/timeout.
+
+    Child stdout/stderr go to temp FILES, not pipes: a pipe would fill at
+    ~64KB of JAX/TPU-runtime warnings and deadlock the un-drained child."""
+    import tempfile
     cmd = [sys.executable, os.path.abspath(__file__), '--child',
            '--model', args.model, '--bench', args.bench,
-           '--img-size', str(args.img_size), '--steps', str(args.steps)]
+           '--img-size', str(args.img_size), '--steps', str(args.steps),
+           # child's wedge backstop = the budget WE enforce, plus a grace
+           # margin — so an orphaned child can't hold the TPU lease long
+           # after the driver kills this parent
+           '--watchdog-s', str(timeout_s + 30)]
     if args.batch_size:
         cmd += ['--batch-size', str(args.batch_size)]
+    t0 = time.time()
+    out_f = tempfile.NamedTemporaryFile('w+', suffix='.out', delete=False)
+    err_f = tempfile.NamedTemporaryFile('w+', suffix='.err', delete=False)
     try:
-        r = subprocess.run(cmd, timeout=timeout_s, capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        print(f'bench child timed out after {timeout_s}s', file=sys.stderr, flush=True)
-        return None
-    except Exception as e:
-        print(f'bench child failed to launch: {e!r}', file=sys.stderr, flush=True)
-        return None
-    for line in reversed((r.stdout or '').strip().splitlines()):
+        try:
+            proc = subprocess.Popen(cmd, stdout=out_f, stderr=err_f, text=True)
+        except Exception as e:
+            print(f'bench child failed to launch: {e!r}', file=sys.stderr, flush=True)
+            return None
+        last_beat = time.time()
+        while proc.poll() is None:
+            if time.time() - t0 > timeout_s:
+                proc.kill()
+                proc.wait()
+                print(f'bench child timed out after {timeout_s}s', file=sys.stderr, flush=True)
+                _status('measurement child timed out; killed')
+                return None
+            if time.time() - last_beat > 25:
+                _status(f'measuring ({args.model} {args.bench}, child alive {time.time() - t0:.0f}s)')
+                last_beat = time.time()
+            time.sleep(1)
+        out_f.seek(0)
+        stdout = out_f.read()
+        err_f.seek(0)
+        stderr = err_f.read()
+    finally:
+        for f in (out_f, err_f):
+            try:
+                f.close()
+                os.unlink(f.name)
+            except Exception:
+                pass
+    for line in reversed((stdout or '').strip().splitlines()):
         try:
             d = json.loads(line)
             if isinstance(d, dict) and 'value' in d:
@@ -159,8 +191,8 @@ def _run_child(args, timeout_s: int) -> dict | None:
         except Exception:
             continue
     # no parseable result: surface the child's diagnostics to the driver log
-    tail = '\n'.join((r.stderr or '').strip().splitlines()[-15:])
-    print(f'bench child rc={r.returncode}, no result line; stderr tail:\n{tail}',
+    tail = '\n'.join((stderr or '').strip().splitlines()[-15:])
+    print(f'bench child rc={proc.returncode}, no result line; stderr tail:\n{tail}',
           file=sys.stderr, flush=True)
     return None
 
@@ -171,11 +203,13 @@ def main():
     parser.add_argument('--bench', default='train', choices=['train', 'infer'])
     parser.add_argument('--batch-size', type=int, default=None)
     parser.add_argument('--img-size', type=int, default=224)
-    parser.add_argument('--steps', type=int, default=20)
+    parser.add_argument('--steps', type=int, default=10)
     parser.add_argument('--fast', action='store_true', help='small model / few steps smoke mode')
     parser.add_argument('--no-probe', action='store_true')
     parser.add_argument('--child', action='store_true',
                         help='internal: run the measurement in this process')
+    parser.add_argument('--watchdog-s', type=int, default=None,
+                        help='internal: child wedge-backstop seconds (set by parent)')
     parser.add_argument('--save-self', action='store_true',
                         help='on success, record result to BENCH_SELF.json')
     args = parser.parse_args()
@@ -187,21 +221,26 @@ def main():
         raise SystemExit(_measure(args))
 
     # ---- parent orchestration: never touches the device itself ----
-    child_timeout = 480 + 12 * max(args.steps, 10) + 120
+    _status('started, probing TPU')
 
     probed_ok = True
     if not args.no_probe:
-        probed_ok = _probe_with_backoff()
+        # One short probe; its only purpose is to distinguish "unreachable
+        # relay" (replay is honest) from "code regression" (report 0.0).
+        probed_ok = _probe_device(timeout_s=int(min(75, max(30, _remaining() - 240))))
+        _status(f'probe {"succeeded" if probed_ok else "FAILED"}, launching measurement')
 
-    # Even if every probe failed, still attempt the real run (twice): the
-    # probe process itself may have wedged where a fresh process would not.
+    # Even if the probe failed, still attempt the real run: the probe process
+    # itself may have wedged where a fresh process would not. Retry with a
+    # fresh process as long as ≥60s of budget remains (a generous
+    # BENCH_TOTAL_BUDGET buys real retries; the default 420s usually fits one).
     result = None
-    for i in range(2):
-        result = _run_child(args, child_timeout)
+    attempts_made = 0
+    while _remaining() - 15 >= 60 and attempts_made < 3:
+        result = _run_child(args, int(_remaining() - 15))
+        attempts_made += 1
         if result is not None and result.get('value', 0) > 0:
             break
-        if i == 0:
-            time.sleep(60)
 
     if result is not None and result.get('value', 0) > 0:
         print(json.dumps(result), flush=True)
@@ -211,15 +250,21 @@ def main():
                            'result': result}, f, indent=1)
         raise SystemExit(0)
 
+    attempted = (f'{attempts_made} fresh-process bench attempt(s) failed'
+                 if attempts_made else 'no bench attempt fit the remaining budget')
     if not probed_ok:
         # Device provably unreachable: replay is honest here (and exits 3).
-        raise SystemExit(_replay_self_result(
-            'TPU unreachable: probes failed over ~10min backoff window and two '
-            'fresh-process bench attempts also failed'))
-    # Probes succeeded but the bench failed twice: a genuine regression.
+        raise SystemExit(_replay_self_result(f'TPU unreachable: probe failed and {attempted}'))
+    if not attempts_made:
+        print(json.dumps({
+            'metric': 'benchmark INCOMPLETE: probe succeeded but no bench attempt fit '
+                      f'the remaining budget (BENCH_TOTAL_BUDGET={TOTAL_BUDGET}s too small)',
+            'value': 0.0, 'unit': 'img/s/chip', 'vs_baseline': None}), flush=True)
+        raise SystemExit(2)
+    # Probe succeeded but the bench failed: a genuine regression.
     # Never mask it with a stale replay — report 0.0 and fail.
     print(json.dumps({
-        'metric': 'benchmark FAILED: bench subprocess failed/timed out twice despite a '
+        'metric': f'benchmark FAILED: {attempted} despite a '
                   'live device probe (likely code regression; see stderr)',
         'value': 0.0, 'unit': 'img/s/chip', 'vs_baseline': None}), flush=True)
     raise SystemExit(2)
@@ -227,8 +272,11 @@ def main():
 
 def _measure(args) -> int:
     """The actual device measurement (runs in the child process)."""
-    # budget: compile (+relay) headroom plus per-step margin for big fused runs
-    _arm_watchdog(480 + 12 * max(args.steps, 10))
+    # The parent enforces the real budget; this is a backstop so a wedged
+    # device op can't outlive the parent's kill by hanging in C++. The parent
+    # passes its enforced budget (+grace) via --watchdog-s; standalone --child
+    # runs fall back to the total budget.
+    _arm_watchdog(args.watchdog_s if args.watchdog_s else TOTAL_BUDGET)
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -46,8 +46,9 @@ from .split_attn import SplitAttn
 from .split_batchnorm import SplitBatchNorm2d, SplitBatchNormAct2d, convert_splitbn_model
 from .test_time_pool import TestTimePoolHead, apply_test_time_pool
 from .pos_embed_sincos import (
-    RotaryEmbeddingCat, build_fourier_pos_embed, build_rotary_pos_embed,
-    build_sincos2d_pos_embed, freq_bands, pixel_freq_bands,
+    RotaryEmbeddingCat, RotaryEmbeddingDinoV3, RotaryEmbeddingMixed,
+    build_fourier_pos_embed, build_rotary_pos_embed,
+    build_sincos2d_pos_embed, create_rope_embed, freq_bands, pixel_freq_bands,
 )
 from .squeeze_excite import EffectiveSEModule, SEModule, SqueezeExcite
 from .weight_init import lecun_normal_, ones_, trunc_normal_, trunc_normal_tf_, variance_scaling_, zeros_

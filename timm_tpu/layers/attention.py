@@ -32,13 +32,24 @@ def maybe_add_mask(scores, attn_mask=None):
     return scores + attn_mask
 
 
-def apply_rot_embed_cat(x, emb):
-    """Apply concatenated (sin, cos) rotary embedding to (..., N, D) tokens."""
+def apply_rot_embed_cat(x, emb, half: bool = False):
+    """Apply concatenated (sin, cos) rotary embedding to (..., N, D) tokens.
+
+    half=False: interleaved layout — sin/cos repeat per channel pair and the
+    rotation swaps within each pair ([-x1, x0, -x3, x2, ...]).
+    half=True: half layout (DINOv3 / LLaMA style) — sin/cos tile across the
+    two halves and the rotation swaps halves ([-x[D/2:], x[:D/2]]).
+    (reference pos_embed_sincos.py:281-297)
+    """
     sin_emb, cos_emb = jnp.split(emb, 2, axis=-1)
-    x1, x2 = jnp.split(x.reshape(*x.shape[:-1], -1, 2), 2, axis=-1)
-    x1 = x1[..., 0]
-    x2 = x2[..., 0]
-    rot = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+    if half:
+        xa, xb = jnp.split(x, 2, axis=-1)
+        rot = jnp.concatenate([-xb, xa], axis=-1)
+    else:
+        x1, x2 = jnp.split(x.reshape(*x.shape[:-1], -1, 2), 2, axis=-1)
+        x1 = x1[..., 0]
+        x2 = x2[..., 0]
+        rot = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
     return x * cos_emb + rot * sin_emb
 
 
@@ -153,23 +164,91 @@ class Attention(nnx.Module):
         return x
 
 
-class AttentionRope(Attention):
-    """MHSA accepting a rotary position embedding (reference attention.py:149+)."""
+class AttentionRope(nnx.Module):
+    """MHSA accepting a rotary position embedding, with fused or unfused qkv,
+    qk/scale norms, and interleaved or half rotation layout
+    (reference attention.py:148-290)."""
+
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int = 8,
+            dim_out: Optional[int] = None,
+            qkv_bias: bool = True,
+            qkv_fused: bool = True,
+            num_prefix_tokens: int = 1,
+            attn_drop: float = 0.0,
+            proj_drop: float = 0.0,
+            attn_head_dim: Optional[int] = None,
+            norm_layer: Optional[Callable] = None,
+            qk_norm: bool = False,
+            scale_norm: bool = False,
+            proj_bias: bool = True,
+            rotate_half: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        dim_out = dim_out or dim
+        head_dim = attn_head_dim
+        if head_dim is None:
+            assert dim % num_heads == 0, 'dim should be divisible by num_heads'
+            head_dim = dim // num_heads
+        if scale_norm or qk_norm:
+            assert norm_layer is not None, 'norm_layer must be provided if qk_norm or scale_norm is True'
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.attn_dim = head_dim * num_heads
+        self.scale = head_dim ** -0.5
+        self.num_prefix_tokens = num_prefix_tokens
+        self.rotate_half = rotate_half
+        self.attn_drop_rate = attn_drop
+
+        linear = partial(
+            nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs,
+        )
+        if qkv_fused:
+            self.qkv = linear(dim, self.attn_dim * 3, use_bias=qkv_bias)
+            self.q_proj = self.k_proj = self.v_proj = None
+        else:
+            self.qkv = None
+            self.q_proj = linear(dim, self.attn_dim, use_bias=qkv_bias)
+            self.k_proj = linear(dim, self.attn_dim, use_bias=qkv_bias)
+            self.v_proj = linear(dim, self.attn_dim, use_bias=qkv_bias)
+        self.q_norm = norm_layer(head_dim, rngs=rngs) if qk_norm else None
+        self.k_norm = norm_layer(head_dim, rngs=rngs) if qk_norm else None
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.norm = norm_layer(self.attn_dim, rngs=rngs) if scale_norm else None
+        self.proj = linear(self.attn_dim, dim_out, use_bias=proj_bias)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
 
     def __call__(self, x, rope=None, attn_mask=None):
         B, N, C = x.shape
-        q, k, v = self._qkv(x)
+        if self.qkv is not None:
+            qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, self.head_dim)
+            qkv = qkv.transpose(2, 0, 3, 1, 4)
+            q, k, v = qkv[0], qkv[1], qkv[2]
+        else:
+            q = self.q_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+            k = self.k_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+            v = self.v_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+        if self.k_norm is not None:
+            k = self.k_norm(k)
         if rope is not None:
             # don't rotate prefix (cls/reg) tokens — rope covers trailing tokens
-            num_prefix = N - rope.shape[-2]
-            if num_prefix > 0:
-                qp, qr = q[..., :num_prefix, :], q[..., num_prefix:, :]
-                kp, kr = k[..., :num_prefix, :], k[..., num_prefix:, :]
-                q = jnp.concatenate([qp, apply_rot_embed_cat(qr, rope)], axis=-2)
-                k = jnp.concatenate([kp, apply_rot_embed_cat(kr, rope)], axis=-2)
+            npt = self.num_prefix_tokens
+            if npt > 0:
+                q = jnp.concatenate(
+                    [q[..., :npt, :], apply_rot_embed_cat(q[..., npt:, :], rope, half=self.rotate_half)], axis=-2)
+                k = jnp.concatenate(
+                    [k[..., :npt, :], apply_rot_embed_cat(k[..., npt:, :], rope, half=self.rotate_half)], axis=-2)
             else:
-                q = apply_rot_embed_cat(q, rope)
-                k = apply_rot_embed_cat(k, rope)
+                q = apply_rot_embed_cat(q, rope, half=self.rotate_half)
+                k = apply_rot_embed_cat(k, rope, half=self.rotate_half)
             q = q.astype(v.dtype)
             k = k.astype(v.dtype)
         dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop_rate
@@ -177,7 +256,7 @@ class AttentionRope(Attention):
         x = scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale,
         )
-        x = x.transpose(0, 2, 1, 3).reshape(B, N, C)
+        x = x.transpose(0, 2, 1, 3).reshape(B, N, self.attn_dim)
         if self.norm is not None:
             x = self.norm(x)
         x = self.proj(x)

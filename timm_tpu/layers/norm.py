@@ -10,6 +10,7 @@ reference's constructor conventions (`eps`, `affine`, positional num_channels).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from flax import nnx
 
@@ -76,10 +77,38 @@ class RmsNorm(nnx.RMSNorm):
 
 
 RmsNorm2d = RmsNorm
-# SimpleNorm (reference norm.py:~430) == RMSNorm with fp32 reduction; flax
-# RMSNorm already promotes reductions, so these alias.
-SimpleNorm = RmsNorm
-SimpleNorm2d = RmsNorm
+
+
+class SimpleNorm(nnx.Module):
+    """x * rsqrt(var(x) + eps) — mean-centered UNBIASED variance but no mean
+    subtraction of x itself (reference norm.py:394-439 via fast_norm.py
+    simple_norm, which uses torch.var's default correction=1). Distinct from
+    RMSNorm, which divides by sqrt(mean(x²))."""
+
+    def __init__(
+            self,
+            num_channels: int,
+            eps: float = 1e-6,
+            affine: bool = True,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.eps = eps
+        self.scale = nnx.Param(jnp.ones((num_channels,), param_dtype)) if affine else None
+
+    def __call__(self, x):
+        dtype = x.dtype
+        xf = x.astype(jnp.float32)
+        v = jnp.var(xf, axis=-1, keepdims=True, ddof=1)
+        xf = xf * jax.lax.rsqrt(v + self.eps)
+        if self.scale is not None:
+            xf = xf * self.scale[...].astype(jnp.float32)
+        return xf.astype(dtype)
+
+
+SimpleNorm2d = SimpleNorm
 
 
 class GroupNorm(nnx.GroupNorm):

@@ -18,8 +18,8 @@ from flax import nnx
 
 from ..layers import (
     ClassifierHead, DropPath, GlobalResponseNormMlp, LayerNorm, LayerScale, Mlp,
-    NormMlpClassifierHead, calculate_drop_path_rates, create_conv2d, get_norm_layer,
-    trunc_normal_,
+    NormMlpClassifierHead, calculate_drop_path_rates, create_conv2d, get_act_fn,
+    get_norm_layer, make_divisible, trunc_normal_,
 )
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
@@ -181,8 +181,10 @@ class ConvNeXt(nnx.Module):
             head_hidden_size: Optional[int] = None,
             conv_bias: bool = True,
             use_grn: bool = False,
+            conv_mlp: bool = False,
             act_layer: Union[str, Callable] = 'gelu',
             norm_layer: Optional[Union[str, Callable]] = None,
+            norm_eps: Optional[float] = None,
             drop_rate: float = 0.0,
             drop_path_rate: float = 0.0,
             *,
@@ -193,13 +195,19 @@ class ConvNeXt(nnx.Module):
         assert output_stride in (8, 16, 32)
         if isinstance(kernel_sizes, int):
             kernel_sizes = (kernel_sizes,) * 4
+        # conv_mlp only changes the reference's torch memory layout (1x1-conv
+        # MLP in NCHW vs Linear in NLC); in NHWC a Linear IS a 1x1 conv, so the
+        # flag is accepted for cfg parity but structurally a no-op here.
+        del conv_mlp
         norm_layer = get_norm_layer(norm_layer) or LayerNorm
+        if norm_eps is not None:
+            norm_layer = partial(norm_layer, eps=norm_eps)
 
         self.num_classes = num_classes
         self.drop_rate = drop_rate
 
         # stem
-        assert stem_type in ('patch', 'overlap', 'overlap_tiered')
+        assert stem_type in ('patch', 'overlap', 'overlap_tiered', 'overlap_act')
         if stem_type == 'patch':
             self.stem_conv = create_conv2d(
                 in_chans, dims[0], patch_size, stride=patch_size, padding=0, bias=conv_bias,
@@ -208,10 +216,11 @@ class ConvNeXt(nnx.Module):
             self.stem_norm = norm_layer(dims[0], rngs=rngs)
             stem_stride = patch_size
         else:
-            mid_chs = dims[0] // 2 if 'tiered' in stem_type else dims[0]
+            mid_chs = make_divisible(dims[0] // 2) if 'tiered' in stem_type else dims[0]
             self.stem_conv = create_conv2d(
                 in_chans, mid_chs, 3, stride=2, padding=None, bias=conv_bias,
                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.stem_act = get_act_fn(act_layer) if 'act' in stem_type else None
             self.stem_conv2 = create_conv2d(
                 mid_chs, dims[0], 3, stride=2, padding=None, bias=conv_bias,
                 dtype=dtype, param_dtype=param_dtype, rngs=rngs)
@@ -307,6 +316,8 @@ class ConvNeXt(nnx.Module):
     def _stem(self, x):
         x = self.stem_conv(x)
         if self.stem_conv2 is not None:
+            if getattr(self, 'stem_act', None) is not None:
+                x = self.stem_act(x)
             x = self.stem_conv2(x)
         return self.stem_norm(x)
 
@@ -390,6 +401,44 @@ default_cfgs = generate_default_cfgs({
     'test_convnext.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
     'test_convnext2.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
     'test_convnext3.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+    'convnext_zepto_rms.ra4_e3600_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), first_conv='stem.0', classifier='head.fc'),
+    'convnext_zepto_rms_ols.ra4_e3600_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.9, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), first_conv='stem.0', classifier='head.fc'),
+    'convnext_atto_ols.a2_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='stem.0', classifier='head.fc'),
+    'convnext_atto_rms.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 256, 256), test_crop_pct=0.95, first_conv='stem.0', classifier='head.fc'),
+    'convnext_femto_ols.d1_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='stem.0', classifier='head.fc'),
+    'convnext_pico_ols.d1_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='stem.0', classifier='head.fc'),
+    'convnext_nano_ols.d1h_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='stem.0', classifier='head.fc'),
+    'convnext_tiny_hnf.a2h_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='stem.0', classifier='head.fc'),
+    'convnext_large_mlp.clip_laion2b_soup_ft_in12k_in1k_320': _cfg(hf_hub_id='timm/', input_size=(3, 320, 320), pool_size=(10, 10), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_large_mlp.clip_laion2b_soup_ft_in12k_in1k_384': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_large_mlp.clip_laion2b_augreg_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_large_mlp.clip_laion2b_augreg_ft_in1k_384': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_large_mlp.clip_laion2b_soup_ft_in12k_320': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 320, 320), pool_size=(10, 10), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_large_mlp.clip_laion2b_augreg_ft_in12k_384': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_large_mlp.clip_laion2b_soup_ft_in12k_384': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_large_mlp.clip_laion2b_augreg': _cfg(hf_hub_id='timm/', num_classes=768, input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_large_mlp.clip_laion2b_ft_320': _cfg(hf_hub_id='timm/', num_classes=768, input_size=(3, 320, 320), pool_size=(10, 10), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_large_mlp.clip_laion2b_ft_soup_320': _cfg(hf_hub_id='timm/', num_classes=768, input_size=(3, 320, 320), pool_size=(10, 10), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_xlarge.fb_in22k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='stem.0', classifier='head.fc'),
+    'convnext_xlarge.fb_in22k_ft_in1k_384': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0, crop_mode='squash', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='stem.0', classifier='head.fc'),
+    'convnext_xlarge.fb_in22k': _cfg(hf_hub_id='timm/', num_classes=21841, input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='stem.0', classifier='head.fc'),
+    'convnext_xxlarge.clip_laion2b_soup_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_xxlarge.clip_laion2b_soup_ft_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_xxlarge.clip_laion2b_soup': _cfg(hf_hub_id='timm/', num_classes=1024, input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnext_xxlarge.clip_laion2b_rewind': _cfg(hf_hub_id='timm/', num_classes=1024, input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711), first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_femto.fcmae_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_femto.fcmae': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_pico.fcmae_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_pico.fcmae': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_small.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_large.fcmae_ft_in22k_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_large.fcmae_ft_in22k_in1k_384': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0, crop_mode='squash', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_large.fcmae_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_large.fcmae': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_huge.fcmae_ft_in22k_in1k_384': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0, crop_mode='squash', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_huge.fcmae_ft_in22k_in1k_512': _cfg(hf_hub_id='timm/', input_size=(3, 512, 512), pool_size=(15, 15), crop_pct=1.0, crop_mode='squash', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_huge.fcmae_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='stem.0', classifier='head.fc'),
+    'convnextv2_huge.fcmae': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='stem.0', classifier='head.fc'),
 })
 
 
@@ -399,11 +448,17 @@ def checkpoint_filter_fn(state_dict, model):
     import re
     from ._torch_convert import convert_torch_state_dict
     import numpy as np
-    # overlap stems: stem.0/stem.1 are convs (4D), stem.2 is the norm
+    # overlap stems: stem.0/stem.1 are convs (4D), stem.2 is the norm;
+    # overlap_act stems have a paramless act at index 1 (conv at 2, norm at 3)
+    overlap_act_stem = any(k.startswith('stem.3.') for k in state_dict)
     overlap_stem = any(k.startswith('stem.2.') for k in state_dict)
     out = {}
     for k, v in state_dict.items():
-        if overlap_stem:
+        if overlap_act_stem:
+            k = re.sub(r'^stem\.0\.', 'stem_conv.', k)
+            k = re.sub(r'^stem\.2\.', 'stem_conv2.', k)
+            k = re.sub(r'^stem\.3\.', 'stem_norm.', k)
+        elif overlap_stem:
             k = re.sub(r'^stem\.0\.', 'stem_conv.', k)
             k = re.sub(r'^stem\.1\.', 'stem_conv2.', k)
             k = re.sub(r'^stem\.2\.', 'stem_norm.', k)
@@ -520,3 +575,102 @@ def test_convnext3(pretrained=False, **kwargs) -> ConvNeXt:
     model_args = dict(
         depths=(1, 1, 1, 1), dims=(32, 64, 96, 128), stem_type='overlap_tiered', use_grn=True, ls_init_value=None)
     return _create_convnext('test_convnext3', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_zepto_rms(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 4, 2), dims=(32, 64, 128, 256), conv_mlp=True, norm_layer='simplenorm')
+    return _create_convnext('convnext_zepto_rms', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_zepto_rms_ols(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(
+        depths=(2, 2, 4, 2), dims=(32, 64, 128, 256), conv_mlp=True, norm_layer='simplenorm', stem_type='overlap_act')
+    return _create_convnext('convnext_zepto_rms_ols', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_atto_ols(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 6, 2), dims=(40, 80, 160, 320), conv_mlp=True, stem_type='overlap_tiered')
+    return _create_convnext('convnext_atto_ols', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_atto_rms(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 6, 2), dims=(40, 80, 160, 320), conv_mlp=True, norm_layer='rmsnorm2d')
+    return _create_convnext('convnext_atto_rms', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_femto_ols(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 6, 2), dims=(48, 96, 192, 384), conv_mlp=True, stem_type='overlap_tiered')
+    return _create_convnext('convnext_femto_ols', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_pico_ols(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 6, 2), dims=(64, 128, 256, 512), conv_mlp=True,  stem_type='overlap_tiered')
+    return _create_convnext('convnext_pico_ols', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_nano_ols(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(2, 2, 8, 2), dims=(80, 160, 320, 640), conv_mlp=True, stem_type='overlap')
+    return _create_convnext('convnext_nano_ols', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_tiny_hnf(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=(3, 3, 9, 3), dims=(96, 192, 384, 768), head_norm_first=True, conv_mlp=True)
+    return _create_convnext('convnext_tiny_hnf', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_large_mlp(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=[3, 3, 27, 3], dims=[192, 384, 768, 1536], head_hidden_size=1536)
+    return _create_convnext('convnext_large_mlp', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_xlarge(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=[3, 3, 27, 3], dims=[256, 512, 1024, 2048])
+    return _create_convnext('convnext_xlarge', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnext_xxlarge(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=[3, 4, 30, 3], dims=[384, 768, 1536, 3072], norm_eps=kwargs.pop('norm_eps', 1e-5))
+    return _create_convnext('convnext_xxlarge', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnextv2_femto(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(
+        depths=(2, 2, 6, 2), dims=(48, 96, 192, 384), use_grn=True, ls_init_value=None, conv_mlp=True)
+    return _create_convnext('convnextv2_femto', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnextv2_pico(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(
+        depths=(2, 2, 6, 2), dims=(64, 128, 256, 512), use_grn=True, ls_init_value=None, conv_mlp=True)
+    return _create_convnext('convnextv2_pico', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnextv2_small(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=[3, 3, 27, 3], dims=[96, 192, 384, 768], use_grn=True, ls_init_value=None)
+    return _create_convnext('convnextv2_small', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnextv2_large(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=[3, 3, 27, 3], dims=[192, 384, 768, 1536], use_grn=True, ls_init_value=None)
+    return _create_convnext('convnextv2_large', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convnextv2_huge(pretrained: bool = False, **kwargs) -> ConvNeXt:
+    model_args = dict(depths=[3, 3, 27, 3], dims=[352, 704, 1408, 2816], use_grn=True, ls_init_value=None)
+    return _create_convnext('convnextv2_huge', pretrained=pretrained, **dict(model_args, **kwargs))

@@ -15,8 +15,9 @@ import jax.numpy as jnp
 from flax import nnx
 
 from ..layers import (
-    BatchNormAct2d, ClassifierHead, DropPath, EcaModule, SEModule,
-    calculate_drop_path_rates, create_conv2d, get_act_fn,
+    AvgPool2dAA, BatchNormAct2d, BlurPool2d, ClassifierHead, DropPath, EcaModule,
+    SEModule, calculate_drop_path_rates, create_conv2d, get_aa_layer, get_act_fn,
+    get_attn, get_norm_act_layer,
 )
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
@@ -55,10 +56,14 @@ def max_pool2d(x, kernel: int = 3, stride: int = 2, padding=None):
 
 
 class DownsampleConv(nnx.Module):
-    def __init__(self, in_chs, out_chs, stride=1, dilation=1, norm_layer=None, *, dtype=None, param_dtype=jnp.float32, rngs):
+    def __init__(self, in_chs, out_chs, kernel_size=1, stride=1, dilation=1, norm_layer=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs):
         norm_layer = norm_layer or BatchNormAct2d
+        kernel_size = 1 if stride == 1 and dilation == 1 else kernel_size
+        first_dilation = (dilation or 1) if kernel_size > 1 else 1
         self.conv = create_conv2d(
-            in_chs, out_chs, 1, stride=stride, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            in_chs, out_chs, kernel_size, stride=stride, dilation=first_dilation, padding=None,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn = norm_layer(out_chs, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
 
     def __call__(self, x):
@@ -97,6 +102,7 @@ class BasicBlock(nnx.Module):
             act_layer: Union[str, Callable] = 'relu',
             norm_layer: Callable = BatchNormAct2d,
             attn_layer: Optional[Callable] = None,
+            aa_layer: Optional[Callable] = None,
             drop_path: float = 0.0,
             *,
             dtype=None,
@@ -107,11 +113,14 @@ class BasicBlock(nnx.Module):
         first_planes = planes // reduce_first
         outplanes = planes * self.expansion
         first_dilation = first_dilation or dilation
+        use_aa = aa_layer is not None and (stride == 2 or first_dilation != dilation)
 
         self.conv1 = create_conv2d(
-            inplanes, first_planes, 3, stride=stride, dilation=first_dilation, padding=None,
+            inplanes, first_planes, 3, stride=1 if use_aa else stride,
+            dilation=first_dilation, padding=None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(first_planes, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.aa = aa_layer(channels=first_planes, stride=stride, rngs=rngs) if use_aa else None
         self.conv2 = create_conv2d(
             first_planes, outplanes, 3, dilation=dilation, padding=None,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
@@ -128,6 +137,8 @@ class BasicBlock(nnx.Module):
     def __call__(self, x):
         shortcut = x
         x = self.bn1(self.conv1(x))
+        if self.aa is not None:
+            x = self.aa(x)
         x = self.bn2(self.conv2(x))
         if self.se is not None:
             x = self.se(x)
@@ -154,6 +165,7 @@ class Bottleneck(nnx.Module):
             act_layer: Union[str, Callable] = 'relu',
             norm_layer: Callable = BatchNormAct2d,
             attn_layer: Optional[Callable] = None,
+            aa_layer: Optional[Callable] = None,
             drop_path: float = 0.0,
             *,
             dtype=None,
@@ -164,13 +176,16 @@ class Bottleneck(nnx.Module):
         first_planes = width // reduce_first
         outplanes = planes * self.expansion
         first_dilation = first_dilation or dilation
+        use_aa = aa_layer is not None and (stride == 2 or first_dilation != dilation)
 
         self.conv1 = create_conv2d(inplanes, first_planes, 1, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(first_planes, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.conv2 = create_conv2d(
-            first_planes, width, 3, stride=stride, dilation=first_dilation, groups=cardinality,
+            first_planes, width, 3, stride=1 if use_aa else stride,
+            dilation=first_dilation, groups=cardinality,
             padding=None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn2 = norm_layer(width, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.aa = aa_layer(channels=width, stride=stride, rngs=rngs) if use_aa else None
         self.conv3 = create_conv2d(width, outplanes, 1, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn3 = norm_layer(outplanes, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.se = attn_layer(outplanes, dtype=dtype, param_dtype=param_dtype, rngs=rngs) if attn_layer else None
@@ -186,6 +201,8 @@ class Bottleneck(nnx.Module):
         shortcut = x
         x = self.bn1(self.conv1(x))
         x = self.bn2(self.conv2(x))
+        if self.aa is not None:
+            x = self.aa(x)
         x = self.bn3(self.conv3(x))
         if self.se is not None:
             x = self.se(x)
@@ -211,9 +228,13 @@ class ResNet(nnx.Module):
             stem_type: str = '',
             replace_stem_pool: bool = False,
             avg_down: bool = False,
+            block_reduce_first: int = 1,
+            down_kernel_size: int = 1,
             act_layer: Union[str, Callable] = 'relu',
             norm_layer: Callable = BatchNormAct2d,
             se_layer: Optional[Callable] = None,
+            aa_layer: Optional[Callable] = None,
+            block_args: Optional[Dict[str, Any]] = None,
             drop_rate: float = 0.0,
             drop_path_rate: float = 0.0,
             zero_init_last: bool = True,
@@ -227,6 +248,12 @@ class ResNet(nnx.Module):
         assert output_stride in (8, 16, 32)
         self.num_classes = num_classes
         self.drop_rate = drop_rate
+        block_args = dict(block_args) if block_args else {}
+        if 'attn_layer' in block_args:
+            se_layer = se_layer or get_attn(block_args.pop('attn_layer'))
+        aa_layer = get_aa_layer(aa_layer)
+        if isinstance(norm_layer, str):
+            norm_layer = get_norm_act_layer(norm_layer, act_layer=act_layer)
 
         # stem
         deep_stem = 'deep' in stem_type
@@ -255,6 +282,33 @@ class ResNet(nnx.Module):
         self.bn1 = norm_layer(inplanes, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.feature_info = [dict(num_chs=inplanes, reduction=2, module='bn1')]
 
+        # stem pooling: default 3x3/s2 max pool, optionally replaced by a
+        # strided conv (+norm/act) or augmented with anti-aliasing
+        # (reference resnet.py:561-577)
+        if replace_stem_pool:
+            stem_pool_max = False
+            stem_pool_conv = create_conv2d(
+                inplanes, inplanes, 3, stride=1 if aa_layer else 2, padding=None,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            stem_pool_aa = aa_layer(channels=inplanes, stride=2, rngs=rngs) if aa_layer is not None else None
+            stem_pool_norm = norm_layer(
+                inplanes, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        elif aa_layer is not None:
+            stem_pool_conv = stem_pool_norm = None
+            if aa_layer is AvgPool2dAA:
+                stem_pool_max = False
+                stem_pool_aa = AvgPool2dAA(stride=2, rngs=rngs)
+            else:
+                stem_pool_max = 'stride1'
+                stem_pool_aa = aa_layer(channels=inplanes, stride=2, rngs=rngs)
+        else:
+            stem_pool_conv = stem_pool_norm = stem_pool_aa = None
+            stem_pool_max = True
+        self.stem_pool_conv = stem_pool_conv
+        self.stem_pool_norm = stem_pool_norm
+        self.stem_pool_aa = stem_pool_aa
+        self.stem_pool_max = stem_pool_max
+
         # stages
         stage_blocks = []
         total_blocks = sum(layers)
@@ -270,10 +324,15 @@ class ResNet(nnx.Module):
                 net_stride *= stride
             downsample = None
             if stride != 1 or inplanes != planes * block.expansion:
-                ds_cls = DownsampleAvg if avg_down else DownsampleConv
-                downsample = ds_cls(
-                    inplanes, planes * block.expansion, stride=stride, dilation=dilation,
-                    norm_layer=norm_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+                if avg_down:
+                    downsample = DownsampleAvg(
+                        inplanes, planes * block.expansion, stride=stride, dilation=dilation,
+                        norm_layer=norm_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+                else:
+                    downsample = DownsampleConv(
+                        inplanes, planes * block.expansion, kernel_size=down_kernel_size,
+                        stride=stride, dilation=dilation,
+                        norm_layer=norm_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
             blocks = []
             for block_idx in range(num_blocks):
                 blocks.append(block(
@@ -283,14 +342,17 @@ class ResNet(nnx.Module):
                     downsample=downsample if block_idx == 0 else None,
                     cardinality=cardinality,
                     base_width=base_width,
+                    reduce_first=block_reduce_first,
                     dilation=dilation,
                     act_layer=act_layer,
                     norm_layer=norm_layer,
                     attn_layer=se_layer,
+                    aa_layer=aa_layer,
                     drop_path=dpr[stage_idx][block_idx],
                     dtype=dtype,
                     param_dtype=param_dtype,
                     rngs=rngs,
+                    **block_args,
                 ))
                 inplanes = planes * block.expansion
             stage_blocks.append(nnx.List(blocks))
@@ -339,7 +401,20 @@ class ResNet(nnx.Module):
         else:
             x = self.conv1(x)
         x = self.bn1(x)
-        return max_pool2d(x, 3, 2)
+        # stem pooling variants (see __init__)
+        if getattr(self, 'stem_pool_conv', None) is not None:
+            x = self.stem_pool_conv(x)
+            if self.stem_pool_aa is not None:
+                x = self.stem_pool_aa(x)
+            return self.stem_pool_norm(x)
+        pool_max = getattr(self, 'stem_pool_max', True)
+        if pool_max == 'stride1':
+            x = max_pool2d(x, 3, 1)
+        elif pool_max:
+            x = max_pool2d(x, 3, 2)
+        if getattr(self, 'stem_pool_aa', None) is not None:
+            x = self.stem_pool_aa(x)
+        return x
 
     def _stages(self):
         return [self.layer1, self.layer2, self.layer3, self.layer4]
@@ -473,6 +548,51 @@ default_cfgs = generate_default_cfgs({
                                   test_input_size=(3, 320, 320), crop_pct=0.95),
     'ecaresnet101d.miil_in1k': _cfg(hf_hub_id='timm/', first_conv='conv1.0'),
     'ecaresnetlight.miil_in1k': _cfg(hf_hub_id='timm/'),
+    'resnet50c.gluon_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv1.0', classifier='fc'),
+    'resnet50s.gluon_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv1.0', classifier='fc'),
+    'resnet101c.gluon_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv1.0', classifier='fc'),
+    'resnet101s.gluon_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv1.0', classifier='fc'),
+    'resnet152c.gluon_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv1.0', classifier='fc'),
+    'resnet152s.gluon_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv1.0', classifier='fc'),
+    'resnet50_gn.a1h_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.94, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1', classifier='fc'),
+    'resnext101_32x32d.fb_wsl_ig1b_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, interpolation='bilinear', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv1', classifier='fc'),
+    'ecaresnet50d_pruned.miil_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1.0', classifier='fc'),
+    'ecaresnet101d_pruned.miil_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1.0', classifier='fc'),
+    'ecaresnet200d.untrained': _cfg(input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1.0', classifier='fc'),
+    'ecaresnet269d.ra2_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 320, 320), pool_size=(10, 10), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 352, 352), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'ecaresnext26t_32x4d.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv1.0', classifier='fc'),
+    'ecaresnext50t_32x4d.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv1.0', classifier='fc'),
+    'seresnet18.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1', classifier='fc'),
+    'seresnet152d.ra2_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 320, 320), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'seresnet200d.untrained': _cfg(input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1.0', classifier='fc'),
+    'seresnet269d.untrained': _cfg(input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1.0', classifier='fc'),
+    'seresnext101d_32x8d.ah_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'senet154.gluon_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv1.0', classifier='fc'),
+    'resnetblur18.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1', classifier='fc'),
+    'resnetblur50.bt_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1', classifier='fc'),
+    'resnetblur50d.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1.0', classifier='fc'),
+    'resnetblur101d.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1.0', classifier='fc'),
+    'resnetaa34d.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1.0', classifier='fc'),
+    'resnetaa50.a1h_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='conv1', classifier='fc'),
+    'resnetaa50d.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'resnetaa50d.sw_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'resnetaa50d.d_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'resnetaa101d.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'resnetaa101d.sw_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'seresnetaa50d.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=0.95, first_conv='conv1.0', classifier='fc'),
+    'seresnextaa101d_32x8d.sw_in12k_ft_in1k_288': _cfg(hf_hub_id='timm/', input_size=(3, 288, 288), pool_size=(9, 9), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 320, 320), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'seresnextaa101d_32x8d.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'seresnextaa101d_32x8d.sw_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'seresnextaa101d_32x8d.ah_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'seresnextaa201d_32x8d.sw_in12k_ft_in1k_384': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv1.0', classifier='fc'),
+    'seresnextaa201d_32x8d.sw_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 320, 320), pool_size=(10, 10), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 384, 384), test_crop_pct=1.0, first_conv='conv1.0', classifier='fc'),
+    'resnetrs50.tf_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), pool_size=(5, 5), crop_pct=0.91, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 224, 224), first_conv='conv1.0', classifier='fc'),
+    'resnetrs101.tf_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 192, 192), pool_size=(6, 6), crop_pct=0.94, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), first_conv='conv1.0', classifier='fc'),
+    'resnetrs152.tf_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 320, 320), first_conv='conv1.0', classifier='fc'),
+    'resnetrs200.tf_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 320, 320), first_conv='conv1.0', classifier='fc'),
+    'resnetrs270.tf_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 352, 352), first_conv='conv1.0', classifier='fc'),
+    'resnetrs350.tf_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 288, 288), pool_size=(9, 9), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 384, 384), first_conv='conv1.0', classifier='fc'),
+    'resnetrs420.tf_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 320, 320), pool_size=(10, 10), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 416, 416), first_conv='conv1.0', classifier='fc'),
 })
 
 
@@ -484,9 +604,13 @@ def checkpoint_filter_fn(state_dict, model):
     from ._torch_convert import convert_torch_state_dict
     # avg-down models use Sequential(pool, conv, bn) → indices 1/2
     has_avg_down = any('downsample.2.' in k for k in state_dict)
+    # replace_stem_pool / aa stems: maxpool is Sequential(conv[, aa], norm, act)
+    pool_norm_idx = 2 if any(k.startswith('maxpool.2.') for k in state_dict) else 1
     out = {}
     for k, v in state_dict.items():
         k = re.sub(r'^fc\.', 'head.fc.', k)
+        k = re.sub(r'^maxpool\.0\.', 'stem_pool_conv.', k)
+        k = re.sub(r'^maxpool\.%d\.' % pool_norm_idx, 'stem_pool_norm.', k)
         if has_avg_down:
             k = re.sub(r'(layer\d+\.\d+\.downsample)\.1\.', r'\1.conv.', k)
             k = re.sub(r'(layer\d+\.\d+\.downsample)\.2\.', r'\1.bn.', k)
@@ -785,3 +909,319 @@ def test_resnet(pretrained=False, **kwargs) -> ResNet:
     """Tiny fixture (reference resnet.py:2213)."""
     model_args = dict(block=BasicBlock, layers=(1, 1, 1, 1), channels=(32, 48, 48, 96))
     return _create_resnet('test_resnet', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet50c(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-50-C model."""
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), stem_width=32, stem_type='deep')
+    return _create_resnet('resnet50c', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet50s(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-50-S model."""
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), stem_width=64, stem_type='deep')
+    return _create_resnet('resnet50s', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet101c(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-101-C model."""
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), stem_width=32, stem_type='deep')
+    return _create_resnet('resnet101c', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet101s(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-101-S model."""
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), stem_width=64, stem_type='deep')
+    return _create_resnet('resnet101s', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet152c(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-152-C model."""
+    model_args = dict(block=Bottleneck, layers=(3, 8, 36, 3), stem_width=32, stem_type='deep')
+    return _create_resnet('resnet152c', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet152s(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-152-S model."""
+    model_args = dict(block=Bottleneck, layers=(3, 8, 36, 3), stem_width=64, stem_type='deep')
+    return _create_resnet('resnet152s', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnet50_gn(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-50 model w/ GroupNorm"""
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), norm_layer='groupnorm')
+    return _create_resnet('resnet50_gn', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnext101_32x32d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNeXt-101 32x32d model"""
+    model_args = dict(block=Bottleneck, layers=(3, 4, 23, 3), cardinality=32, base_width=32)
+    return _create_resnet('resnext101_32x32d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def ecaresnet50d_pruned(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-50-D model pruned with eca."""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 6, 3), stem_width=32, stem_type='deep', avg_down=True,
+        block_args=dict(attn_layer='eca'))
+    return _create_resnet('ecaresnet50d_pruned', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def ecaresnet101d_pruned(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-101-D model pruned with eca."""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 23, 3), stem_width=32, stem_type='deep', avg_down=True,
+        block_args=dict(attn_layer='eca'))
+    return _create_resnet('ecaresnet101d_pruned', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def ecaresnet200d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-200-D model with ECA."""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 24, 36, 3), stem_width=32, stem_type='deep', avg_down=True,
+        block_args=dict(attn_layer='eca'))
+    return _create_resnet('ecaresnet200d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def ecaresnet269d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-269-D model with ECA."""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 30, 48, 8), stem_width=32, stem_type='deep', avg_down=True,
+        block_args=dict(attn_layer='eca'))
+    return _create_resnet('ecaresnet269d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def ecaresnext26t_32x4d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs an ECA-ResNeXt-26-T model."""
+    model_args = dict(
+        block=Bottleneck, layers=(2, 2, 2, 2), cardinality=32, base_width=4, stem_width=32,
+        stem_type='deep_tiered', avg_down=True, block_args=dict(attn_layer='eca'))
+    return _create_resnet('ecaresnext26t_32x4d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def ecaresnext50t_32x4d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs an ECA-ResNeXt-50-T model."""
+    model_args = dict(
+        block=Bottleneck, layers=(2, 2, 2, 2), cardinality=32, base_width=4, stem_width=32,
+        stem_type='deep_tiered', avg_down=True, block_args=dict(attn_layer='eca'))
+    return _create_resnet('ecaresnext50t_32x4d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnet18(pretrained: bool = False, **kwargs) -> ResNet:
+    model_args = dict(block=BasicBlock, layers=(2, 2, 2, 2), block_args=dict(attn_layer='se'))
+    return _create_resnet('seresnet18', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnet152d(pretrained: bool = False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(3, 8, 36, 3), stem_width=32, stem_type='deep',
+        avg_down=True, block_args=dict(attn_layer='se'))
+    return _create_resnet('seresnet152d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnet200d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-200-D model with SE attn."""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 24, 36, 3), stem_width=32, stem_type='deep',
+        avg_down=True, block_args=dict(attn_layer='se'))
+    return _create_resnet('seresnet200d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnet269d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-269-D model with SE attn."""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 30, 48, 8), stem_width=32, stem_type='deep',
+        avg_down=True, block_args=dict(attn_layer='se'))
+    return _create_resnet('seresnet269d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnext101d_32x8d(pretrained: bool = False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 23, 3), cardinality=32, base_width=8,
+        stem_width=32, stem_type='deep', avg_down=True,
+        block_args=dict(attn_layer='se'))
+    return _create_resnet('seresnext101d_32x8d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def senet154(pretrained: bool = False, **kwargs) -> ResNet:
+    model_args = dict(
+        block=Bottleneck, layers=(3, 8, 36, 3), cardinality=64, base_width=4, stem_type='deep',
+        down_kernel_size=3, block_reduce_first=2, block_args=dict(attn_layer='se'))
+    return _create_resnet('senet154', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetblur18(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-18 model with blur anti-aliasing"""
+    model_args = dict(block=BasicBlock, layers=(2, 2, 2, 2), aa_layer=BlurPool2d)
+    return _create_resnet('resnetblur18', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetblur50(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-50 model with blur anti-aliasing"""
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), aa_layer=BlurPool2d)
+    return _create_resnet('resnetblur50', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetblur50d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-50-D model with blur anti-aliasing"""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 6, 3), aa_layer=BlurPool2d,
+        stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnetblur50d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetblur101d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-101-D model with blur anti-aliasing"""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 23, 3), aa_layer=BlurPool2d,
+        stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnetblur101d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetaa34d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-34-D model w/ avgpool anti-aliasing"""
+    model_args = dict(
+        block=BasicBlock, layers=(3, 4, 6, 3),  aa_layer=AvgPool2dAA, stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnetaa34d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetaa50(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-50 model with avgpool anti-aliasing"""
+    model_args = dict(block=Bottleneck, layers=(3, 4, 6, 3), aa_layer=AvgPool2dAA)
+    return _create_resnet('resnetaa50', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetaa50d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-50-D model with avgpool anti-aliasing"""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 6, 3), aa_layer=AvgPool2dAA,
+        stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnetaa50d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetaa101d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-101-D model with avgpool anti-aliasing"""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 23, 3), aa_layer=AvgPool2dAA,
+        stem_width=32, stem_type='deep', avg_down=True)
+    return _create_resnet('resnetaa101d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnetaa50d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a SE=ResNet-50-D model with avgpool anti-aliasing"""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 6, 3), aa_layer=AvgPool2dAA,
+        stem_width=32, stem_type='deep', avg_down=True, block_args=dict(attn_layer='se'))
+    return _create_resnet('seresnetaa50d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnextaa101d_32x8d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a SE=ResNeXt-101-D 32x8d model with avgpool anti-aliasing"""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 23, 3), cardinality=32, base_width=8,
+        stem_width=32, stem_type='deep', avg_down=True, aa_layer=AvgPool2dAA,
+        block_args=dict(attn_layer='se'))
+    return _create_resnet('seresnextaa101d_32x8d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def seresnextaa201d_32x8d(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a SE=ResNeXt-101-D 32x8d model with avgpool anti-aliasing"""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 24, 36, 4), cardinality=32, base_width=8,
+        stem_width=64, stem_type='deep', avg_down=True, aa_layer=AvgPool2dAA,
+        block_args=dict(attn_layer='se'))
+    return _create_resnet('seresnextaa201d_32x8d', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetrs50(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-RS-50 model."""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 6, 3), stem_width=32, stem_type='deep', replace_stem_pool=True,
+        avg_down=True,  block_args=dict(attn_layer=partial(get_attn('se'), rd_ratio=0.25)))
+    return _create_resnet('resnetrs50', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetrs101(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-RS-101 model."""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 4, 23, 3), stem_width=32, stem_type='deep', replace_stem_pool=True,
+        avg_down=True,  block_args=dict(attn_layer=partial(get_attn('se'), rd_ratio=0.25)))
+    return _create_resnet('resnetrs101', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetrs152(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-RS-152 model."""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 8, 36, 3), stem_width=32, stem_type='deep', replace_stem_pool=True,
+        avg_down=True,  block_args=dict(attn_layer=partial(get_attn('se'), rd_ratio=0.25)))
+    return _create_resnet('resnetrs152', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetrs200(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-RS-200 model."""
+    model_args = dict(
+        block=Bottleneck, layers=(3, 24, 36, 3), stem_width=32, stem_type='deep', replace_stem_pool=True,
+        avg_down=True,  block_args=dict(attn_layer=partial(get_attn('se'), rd_ratio=0.25)))
+    return _create_resnet('resnetrs200', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetrs270(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-RS-270 model."""
+    model_args = dict(
+        block=Bottleneck, layers=(4, 29, 53, 4), stem_width=32, stem_type='deep', replace_stem_pool=True,
+        avg_down=True,  block_args=dict(attn_layer=partial(get_attn('se'), rd_ratio=0.25)))
+    return _create_resnet('resnetrs270', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetrs350(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-RS-350 model."""
+    model_args = dict(
+        block=Bottleneck, layers=(4, 36, 72, 4), stem_width=32, stem_type='deep', replace_stem_pool=True,
+        avg_down=True,  block_args=dict(attn_layer=partial(get_attn('se'), rd_ratio=0.25)))
+    return _create_resnet('resnetrs350', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def resnetrs420(pretrained: bool = False, **kwargs) -> ResNet:
+    """Constructs a ResNet-RS-420 model"""
+    model_args = dict(
+        block=Bottleneck, layers=(4, 44, 87, 4), stem_width=32, stem_type='deep', replace_stem_pool=True,
+        avg_down=True,  block_args=dict(attn_layer=partial(get_attn('se'), rd_ratio=0.25)))
+    return _create_resnet('resnetrs420', pretrained=pretrained, **dict(model_args, **kwargs))

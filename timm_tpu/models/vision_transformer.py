@@ -22,8 +22,9 @@ from flax import nnx
 
 from ..layers import (
     Attention, AttentionPoolLatent, DropPath, Dropout, LayerNorm, LayerScale,
-    Mlp, PatchDropout, PatchEmbed, calculate_drop_path_rates, get_act_fn,
-    get_norm_layer, global_pool_nlc, resample_abs_pos_embed, trunc_normal_,
+    Mlp, PatchDropout, PatchEmbed, RmsNorm, SwiGLU, SwiGLUPacked, calculate_drop_path_rates,
+    get_act_fn, get_norm_layer, global_pool_nlc, maybe_add_mask,
+    resample_abs_pos_embed, scaled_dot_product_attention, trunc_normal_, zeros_,
 )
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
@@ -43,6 +44,8 @@ class Block(nnx.Module):
             mlp_ratio: float = 4.0,
             qkv_bias: bool = False,
             qk_norm: bool = False,
+            scale_attn_norm: bool = False,
+            scale_mlp_norm: bool = False,
             proj_bias: bool = True,
             proj_drop: float = 0.0,
             attn_drop: float = 0.0,
@@ -52,6 +55,7 @@ class Block(nnx.Module):
             norm_layer: Callable = LayerNorm,
             mlp_layer: Callable = Mlp,
             attn_layer: Optional[Callable] = None,
+            depth: int = 0,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -64,6 +68,7 @@ class Block(nnx.Module):
             num_heads=num_heads,
             qkv_bias=qkv_bias,
             qk_norm=qk_norm,
+            scale_norm=scale_attn_norm,
             proj_bias=proj_bias,
             attn_drop=attn_drop,
             proj_drop=proj_drop,
@@ -79,6 +84,7 @@ class Block(nnx.Module):
             dim,
             hidden_features=int(dim * mlp_ratio),
             act_layer=act_layer,
+            norm_layer=norm_layer if scale_mlp_norm else None,
             drop=proj_drop,
             bias=proj_bias,
             dtype=dtype,
@@ -110,6 +116,8 @@ class ResPostBlock(nnx.Module):
             mlp_ratio: float = 4.0,
             qkv_bias: bool = False,
             qk_norm: bool = False,
+            scale_attn_norm: bool = False,
+            scale_mlp_norm: bool = False,
             proj_bias: bool = True,
             proj_drop: float = 0.0,
             attn_drop: float = 0.0,
@@ -119,6 +127,7 @@ class ResPostBlock(nnx.Module):
             norm_layer: Callable = LayerNorm,
             mlp_layer: Callable = Mlp,
             attn_layer: Optional[Callable] = None,
+            depth: int = 0,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -127,14 +136,16 @@ class ResPostBlock(nnx.Module):
         self.init_values = init_values
         attn_cls = attn_layer or Attention
         self.attn = attn_cls(
-            dim, num_heads=num_heads, qkv_bias=qkv_bias, qk_norm=qk_norm, proj_bias=proj_bias,
+            dim, num_heads=num_heads, qkv_bias=qkv_bias, qk_norm=qk_norm,
+            scale_norm=scale_attn_norm, proj_bias=proj_bias,
             attn_drop=attn_drop, proj_drop=proj_drop, norm_layer=norm_layer,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs,
         )
         self.norm1 = norm_layer(dim, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.drop_path1 = DropPath(drop_path, rngs=rngs)
         self.mlp = mlp_layer(
-            dim, hidden_features=int(dim * mlp_ratio), act_layer=act_layer, drop=proj_drop,
+            dim, hidden_features=int(dim * mlp_ratio), act_layer=act_layer,
+            norm_layer=norm_layer if scale_mlp_norm else None, drop=proj_drop,
             bias=proj_bias, dtype=dtype, param_dtype=param_dtype, rngs=rngs,
         )
         self.norm2 = norm_layer(dim, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
@@ -147,6 +158,309 @@ class ResPostBlock(nnx.Module):
     def __call__(self, x, attn_mask=None):
         x = x + self.drop_path1(self.norm1(self.attn(x, attn_mask=attn_mask)))
         x = x + self.drop_path2(self.norm2(self.mlp(x)))
+        return x
+
+
+class ParallelScalingBlock(nnx.Module):
+    """ViT-22B-style parallel block: one fused input projection computes the
+    qkv AND the MLP hidden activations from a single norm, and the attention /
+    MLP branch outputs are summed into the residual
+    (reference vision_transformer.py:292-421).
+
+    TPU note: the fused in_proj is exactly the layout the MXU wants — one
+    (N, C) x (C, 3C+H) matmul per block instead of two smaller ones.
+    """
+
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int,
+            mlp_ratio: float = 4.0,
+            qkv_bias: bool = False,
+            qk_norm: bool = False,
+            scale_attn_norm: bool = False,
+            scale_mlp_norm: bool = False,
+            proj_bias: bool = True,
+            proj_drop: float = 0.0,
+            attn_drop: float = 0.0,
+            init_values: Optional[float] = None,
+            drop_path: float = 0.0,
+            act_layer: Union[str, Callable] = 'gelu',
+            norm_layer: Callable = LayerNorm,
+            mlp_layer: Optional[Callable] = None,  # unused, fused design
+            attn_layer: Optional[Callable] = None,  # unused, fused design
+            depth: int = 0,  # unused
+            fuse_out_proj: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert dim % num_heads == 0, 'dim should be divisible by num_heads'
+        assert not scale_attn_norm and not scale_mlp_norm, 'Scale norms not supported'
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.scale = self.head_dim ** -0.5
+        mlp_hidden_dim = int(mlp_ratio * dim)
+        self.mlp_hidden_dim = mlp_hidden_dim
+
+        linear = partial(nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+                         kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.in_norm = norm_layer(dim, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.in_proj = linear(dim, mlp_hidden_dim + 3 * dim, use_bias=qkv_bias)
+        # when in_proj has no bias, the MLP branch still gets its own bias
+        self.mlp_bias = None if qkv_bias else nnx.Param(jnp.zeros((mlp_hidden_dim,), param_dtype))
+        self.q_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.k_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.attn_drop_rate = attn_drop
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.mlp_drop = Dropout(proj_drop, rngs=rngs)
+        self.mlp_act = get_act_fn(act_layer)
+        if fuse_out_proj:
+            self.out_proj = linear(dim + mlp_hidden_dim, dim, use_bias=proj_bias)
+            self.attn_out_proj = None
+            self.mlp_out_proj = None
+        else:
+            self.out_proj = None
+            self.attn_out_proj = linear(dim, dim, use_bias=proj_bias)
+            self.mlp_out_proj = linear(mlp_hidden_dim, dim, use_bias=proj_bias)
+        self.ls = LayerScale(dim, init_values=init_values, param_dtype=param_dtype, rngs=rngs) \
+            if init_values is not None else None
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x, attn_mask=None):
+        B, N, C = x.shape
+        y = self.in_proj(self.in_norm(x))
+        x_mlp, qkv = jnp.split(y, [self.mlp_hidden_dim], axis=-1)
+        if self.mlp_bias is not None:
+            x_mlp = x_mlp + self.mlp_bias[...].astype(x_mlp.dtype)
+
+        q, k, v = jnp.split(qkv.reshape(B, N, 3, self.num_heads, self.head_dim)
+                            .transpose(2, 0, 3, 1, 4), 3, axis=0)
+        q, k, v = q[0], k[0], v[0]
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+        if self.k_norm is not None:
+            k = self.k_norm(k)
+        from ..layers.drop import dropout_rng_key
+        dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop_rate
+        dropout_key = dropout_rng_key(self.attn_drop) if dropout_p > 0.0 else None
+        x_attn = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale)
+        x_attn = x_attn.transpose(0, 2, 1, 3).reshape(B, N, C)
+
+        x_mlp = self.mlp_drop(self.mlp_act(x_mlp))
+        if self.out_proj is not None:
+            y = self.out_proj(jnp.concatenate([x_attn, x_mlp], axis=-1))
+        else:
+            y = self.attn_out_proj(x_attn) + self.mlp_out_proj(x_mlp)
+        if self.ls is not None:
+            y = self.ls(y)
+        return x + self.drop_path(y)
+
+
+class DiffParallelScalingBlock(nnx.Module):
+    """Parallel fused block with differential attention
+    (reference vision_transformer.py:424-595): two softmax attention maps from
+    split half-dim heads are subtracted with a learned per-layer lambda, then
+    RMS-normed per head before the fused output projection."""
+
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int,
+            mlp_ratio: float = 4.0,
+            qkv_bias: bool = False,
+            qk_norm: bool = False,
+            scale_attn_norm: bool = False,
+            scale_mlp_norm: bool = False,
+            proj_bias: bool = True,
+            proj_drop: float = 0.0,
+            attn_drop: float = 0.0,
+            init_values: Optional[float] = None,
+            drop_path: float = 0.0,
+            act_layer: Union[str, Callable] = 'gelu',
+            norm_layer: Callable = LayerNorm,
+            mlp_layer: Optional[Callable] = None,  # unused
+            attn_layer: Optional[Callable] = None,  # unused
+            depth: int = 0,
+            dual_lambda: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert dim % num_heads == 0, 'dim should be divisible by num_heads'
+        assert not scale_attn_norm and not scale_mlp_norm, 'Scale norms not supported'
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads // 2  # half head_dim for diff attention
+        self.scale = self.head_dim ** -0.5
+        mlp_hidden_dim = int(mlp_ratio * dim)
+        self.mlp_hidden_dim = mlp_hidden_dim
+
+        linear = partial(nnx.Linear, dtype=dtype, param_dtype=param_dtype,
+                         kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, rngs=rngs)
+        self.in_norm = norm_layer(dim, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.in_proj = linear(dim, mlp_hidden_dim + 3 * dim, use_bias=qkv_bias)
+        self.mlp_bias = None if qkv_bias else nnx.Param(jnp.zeros((mlp_hidden_dim,), param_dtype))
+        self.q_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.k_norm = norm_layer(self.head_dim, rngs=rngs) if qk_norm else None
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.sub_norm = RmsNorm(2 * self.head_dim, eps=1e-5, rngs=rngs)
+        self.dual_lambda = dual_lambda
+        key = rngs.params()
+        if dual_lambda:
+            self.lambda_a = nnx.Param(jnp.zeros((), jnp.float32))
+            self.lambda_b = nnx.Param(jnp.zeros((), jnp.float32))
+            self.lambda_q1 = self.lambda_k1 = self.lambda_q2 = self.lambda_k2 = None
+        else:
+            ks = jax.random.split(key, 4)
+            self.lambda_a = self.lambda_b = None
+            self.lambda_q1 = nnx.Param(jax.random.normal(ks[0], (self.head_dim,), jnp.float32) * 0.1)
+            self.lambda_k1 = nnx.Param(jax.random.normal(ks[1], (self.head_dim,), jnp.float32) * 0.1)
+            self.lambda_q2 = nnx.Param(jax.random.normal(ks[2], (self.head_dim,), jnp.float32) * 0.1)
+            self.lambda_k2 = nnx.Param(jax.random.normal(ks[3], (self.head_dim,), jnp.float32) * 0.1)
+        self.mlp_drop = Dropout(proj_drop, rngs=rngs)
+        self.mlp_act = get_act_fn(act_layer)
+        self.out_proj = linear(dim + mlp_hidden_dim, dim, use_bias=proj_bias)
+        self.ls = LayerScale(dim, init_values=init_values, param_dtype=param_dtype, rngs=rngs) \
+            if init_values is not None else None
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+        self.lambda_init = 0.8 - 0.6 * math.exp(-0.3 * depth)
+
+    def _compute_lambda(self):
+        if self.lambda_a is not None:
+            l1 = jnp.exp(self.lambda_a[...])
+            l2 = jnp.exp(self.lambda_b[...])
+        else:
+            l1 = jnp.exp(jnp.sum(self.lambda_q1[...] * self.lambda_k1[...]))
+            l2 = jnp.exp(jnp.sum(self.lambda_q2[...] * self.lambda_k2[...]))
+        return l1 - l2 + self.lambda_init
+
+    def __call__(self, x, attn_mask=None):
+        B, N, C = x.shape
+        y = self.in_proj(self.in_norm(x))
+        x_mlp, qkv = jnp.split(y, [self.mlp_hidden_dim], axis=-1)
+        if self.mlp_bias is not None:
+            x_mlp = x_mlp + self.mlp_bias[...].astype(x_mlp.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # 2x heads with half head_dim for q/k; v keeps full head width
+        q = q.reshape(B, N, 2 * self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, N, 2 * self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, N, self.num_heads, 2 * self.head_dim).transpose(0, 2, 1, 3)
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+        if self.k_norm is not None:
+            k = self.k_norm(k)
+        lambda_full = self._compute_lambda().astype(q.dtype)
+
+        attn = (q * self.scale) @ k.transpose(0, 1, 3, 2)
+        attn = maybe_add_mask(attn, attn_mask)
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = self.attn_drop(attn)
+        attn = attn.reshape(B, self.num_heads, 2, N, N)
+        attn = attn[:, :, 0] - lambda_full * attn[:, :, 1]
+        x_attn = attn @ v
+        x_attn = self.sub_norm(x_attn)
+        x_attn = x_attn * (1 - self.lambda_init)
+        x_attn = x_attn.transpose(0, 2, 1, 3).reshape(B, N, C)
+
+        x_mlp = self.mlp_drop(self.mlp_act(x_mlp))
+        y = self.out_proj(jnp.concatenate([x_attn, x_mlp], axis=-1))
+        if self.ls is not None:
+            y = self.ls(y)
+        return x + self.drop_path(y)
+
+
+class _AttnBranch(nnx.Module):
+    """norm → attn → layer-scale → drop-path branch of ParallelThingsBlock
+    (keeps the reference's ``attns.N.{norm,attn,ls}`` state naming)."""
+
+    def __init__(self, dim, attn_cls, norm_cls, init_values, drop_path, *,
+                 param_dtype=jnp.float32, rngs: nnx.Rngs, **attn_kwargs):
+        self.norm = norm_cls(dim, rngs=rngs)
+        self.attn = attn_cls(dim, **attn_kwargs, rngs=rngs)
+        self.ls = LayerScale(dim, init_values=init_values, param_dtype=param_dtype, rngs=rngs) \
+            if init_values else None
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x, attn_mask=None):
+        y = self.attn(self.norm(x), attn_mask=attn_mask)
+        if self.ls is not None:
+            y = self.ls(y)
+        return self.drop_path(y)
+
+
+class _FfnBranch(nnx.Module):
+    """norm → mlp → layer-scale → drop-path branch of ParallelThingsBlock."""
+
+    def __init__(self, dim, mlp_layer, norm_cls, init_values, drop_path, *,
+                 param_dtype=jnp.float32, rngs: nnx.Rngs, **mlp_kwargs):
+        self.norm = norm_cls(dim, rngs=rngs)
+        self.mlp = mlp_layer(dim, **mlp_kwargs, rngs=rngs)
+        self.ls = LayerScale(dim, init_values=init_values, param_dtype=param_dtype, rngs=rngs) \
+            if init_values else None
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        y = self.mlp(self.norm(x))
+        if self.ls is not None:
+            y = self.ls(y)
+        return self.drop_path(y)
+
+
+class ParallelThingsBlock(nnx.Module):
+    """'Three things' parallel block: N parallel attentions then N parallel
+    MLPs, each branch summed into the residual
+    (reference vision_transformer.py:598-682)."""
+
+    def __init__(
+            self,
+            dim: int,
+            num_heads: int,
+            num_parallel: int = 2,
+            mlp_ratio: float = 4.0,
+            qkv_bias: bool = False,
+            qk_norm: bool = False,
+            scale_attn_norm: bool = False,
+            scale_mlp_norm: bool = False,
+            proj_bias: bool = True,
+            init_values: Optional[float] = None,
+            proj_drop: float = 0.0,
+            attn_drop: float = 0.0,
+            drop_path: float = 0.0,
+            act_layer: Union[str, Callable] = 'gelu',
+            norm_layer: Callable = LayerNorm,
+            mlp_layer: Callable = Mlp,
+            attn_layer: Optional[Callable] = None,
+            depth: int = 0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        attn_cls = attn_layer or Attention
+        self.num_parallel = num_parallel
+        self.attns = nnx.List([
+            _AttnBranch(
+                dim, attn_cls, norm_layer, init_values, drop_path,
+                num_heads=num_heads, qkv_bias=qkv_bias, qk_norm=qk_norm,
+                scale_norm=scale_attn_norm, proj_bias=proj_bias, attn_drop=attn_drop,
+                proj_drop=proj_drop, norm_layer=norm_layer,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+            ) for _ in range(num_parallel)])
+        self.ffns = nnx.List([
+            _FfnBranch(
+                dim, mlp_layer, norm_layer, init_values, drop_path,
+                hidden_features=int(dim * mlp_ratio), act_layer=act_layer,
+                norm_layer=norm_layer if scale_mlp_norm else None,
+                bias=proj_bias, drop=proj_drop,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+            ) for _ in range(num_parallel)])
+
+    def __call__(self, x, attn_mask=None):
+        x = x + sum(attn(x, attn_mask=attn_mask) for attn in self.attns)
+        x = x + sum(ffn(x) for ffn in self.ffns)
         return x
 
 
@@ -168,6 +482,8 @@ class VisionTransformer(nnx.Module):
             mlp_ratio: float = 4.0,
             qkv_bias: bool = True,
             qk_norm: bool = False,
+            scale_attn_norm: bool = False,
+            scale_mlp_norm: bool = False,
             proj_bias: bool = True,
             init_values: Optional[float] = None,
             class_token: bool = True,
@@ -188,6 +504,7 @@ class VisionTransformer(nnx.Module):
             weight_init: str = '',
             fix_init: bool = False,
             embed_layer: Callable = PatchEmbed,
+            embed_norm_layer: Optional[Union[str, Callable]] = None,
             norm_layer: Optional[Union[str, Callable]] = None,
             act_layer: Optional[Union[str, Callable]] = None,
             block_fn: Callable = Block,
@@ -219,6 +536,8 @@ class VisionTransformer(nnx.Module):
         embed_args = {}
         if dynamic_img_size:
             embed_args.update(dict(strict_img_size=False))
+        if embed_norm_layer is not None:
+            embed_args['norm_layer'] = get_norm_layer(embed_norm_layer)
         self.patch_embed = embed_layer(
             img_size=img_size,
             patch_size=patch_size,
@@ -274,6 +593,8 @@ class VisionTransformer(nnx.Module):
                 mlp_ratio=mlp_ratio,
                 qkv_bias=qkv_bias,
                 qk_norm=qk_norm,
+                scale_attn_norm=scale_attn_norm,
+                scale_mlp_norm=scale_mlp_norm,
                 proj_bias=proj_bias,
                 init_values=init_values,
                 proj_drop=proj_drop_rate,
@@ -283,6 +604,7 @@ class VisionTransformer(nnx.Module):
                 act_layer=act_layer,
                 mlp_layer=mlp_layer,
                 attn_layer=_resolve_attn_layer(i),
+                depth=i,
                 dtype=dtype,
                 param_dtype=param_dtype,
                 rngs=rngs,
@@ -566,6 +888,278 @@ default_cfgs = generate_default_cfgs({
     'vit_so400m_patch14_siglip_224.untrained': _cfg(url=''),
     'vit_tiny_patch16_224.untrained': _cfg(url=''),
     # tiny test fixtures (reference vision_transformer.py:4802-4833)
+    'vit_small_patch32_384.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_small_patch8_224.dino': _cfg(hf_hub_id='timm/', num_classes=0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_base_patch32_384.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_base_patch32_384.augreg_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_large_patch32_224.orig_in21k': _cfg(hf_hub_id='timm/', num_classes=0),
+    'vit_large_patch32_384.orig_in21k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_large_patch16_384.augreg_in21k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_giant_patch14_224.untrained': _cfg(),
+    'vit_gigantic_patch14_224.untrained': _cfg(),
+    'vit_base_patch16_224_miil.in21k': _cfg(hf_hub_id='timm/', num_classes=11221, crop_pct=0.875, interpolation='bilinear', mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0)),
+    'vit_base_patch16_224_miil.in21k_ft_in1k': _cfg(hf_hub_id='timm/', crop_pct=0.875, interpolation='bilinear', mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0)),
+    'vit_medium_patch16_gap_240.sw_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 240, 240), crop_pct=0.95),
+    'vit_medium_patch16_gap_256.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_medium_patch16_gap_384.sw_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=0.95, crop_mode='squash'),
+    'vit_betwixt_patch16_gap_256.untrained': _cfg(input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_base_patch16_gap_224.untrained': _cfg(),
+    'vit_huge_patch14_gap_224.in1k_ijepa': _cfg(num_classes=0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_huge_patch14_gap_224.in22k_ijepa': _cfg(num_classes=0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_huge_patch16_gap_448.in1k_ijepa': _cfg(num_classes=0, input_size=(3, 448, 448), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_giant_patch16_gap_224.in22k_ijepa': _cfg(num_classes=0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_xsmall_patch16_clip_224.tinyclip_yfcc15m': _cfg(hf_hub_id='timm/', num_classes=512, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_medium_patch32_clip_224.tinyclip_laion400m': _cfg(hf_hub_id='timm/', num_classes=512, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_medium_patch16_clip_224.tinyclip_yfcc15m': _cfg(hf_hub_id='timm/', num_classes=512, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_betwixt_patch32_clip_224.tinyclip_laion400m': _cfg(hf_hub_id='timm/', num_classes=512, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_224.laion2b_ft_in12k_in1k': _cfg(hf_hub_id='timm/', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_224.openai_ft_in12k_in1k': _cfg(mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_224.laion2b_ft_in1k': _cfg(hf_hub_id='timm/', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_224.openai_ft_in1k': _cfg(hf_hub_id='timm/', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_224.laion2b': _cfg(hf_hub_id='timm/', num_classes=512, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_224.laion400m_e32': _cfg(hf_hub_id='timm/', num_classes=512, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_224.datacompxl': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_224.metaclip_2pt5b': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_224.metaclip_400m': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_224.openai': _cfg(hf_hub_id='timm/', num_classes=512, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_256.datacompxl': _cfg(hf_hub_id='timm/', num_classes=512, input_size=(3, 256, 256), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_384.laion2b_ft_in12k_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_384.openai_ft_in12k_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=0.95, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_448.laion2b_ft_in12k_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 448, 448), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.laion2b_ft_in12k_in1k': _cfg(hf_hub_id='timm/', crop_pct=0.95, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.openai_ft_in12k_in1k': _cfg(hf_hub_id='timm/', crop_pct=0.95, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.laion2b_ft_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.openai_ft_in1k': _cfg(hf_hub_id='timm/', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.laion2b_ft_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.openai_ft_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.laion2b': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.laion400m_e32': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.datacompxl': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.dfn2b': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.metaclip_2pt5b': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.metaclip_400m': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_224.openai': _cfg(hf_hub_id='timm/', num_classes=512, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_384.laion2b_ft_in12k_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_384.openai_ft_in12k_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=0.95, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_384.laion2b_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_384.openai_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_plus_clip_240.laion400m_e32': _cfg(hf_hub_id='timm/', num_classes=640, input_size=(3, 240, 240), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_224.laion2b_ft_in12k_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0),
+    'vit_large_patch14_clip_224.openai_ft_in12k_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_224.laion2b_ft_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0),
+    'vit_large_patch14_clip_224.openai_ft_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_224.laion2b_ft_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, crop_pct=1.0),
+    'vit_large_patch14_clip_224.openai_ft_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_224.laion2b': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0),
+    'vit_large_patch14_clip_224.laion400m_e32': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_224.datacompxl': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_224.dfn2b_s39b': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_224.dfn2b': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_224.metaclip_2pt5b': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_224.metaclip_400m': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_224.openai': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_224.apple_mclip2_dfndr2b': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_336.laion2b_ft_in12k_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 336, 336), crop_pct=1.0, crop_mode='squash'),
+    'vit_large_patch14_clip_336.openai_ft_in12k_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 336, 336), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_336.laion2b_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 336, 336), crop_pct=1.0, crop_mode='squash'),
+    'vit_large_patch14_clip_336.openai': _cfg(hf_hub_id='timm/', num_classes=768, input_size=(3, 336, 336), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_224.laion2b_ft_in12k_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_224.laion2b_ft_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_224.laion2b_ft_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_224.laion2b': _cfg(hf_hub_id='timm/', num_classes=1024, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_224.dfn5b': _cfg(hf_hub_id='timm/', num_classes=1024, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_224.metaclip2_worldwide': _cfg(hf_hub_id='timm/', num_classes=1024, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_224.metaclip_2pt5b': _cfg(hf_hub_id='timm/', num_classes=1024, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_224.metaclip_altogether': _cfg(hf_hub_id='timm/', num_classes=1024, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_336.laion2b_ft_in12k_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 336, 336), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_336.laion2b_ft_in1k': _cfg(input_size=(3, 336, 336), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_378.dfn5b': _cfg(hf_hub_id='timm/', num_classes=1024, input_size=(3, 378, 378), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_378.metaclip2_worldwide': _cfg(hf_hub_id='timm/', num_classes=1024, input_size=(3, 378, 378), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_giant_patch14_clip_224.laion2b': _cfg(hf_hub_id='timm/', num_classes=1024, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_gigantic_patch14_clip_224.laion2b': _cfg(hf_hub_id='timm/', num_classes=1280, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_gigantic_patch14_clip_224.metaclip2_worldwide': _cfg(hf_hub_id='timm/', num_classes=1280, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_gigantic_patch14_clip_224.metaclip_2pt5b': _cfg(hf_hub_id='timm/', num_classes=1280, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_gigantic_patch14_clip_378.metaclip2_worldwide': _cfg(hf_hub_id='timm/', num_classes=1280, input_size=(3, 378, 378), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_quickgelu_224.laion400m_e32': _cfg(hf_hub_id='timm/', num_classes=512, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_quickgelu_224.metaclip_2pt5b': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_quickgelu_224.metaclip_400m': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_clip_quickgelu_224.openai': _cfg(hf_hub_id='timm/', num_classes=512, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_quickgelu_224.metaclip_2pt5b': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_quickgelu_224.metaclip_400m': _cfg(hf_hub_id='timm/', num_classes=512, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch16_clip_quickgelu_224.openai': _cfg(hf_hub_id='timm/', num_classes=512, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_quickgelu_224.dfn2b': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_quickgelu_224.metaclip_2pt5b': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_quickgelu_224.metaclip_400m': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_quickgelu_224.openai': _cfg(hf_hub_id='timm/', num_classes=768, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_large_patch14_clip_quickgelu_336.openai': _cfg(hf_hub_id='timm/', num_classes=768, input_size=(3, 336, 336), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_quickgelu_224.dfn5b': _cfg(hf_hub_id='timm/', num_classes=1024, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_quickgelu_224.metaclip2_worldwide': _cfg(hf_hub_id='timm/', num_classes=1024, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_quickgelu_224.metaclip_2pt5b': _cfg(hf_hub_id='timm/', num_classes=1024, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_huge_patch14_clip_quickgelu_378.dfn5b': _cfg(hf_hub_id='timm/', num_classes=1024, input_size=(3, 378, 378), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_gigantic_patch14_clip_quickgelu_224.metaclip_2pt5b': _cfg(hf_hub_id='timm/', num_classes=1280, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'vit_base_patch32_plus_256.untrained': _cfg(input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_base_patch16_plus_240.untrained': _cfg(input_size=(3, 240, 240), crop_pct=0.95),
+    'vit_base_patch16_rpn_224.sw_in1k': _cfg(hf_hub_id='timm/'),
+    'vit_small_patch16_36x1_224.untrained': _cfg(),
+    'vit_small_patch16_18x2_224.untrained': _cfg(),
+    'vit_base_patch16_18x2_224.untrained': _cfg(),
+    'eva_large_patch14_196.in22k_ft_in22k_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 196, 196), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'eva_large_patch14_196.in22k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 196, 196), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'eva_large_patch14_336.in22k_ft_in22k_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 336, 336), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'eva_large_patch14_336.in22k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 336, 336), crop_pct=1.0, crop_mode='squash', mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'flexivit_small.1200ep_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_small.600ep_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_small.300ep_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_base.1200ep_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_base.600ep_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_base.300ep_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_base.1000ep_in21k': _cfg(hf_hub_id='timm/', num_classes=21843, input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_base.300ep_in21k': _cfg(hf_hub_id='timm/', num_classes=21843, input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_base.patch16_in21k': _cfg(hf_hub_id='timm/', num_classes=21843, input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_base.patch30_in21k': _cfg(hf_hub_id='timm/', num_classes=21843, input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_large.1200ep_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_large.600ep_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), crop_pct=0.95),
+    'flexivit_large.300ep_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), crop_pct=0.95),
+    'vit_base_patch16_xp_224.untrained': _cfg(),
+    'vit_large_patch14_xp_224.untrained': _cfg(),
+    'vit_huge_patch14_xp_224.untrained': _cfg(),
+    'vit_small_patch14_dinov2.lvd142m': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 518, 518), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_base_patch14_dinov2.lvd142m': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 518, 518), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_large_patch14_dinov2.lvd142m': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 518, 518), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_giant_patch14_dinov2.lvd142m': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 518, 518), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_small_patch14_reg4_dinov2.lvd142m': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 518, 518), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_base_patch14_reg4_dinov2.lvd142m': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 518, 518), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_large_patch14_reg4_dinov2.lvd142m': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 518, 518), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_giant_patch14_reg4_dinov2.lvd142m': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 518, 518), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_base_patch14_reg1_tipsv2.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0, mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0)),
+    'vit_large_patch14_reg1_tipsv2.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0, mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0)),
+    'vit_so400m_patch14_reg1_tipsv2.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0, mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0)),
+    'vit_giant_patch14_reg1_tipsv2.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0, mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0)),
+    'vit_base_patch32_siglip_256.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_base_patch16_siglip_224.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0),
+    'vit_base_patch16_siglip_224.webli': _cfg(hf_hub_id='timm/', num_classes=0),
+    'vit_base_patch16_siglip_256.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_base_patch16_siglip_256.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_base_patch16_siglip_256.webli_i18n': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_base_patch16_siglip_384.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_base_patch16_siglip_384.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_base_patch16_siglip_512.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 512, 512)),
+    'vit_base_patch16_siglip_512.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 512, 512)),
+    'vit_large_patch16_siglip_256.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_large_patch16_siglip_256.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_large_patch16_siglip_384.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_large_patch16_siglip_384.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_large_patch16_siglip_512.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 512, 512)),
+    'vit_so400m_patch14_siglip_378.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 378, 378)),
+    'vit_so400m_patch14_siglip_378.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 378, 378)),
+    'vit_so400m_patch14_siglip_378.webli_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 378, 378), crop_pct=1.0, crop_mode='squash'),
+    'vit_so400m_patch14_siglip_384.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_so400m_patch16_siglip_256.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_so400m_patch16_siglip_256.webli_i18n': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_so400m_patch16_siglip_384.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_so400m_patch16_siglip_512.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 512, 512)),
+    'vit_giantopt_patch16_siglip_256.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_giantopt_patch16_siglip_384.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_base_patch32_siglip_gap_256.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_base_patch16_siglip_gap_224.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0),
+    'vit_base_patch16_siglip_gap_224.webli': _cfg(hf_hub_id='timm/', num_classes=0),
+    'vit_base_patch16_siglip_gap_256.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_base_patch16_siglip_gap_256.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_base_patch16_siglip_gap_256.webli_i18n': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_base_patch16_siglip_gap_384.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_base_patch16_siglip_gap_384.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_base_patch16_siglip_gap_512.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 512, 512)),
+    'vit_base_patch16_siglip_gap_512.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 512, 512)),
+    'vit_large_patch16_siglip_gap_256.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_large_patch16_siglip_gap_256.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_large_patch16_siglip_gap_384.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_large_patch16_siglip_gap_384.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_large_patch16_siglip_gap_512.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 512, 512)),
+    'vit_so400m_patch14_siglip_gap_224.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0),
+    'vit_so400m_patch14_siglip_gap_224.webli': _cfg(hf_hub_id='timm/', num_classes=0),
+    'vit_so400m_patch14_siglip_gap_224.pali_mix': _cfg(hf_hub_id='timm/', num_classes=0),
+    'vit_so400m_patch14_siglip_gap_224.pali_pt': _cfg(hf_hub_id='timm/', num_classes=0),
+    'vit_so400m_patch14_siglip_gap_224.pali2_3b_pt': _cfg(hf_hub_id='timm/', num_classes=0),
+    'vit_so400m_patch14_siglip_gap_224.pali2_10b_pt': _cfg(hf_hub_id='timm/', num_classes=0),
+    'vit_so400m_patch14_siglip_gap_378.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 378, 378)),
+    'vit_so400m_patch14_siglip_gap_378.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 378, 378), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_378.webli_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 378, 378), crop_pct=1.0, crop_mode='squash'),
+    'vit_so400m_patch14_siglip_gap_384.webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_448.pali_mix': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_448.pali_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_448.pali_refcoco_seg': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_448.pali_ocrvqa': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_448.pali2_3b_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_448.pali2_10b_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_448.pali2_3b_docci': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_448.pali2_10b_docci': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_896.pali_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 896, 896), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_896.pali_refcoco_seg': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 896, 896), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_896.pali_ocrvqa': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 896, 896), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_896.pali2_3b_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 896, 896), crop_pct=1.0),
+    'vit_so400m_patch14_siglip_gap_896.pali2_10b_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 896, 896), crop_pct=1.0),
+    'vit_so400m_patch16_siglip_gap_256.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_so400m_patch16_siglip_gap_256.webli_i18n': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_so400m_patch16_siglip_gap_384.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_so400m_patch16_siglip_gap_512.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 512, 512)),
+    'vit_giantopt_patch16_siglip_gap_256.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 256, 256)),
+    'vit_giantopt_patch16_siglip_gap_384.v2_webli': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 384, 384)),
+    'vit_wee_patch16_reg1_gap_256.sbb_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_dwee_patch16_reg1_gap_256.sbb_nadamuon_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_dwee_patch16_reg1_gap_256.sbb_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_pwee_patch16_reg1_gap_256.sbb_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_dpwee_patch16_reg1_gap_256.sbb_nadamuon_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_dpwee_patch16_reg1_gap_256.sbb_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_little_patch16_reg1_gap_256.sbb_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_little_patch16_reg1_gap_256.sbb_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_medium_patch16_reg1_gap_256.sbb_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_mediumd_patch16_reg4_gap_256.sbb2_e200_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_mediumd_patch16_reg4_gap_256.sbb_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_mediumd_patch16_reg4_gap_256.sbb2_e200_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_mediumd_patch16_reg4_gap_256.sbb_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_mediumd_patch16_reg4_gap_384.sbb2_e200_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_betwixt_patch16_reg1_gap_256.sbb_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_betwixt_patch16_reg4_gap_256.sbb2_e200_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_betwixt_patch16_reg4_gap_256.sbb_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_betwixt_patch16_reg4_gap_256.sbb_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_betwixt_patch16_reg4_gap_256.sbb2_e200_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_betwixt_patch16_reg4_gap_256.sbb_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_betwixt_patch16_reg4_gap_384.sbb2_e200_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_base_patch16_reg4_gap_256.untrained': _cfg(input_size=(3, 256, 256)),
+    'vit_so150m_patch16_reg4_map_256.untrained': _cfg(input_size=(3, 256, 256)),
+    'vit_so150m_patch16_reg4_gap_256.sbb_e250_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_so150m_patch16_reg4_gap_256.sbb_e250_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 256, 256), crop_pct=0.95),
+    'vit_so150m_patch16_reg4_gap_384.sbb_e250_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_so150m2_patch16_reg1_gap_256.sbb_e200_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), crop_pct=1.0),
+    'vit_so150m2_patch16_reg1_gap_256.sbb_e200_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 256, 256), crop_pct=1.0),
+    'vit_so150m2_patch16_reg1_gap_384.sbb_e200_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), crop_pct=1.0),
+    'vit_so150m2_patch16_reg1_gap_448.sbb_e200_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 448, 448), crop_pct=1.0, crop_mode='squash'),
+    'vit_intern300m_patch14_448.ogvl_dist': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'vit_intern300m_patch14_448.ogvl_2pt5': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'aimv2_large_patch14_224.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_large_patch14_224.apple_pt_dist': _cfg(hf_hub_id='timm/', num_classes=0, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_huge_patch14_224.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_1b_patch14_224.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_3b_patch14_224.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_large_patch14_336.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 336, 336), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_large_patch14_336.apple_pt_dist': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 336, 336), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_huge_patch14_336.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 336, 336), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_1b_patch14_336.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 336, 336), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_3b_patch14_336.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 336, 336), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_large_patch14_448.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_huge_patch14_448.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_1b_patch14_448.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'aimv2_3b_patch14_448.apple_pt': _cfg(hf_hub_id='timm/', num_classes=0, input_size=(3, 448, 448), crop_pct=1.0, mean=(0.48145466, 0.4578275, 0.40821073), std=(0.26862954, 0.26130258, 0.27577711)),
+    'beit3_base_patch16_224.in22k_ft_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'beit3_base_patch16_224.indomain_in22k_ft_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'beit3_base_patch16_224.pt': _cfg(hf_hub_id='timm/', num_classes=0, crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'beit3_base_patch16_224.indomain_pt': _cfg(hf_hub_id='timm/', num_classes=0, crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'beit3_large_patch16_224.in22k_ft_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'beit3_large_patch16_224.indomain_in22k_ft_in1k': _cfg(hf_hub_id='timm/', crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'beit3_large_patch16_224.pt': _cfg(hf_hub_id='timm/', num_classes=0, crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'beit3_large_patch16_224.indomain_pt': _cfg(hf_hub_id='timm/', num_classes=0, crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'beit3_giant_patch14_224.untrained': _cfg(crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'beit3_giant_patch14_336.untrained': _cfg(input_size=(3, 336, 336), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
     'test_vit.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
     'test_vit2.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
     'test_vit3.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
@@ -734,3 +1328,1228 @@ def test_vit4(pretrained: bool = False, **kwargs) -> VisionTransformer:
         dynamic_img_size=True, patch_drop_rate=0.25,
     )
     return _create_vision_transformer('test_vit4', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_small_patch32_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Small (ViT-S/32) at 384x384."""
+    model_args = dict(patch_size=32, embed_dim=384, depth=12, num_heads=6)
+    return _create_vision_transformer('vit_small_patch32_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_small_patch8_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Small (ViT-S/8)"""
+    model_args = dict(patch_size=8, embed_dim=384, depth=12, num_heads=6)
+    return _create_vision_transformer('vit_small_patch8_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch32_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Base model (ViT-B/32) from original paper (https://arxiv.org/abs/2010.11929)."""
+    model_args = dict(patch_size=32, embed_dim=768, depth=12, num_heads=12)
+    return _create_vision_transformer('vit_base_patch32_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch32_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Large model (ViT-L/32) from original paper (https://arxiv.org/abs/2010.11929). No pretrained weights."""
+    model_args = dict(patch_size=32, embed_dim=1024, depth=24, num_heads=16)
+    return _create_vision_transformer('vit_large_patch32_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch32_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Large model (ViT-L/32) from original paper (https://arxiv.org/abs/2010.11929)."""
+    model_args = dict(patch_size=32, embed_dim=1024, depth=24, num_heads=16)
+    return _create_vision_transformer('vit_large_patch32_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch16_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Large model (ViT-L/16) from original paper (https://arxiv.org/abs/2010.11929)."""
+    model_args = dict(patch_size=16, embed_dim=1024, depth=24, num_heads=16)
+    return _create_vision_transformer('vit_large_patch16_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_giant_patch14_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Giant (little-g) model (ViT-g/14) from `Scaling Vision Transformers` - https://arxiv.org/abs/2106.04560"""
+    model_args = dict(patch_size=14, embed_dim=1408, mlp_ratio=48/11, depth=40, num_heads=16)
+    return _create_vision_transformer('vit_giant_patch14_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_gigantic_patch14_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Gigantic (big-G) model (ViT-G/14) from `Scaling Vision Transformers` - https://arxiv.org/abs/2106.04560"""
+    model_args = dict(patch_size=14, embed_dim=1664, mlp_ratio=64/13, depth=48, num_heads=16)
+    return _create_vision_transformer('vit_gigantic_patch14_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_224_miil(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Base (ViT-B/16) from original paper (https://arxiv.org/abs/2010.11929)."""
+    model_args = dict(patch_size=16, embed_dim=768, depth=12, num_heads=12, qkv_bias=False)
+    return _create_vision_transformer('vit_base_patch16_224_miil', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_medium_patch16_gap_240(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Medium (ViT-M/16) w/o class token, w/ avg-pool @ 240x240"""
+    model_args = dict(
+        patch_size=16, embed_dim=512, depth=12, num_heads=8, class_token=False,
+        global_pool='avg', qkv_bias=False, init_values=1e-6, fc_norm=False)
+    return _create_vision_transformer('vit_medium_patch16_gap_240', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_medium_patch16_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Medium (ViT-M/16) w/o class token, w/ avg-pool @ 256x256"""
+    model_args = dict(
+        patch_size=16, embed_dim=512, depth=12, num_heads=8, class_token=False,
+        global_pool='avg', qkv_bias=False, init_values=1e-6, fc_norm=False)
+    return _create_vision_transformer('vit_medium_patch16_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_medium_patch16_gap_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Medium (ViT-M/16) w/o class token, w/ avg-pool @ 384x384"""
+    model_args = dict(
+        patch_size=16, embed_dim=512, depth=12, num_heads=8, class_token=False,
+        global_pool='avg', qkv_bias=False, init_values=1e-6, fc_norm=False)
+    return _create_vision_transformer('vit_medium_patch16_gap_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_betwixt_patch16_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Betwixt (ViT-b/16) w/o class token, w/ avg-pool @ 256x256"""
+    model_args = dict(
+        patch_size=16, embed_dim=640, depth=12, num_heads=10, class_token=False,
+        global_pool='avg', qkv_bias=False, init_values=1e-6, fc_norm=False)
+    return _create_vision_transformer('vit_betwixt_patch16_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_gap_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Base (ViT-B/16) w/o class token, w/ avg-pool @ 224x224"""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=16, class_token=False, global_pool='avg', fc_norm=False)
+    return _create_vision_transformer('vit_base_patch16_gap_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_huge_patch14_gap_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Huge model (ViT-H/14) w/ no class token, avg pool"""
+    model_args = dict(
+        patch_size=14, embed_dim=1280, depth=32, num_heads=16, class_token=False, global_pool='avg', fc_norm=False)
+    return _create_vision_transformer('vit_huge_patch14_gap_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_huge_patch16_gap_448(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Huge model (ViT-H/16) w/ no class token, avg pool @ 448x448"""
+    model_args = dict(
+        patch_size=16, embed_dim=1280, depth=32, num_heads=16, class_token=False, global_pool='avg', fc_norm=False)
+    return _create_vision_transformer('vit_huge_patch16_gap_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_giant_patch16_gap_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Giant (little-gg) model (ViT-g/16) w/ no class token, avg pool"""
+    model_args = dict(
+        patch_size=16, embed_dim=1408, depth=40, num_heads=16, mlp_ratio=48/11,
+        class_token=False, global_pool='avg', fc_norm=False)
+    return _create_vision_transformer('vit_giant_patch16_gap_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_xsmall_patch16_clip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(embed_dim=256, depth=10, num_heads=4, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_xsmall_patch16_clip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_medium_patch32_clip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=32, embed_dim=512, depth=12, num_heads=8, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_medium_patch32_clip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_medium_patch16_clip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(embed_dim=512, depth=12, num_heads=8, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_medium_patch16_clip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_betwixt_patch32_clip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=32, embed_dim=640, depth=12, num_heads=10, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_betwixt_patch32_clip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch32_clip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-B/32 CLIP image tower @ 224x224"""
+    model_args = dict(
+        patch_size=32, embed_dim=768, depth=12, num_heads=12, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_base_patch32_clip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch32_clip_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-B/32 CLIP image tower @ 256x256"""
+    model_args = dict(
+        patch_size=32, embed_dim=768, depth=12, num_heads=12, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_base_patch32_clip_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch32_clip_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-B/32 CLIP image tower @ 384x384"""
+    model_args = dict(
+        patch_size=32, embed_dim=768, depth=12, num_heads=12, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_base_patch32_clip_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch32_clip_448(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-B/32 CLIP image tower @ 448x448"""
+    model_args = dict(
+        patch_size=32, embed_dim=768, depth=12, num_heads=12, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_base_patch32_clip_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_clip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-B/16 CLIP image tower"""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_base_patch16_clip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_clip_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-B/16 CLIP image tower @ 384x384"""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_base_patch16_clip_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_plus_clip_240(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Base (ViT-B/16+) CLIP image tower @ 240x240"""
+    model_args = dict(
+        patch_size=16, embed_dim=896, depth=12, num_heads=14, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_base_patch16_plus_clip_240', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch14_clip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Large model (ViT-L/14) CLIP image tower"""
+    model_args = dict(
+        patch_size=14, embed_dim=1024, depth=24, num_heads=16, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_large_patch14_clip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch14_clip_336(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Large model (ViT-L/14) CLIP image tower @ 336x336"""
+    model_args = dict(
+        patch_size=14, embed_dim=1024, depth=24, num_heads=16, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_large_patch14_clip_336', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_huge_patch14_clip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Huge model (ViT-H/14) CLIP image tower."""
+    model_args = dict(
+        patch_size=14, embed_dim=1280, depth=32, num_heads=16, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_huge_patch14_clip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_huge_patch14_clip_336(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Huge model (ViT-H/14) CLIP image tower @ 336x336"""
+    model_args = dict(
+        patch_size=14, embed_dim=1280, depth=32, num_heads=16, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_huge_patch14_clip_336', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_huge_patch14_clip_378(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Huge model (ViT-H/14) CLIP image tower @ 378x378"""
+    model_args = dict(
+        patch_size=14, embed_dim=1280, depth=32, num_heads=16, pre_norm=True, norm_layer=partial(LayerNorm, eps=1e-5))
+    return _create_vision_transformer('vit_huge_patch14_clip_378', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_giant_patch14_clip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Giant (little-g) model (ViT-g/14) from `Scaling Vision Transformers` - https://arxiv.org/abs/2106.04560"""
+    model_args = dict(
+        patch_size=14, embed_dim=1408, mlp_ratio=48/11, depth=40, num_heads=16, pre_norm=True,
+        norm_layer=partial(LayerNorm, eps=1e-5),
+    )
+    return _create_vision_transformer('vit_giant_patch14_clip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_gigantic_patch14_clip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-bigG model (ViT-G/14) from `Scaling Vision Transformers` - https://arxiv.org/abs/2106.04560"""
+    model_args = dict(
+        patch_size=14, embed_dim=1664, mlp_ratio=64/13, depth=48, num_heads=16, pre_norm=True,
+        norm_layer=partial(LayerNorm, eps=1e-5),
+    )
+    return _create_vision_transformer('vit_gigantic_patch14_clip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_gigantic_patch14_clip_378(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-bigG model (ViT-G/14) from `Scaling Vision Transformers` - https://arxiv.org/abs/2106.04560"""
+    model_args = dict(
+        patch_size=14, embed_dim=1664, mlp_ratio=64/13, depth=48, num_heads=16, pre_norm=True,
+        norm_layer=partial(LayerNorm, eps=1e-5),
+    )
+    return _create_vision_transformer('vit_gigantic_patch14_clip_378', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch32_clip_quickgelu_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-B/32 CLIP image tower @ 224x224"""
+    model_args = dict(
+        patch_size=32, embed_dim=768, depth=12, num_heads=12, pre_norm=True,
+        norm_layer=partial(LayerNorm, eps=1e-5), act_layer='quick_gelu'
+    )
+    return _create_vision_transformer('vit_base_patch32_clip_quickgelu_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_clip_quickgelu_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-B/16 CLIP image tower w/ QuickGELU act"""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, pre_norm=True,
+        norm_layer=partial(LayerNorm, eps=1e-5), act_layer='quick_gelu'
+    )
+    return _create_vision_transformer('vit_base_patch16_clip_quickgelu_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch14_clip_quickgelu_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Large model (ViT-L/14) CLIP image tower w/ QuickGELU act"""
+    model_args = dict(
+        patch_size=14, embed_dim=1024, depth=24, num_heads=16, pre_norm=True,
+        norm_layer=partial(LayerNorm, eps=1e-5), act_layer='quick_gelu'
+    )
+    return _create_vision_transformer('vit_large_patch14_clip_quickgelu_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch14_clip_quickgelu_336(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Large model (ViT-L/14) CLIP image tower @ 336x336 w/ QuickGELU act"""
+    model_args = dict(
+        patch_size=14, embed_dim=1024, depth=24, num_heads=16, pre_norm=True,
+        norm_layer=partial(LayerNorm, eps=1e-5), act_layer='quick_gelu'
+    )
+    return _create_vision_transformer('vit_large_patch14_clip_quickgelu_336', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_huge_patch14_clip_quickgelu_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Huge model (ViT-H/14) CLIP image tower w/ QuickGELU act."""
+    model_args = dict(
+        patch_size=14, embed_dim=1280, depth=32, num_heads=16, pre_norm=True,
+        norm_layer=partial(LayerNorm, eps=1e-5), act_layer='quick_gelu'
+    )
+    return _create_vision_transformer('vit_huge_patch14_clip_quickgelu_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_huge_patch14_clip_quickgelu_378(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Huge model (ViT-H/14) CLIP image tower @ 378x378 w/ QuickGELU act"""
+    model_args = dict(
+        patch_size=14, embed_dim=1280, depth=32, num_heads=16, pre_norm=True,
+        norm_layer=partial(LayerNorm, eps=1e-5), act_layer='quick_gelu'
+    )
+    return _create_vision_transformer('vit_huge_patch14_clip_quickgelu_378', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_gigantic_patch14_clip_quickgelu_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-bigG model (ViT-G/14) w/ QuickGELU act"""
+    model_args = dict(
+        patch_size=14, embed_dim=1664, mlp_ratio=64/13, depth=48, num_heads=16, pre_norm=True,
+        norm_layer=partial(LayerNorm, eps=1e-5), act_layer='quick_gelu'
+    )
+    return _create_vision_transformer('vit_gigantic_patch14_clip_quickgelu_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch32_plus_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Base (ViT-B/32+)"""
+    model_args = dict(patch_size=32, embed_dim=896, depth=12, num_heads=14, init_values=1e-5)
+    return _create_vision_transformer('vit_base_patch32_plus_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_plus_240(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Base (ViT-B/16+)"""
+    model_args = dict(patch_size=16, embed_dim=896, depth=12, num_heads=14, init_values=1e-5)
+    return _create_vision_transformer('vit_base_patch16_plus_240', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_rpn_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Base (ViT-B/16) w/ residual post-norm"""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, qkv_bias=False, init_values=1e-5,
+        class_token=False, block_fn=ResPostBlock, global_pool='avg')
+    return _create_vision_transformer('vit_base_patch16_rpn_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_small_patch16_36x1_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Base w/ LayerScale + 36 x 1 (36 block serial) config. Experimental, may remove."""
+    model_args = dict(patch_size=16, embed_dim=384, depth=36, num_heads=6, init_values=1e-5)
+    return _create_vision_transformer('vit_small_patch16_36x1_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_small_patch16_18x2_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Small w/ LayerScale + 18 x 2 (36 block parallel) config. Experimental, may remove."""
+    model_args = dict(
+        patch_size=16, embed_dim=384, depth=18, num_heads=6, init_values=1e-5, block_fn=ParallelThingsBlock)
+    return _create_vision_transformer('vit_small_patch16_18x2_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_18x2_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Base w/ LayerScale + 18 x 2 (36 block parallel) config. Experimental, may remove."""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=18, num_heads=12, init_values=1e-5, block_fn=ParallelThingsBlock)
+    return _create_vision_transformer('vit_base_patch16_18x2_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def eva_large_patch14_196(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """EVA-large model https://arxiv.org/abs/2211.07636 /via MAE MIM pretrain"""
+    model_args = dict(patch_size=14, embed_dim=1024, depth=24, num_heads=16, global_pool='avg')
+    return _create_vision_transformer('eva_large_patch14_196', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def eva_large_patch14_336(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """EVA-large model https://arxiv.org/abs/2211.07636 via MAE MIM pretrain"""
+    model_args = dict(patch_size=14, embed_dim=1024, depth=24, num_heads=16, global_pool='avg')
+    return _create_vision_transformer('eva_large_patch14_336', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def flexivit_small(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """FlexiViT-Small"""
+    model_args = dict(patch_size=16, embed_dim=384, depth=12, num_heads=6, no_embed_class=True)
+    return _create_vision_transformer('flexivit_small', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def flexivit_base(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """FlexiViT-Base"""
+    model_args = dict(patch_size=16, embed_dim=768, depth=12, num_heads=12, no_embed_class=True)
+    return _create_vision_transformer('flexivit_base', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def flexivit_large(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """FlexiViT-Large"""
+    model_args = dict(patch_size=16, embed_dim=1024, depth=24, num_heads=16, no_embed_class=True)
+    return _create_vision_transformer('flexivit_large', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_xp_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Large model (ViT-L/14) w/ parallel blocks and qk norm enabled."""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, pre_norm=True, no_embed_class=True,
+        norm_layer=RmsNorm, block_fn=ParallelScalingBlock, qkv_bias=False, qk_norm=True,
+    )
+    return _create_vision_transformer('vit_base_patch16_xp_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch14_xp_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Large model (ViT-L/14) w/ parallel blocks and qk norm enabled."""
+    model_args = dict(
+        patch_size=14, embed_dim=1024, depth=24, num_heads=16, pre_norm=True, no_embed_class=True,
+        norm_layer=RmsNorm, block_fn=ParallelScalingBlock, qkv_bias=False, qk_norm=True,
+    )
+    return _create_vision_transformer('vit_large_patch14_xp_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_huge_patch14_xp_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-Huge model (ViT-H/14) w/ parallel blocks and qk norm enabled."""
+    model_args = dict(
+        patch_size=14, embed_dim=1280, depth=32, num_heads=16, pre_norm=True, no_embed_class=True,
+        norm_layer=RmsNorm, block_fn=ParallelScalingBlock, qkv_bias=False, qk_norm=True,
+    )
+    return _create_vision_transformer('vit_huge_patch14_xp_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_small_patch14_dinov2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-S/14 for DINOv2"""
+    model_args = dict(patch_size=14, embed_dim=384, depth=12, num_heads=6, init_values=1e-5)
+    return _create_vision_transformer('vit_small_patch14_dinov2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch14_dinov2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-B/14 for DINOv2"""
+    model_args = dict(patch_size=14, embed_dim=768, depth=12, num_heads=12, init_values=1e-5)
+    return _create_vision_transformer('vit_base_patch14_dinov2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch14_dinov2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-L/14 for DINOv2"""
+    model_args = dict(patch_size=14, embed_dim=1024, depth=24, num_heads=16, init_values=1e-5)
+    return _create_vision_transformer('vit_large_patch14_dinov2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_giant_patch14_dinov2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-G/14 for DINOv2"""
+    model_args = dict(
+        patch_size=14, embed_dim=1536, depth=40, num_heads=24, init_values=1e-5,
+        mlp_ratio=2.66667 * 2, mlp_layer=SwiGLUPacked, act_layer='silu'
+    )
+    return _create_vision_transformer('vit_giant_patch14_dinov2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_small_patch14_reg4_dinov2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-S/14 for DINOv2 w/ 4 registers"""
+    model_args = dict(
+        patch_size=14, embed_dim=384, depth=12, num_heads=6, init_values=1e-5,
+        reg_tokens=4, no_embed_class=True,
+    )
+    return _create_vision_transformer('vit_small_patch14_reg4_dinov2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch14_reg4_dinov2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-B/14 for DINOv2 w/ 4 registers"""
+    model_args = dict(
+        patch_size=14, embed_dim=768, depth=12, num_heads=12, init_values=1e-5,
+        reg_tokens=4, no_embed_class=True,
+    )
+    return _create_vision_transformer('vit_base_patch14_reg4_dinov2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch14_reg4_dinov2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-L/14 for DINOv2 w/ 4 registers"""
+    model_args = dict(
+        patch_size=14, embed_dim=1024, depth=24, num_heads=16, init_values=1e-5,
+        reg_tokens=4, no_embed_class=True,
+    )
+    return _create_vision_transformer('vit_large_patch14_reg4_dinov2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_giant_patch14_reg4_dinov2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-G/14 for DINOv2"""
+    model_args = dict(
+        patch_size=14, embed_dim=1536, depth=40, num_heads=24, init_values=1e-5, mlp_ratio=2.66667 * 2,
+        mlp_layer=SwiGLUPacked, act_layer='silu', reg_tokens=4, no_embed_class=True,
+    )
+    return _create_vision_transformer('vit_giant_patch14_reg4_dinov2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch14_reg1_tipsv2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-B/14 for TIPSv2 (DINOv2-style w/ 1 register token, LayerScale init=1.0)."""
+    model_args = dict(
+        patch_size=14, embed_dim=768, depth=12, num_heads=12, init_values=1.0,
+        reg_tokens=1, no_embed_class=True,
+    )
+    return _create_vision_transformer('vit_base_patch14_reg1_tipsv2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch14_reg1_tipsv2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-L/14 for TIPSv2 (DINOv2-style w/ 1 register token, LayerScale init=1.0)."""
+    model_args = dict(
+        patch_size=14, embed_dim=1024, depth=24, num_heads=16, init_values=1.0,
+        reg_tokens=1, no_embed_class=True,
+    )
+    return _create_vision_transformer('vit_large_patch14_reg1_tipsv2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch14_reg1_tipsv2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """SoViT-400M/14 for TIPSv2 (DINOv2-style w/ 1 register token, LayerScale init=1.0)."""
+    model_args = dict(
+        patch_size=14, embed_dim=1152, depth=27, num_heads=16, init_values=1.0,
+        mlp_ratio=4304 / 1152, reg_tokens=1, no_embed_class=True,
+    )
+    return _create_vision_transformer('vit_so400m_patch14_reg1_tipsv2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_giant_patch14_reg1_tipsv2(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT-G/14 for TIPSv2 (DINOv2-style w/ SwiGLU FFN, 1 register token, LayerScale init=1.0)."""
+    model_args = dict(
+        patch_size=14, embed_dim=1536, depth=40, num_heads=24, init_values=1.0,
+        mlp_ratio=2.66667 * 2, mlp_layer=SwiGLUPacked, act_layer='silu',
+        reg_tokens=1, no_embed_class=True,
+    )
+    return _create_vision_transformer('vit_giant_patch14_reg1_tipsv2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch32_siglip_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=32, embed_dim=768, depth=12, num_heads=12, class_token=False, global_pool='map',
+        act_layer='gelu_tanh',
+    )
+    return _create_vision_transformer('vit_base_patch32_siglip_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_siglip_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, class_token=False, global_pool='map',
+    )
+    return _create_vision_transformer('vit_base_patch16_siglip_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_siglip_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, class_token=False, global_pool='map',
+    )
+    return _create_vision_transformer('vit_base_patch16_siglip_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_siglip_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, class_token=False, global_pool='map',
+    )
+    return _create_vision_transformer('vit_base_patch16_siglip_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_siglip_512(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, class_token=False, global_pool='map',
+    )
+    return _create_vision_transformer('vit_base_patch16_siglip_512', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch16_siglip_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1024, depth=24, num_heads=16, class_token=False, global_pool='map',
+    )
+    return _create_vision_transformer('vit_large_patch16_siglip_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch16_siglip_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1024, depth=24, num_heads=16, class_token=False, global_pool='map',
+    )
+    return _create_vision_transformer('vit_large_patch16_siglip_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch16_siglip_512(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1024, depth=24, num_heads=16, class_token=False, global_pool='map',
+        act_layer='gelu_tanh'
+    )
+    return _create_vision_transformer('vit_large_patch16_siglip_512', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch14_siglip_378(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=14, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362, class_token=False, global_pool='map',
+    )
+    return _create_vision_transformer('vit_so400m_patch14_siglip_378', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch14_siglip_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=14, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362, class_token=False, global_pool='map',
+    )
+    return _create_vision_transformer('vit_so400m_patch14_siglip_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch16_siglip_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362, class_token=False, global_pool='map',
+        act_layer='gelu_tanh',
+    )
+    return _create_vision_transformer('vit_so400m_patch16_siglip_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch16_siglip_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362, class_token=False, global_pool='map',
+        act_layer='gelu_tanh',
+    )
+    return _create_vision_transformer('vit_so400m_patch16_siglip_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch16_siglip_512(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362, class_token=False, global_pool='map',
+        act_layer='gelu_tanh',
+    )
+    return _create_vision_transformer('vit_so400m_patch16_siglip_512', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_giantopt_patch16_siglip_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1536, depth=40, num_heads=16, class_token=False, global_pool='map',
+        act_layer='gelu_tanh',
+    )
+    return _create_vision_transformer('vit_giantopt_patch16_siglip_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_giantopt_patch16_siglip_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1536, depth=40, num_heads=16, class_token=False, global_pool='map',
+        act_layer='gelu_tanh',
+    )
+    return _create_vision_transformer('vit_giantopt_patch16_siglip_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch32_siglip_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=32, embed_dim=768, depth=12, num_heads=12, class_token=False, global_pool='avg', fc_norm=False,
+        act_layer='gelu_tanh',
+    )
+    return _create_vision_transformer('vit_base_patch32_siglip_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_siglip_gap_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, class_token=False, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_base_patch16_siglip_gap_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_siglip_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, class_token=False, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_base_patch16_siglip_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_siglip_gap_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, class_token=False, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_base_patch16_siglip_gap_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_siglip_gap_512(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, class_token=False, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_base_patch16_siglip_gap_512', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch16_siglip_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=16, embed_dim=1024, depth=24, num_heads=16, class_token=False, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_large_patch16_siglip_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch16_siglip_gap_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=16, embed_dim=1024, depth=24, num_heads=16, class_token=False, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_large_patch16_siglip_gap_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_large_patch16_siglip_gap_512(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1024, depth=24, num_heads=16, class_token=False,
+        global_pool='avg', fc_norm=False, act_layer='gelu_tanh'
+    )
+    return _create_vision_transformer('vit_large_patch16_siglip_gap_512', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch14_siglip_gap_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=14, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362,
+        class_token=False, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_so400m_patch14_siglip_gap_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch14_siglip_gap_378(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=14, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362,
+        class_token=False, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_so400m_patch14_siglip_gap_378', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch14_siglip_gap_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=14, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362,
+        class_token=False, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_so400m_patch14_siglip_gap_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch14_siglip_gap_448(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=14, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362,
+        class_token=False, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_so400m_patch14_siglip_gap_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch14_siglip_gap_896(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=14, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362,
+        class_token=False, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_so400m_patch14_siglip_gap_896', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch16_siglip_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """A SigLIP variant of ViT with global average pooling (GAP) instead of attention pooling (MAP)."""
+    model_args = dict(
+        patch_size=16, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362,
+        class_token=False, global_pool='avg', fc_norm=False, act_layer='gelu_tanh',
+    )
+    return _create_vision_transformer('vit_so400m_patch16_siglip_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch16_siglip_gap_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362, class_token=False,
+        global_pool='avg', fc_norm=False, act_layer='gelu_tanh'
+    )
+    return _create_vision_transformer('vit_so400m_patch16_siglip_gap_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so400m_patch16_siglip_gap_512(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1152, depth=27, num_heads=16, mlp_ratio=3.7362, class_token=False,
+        global_pool='avg', fc_norm=False, act_layer='gelu_tanh'
+    )
+    return _create_vision_transformer('vit_so400m_patch16_siglip_gap_512', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_giantopt_patch16_siglip_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1536, depth=40, num_heads=16, class_token=False,
+        global_pool='avg', fc_norm=False, act_layer='gelu_tanh'
+    )
+    return _create_vision_transformer('vit_giantopt_patch16_siglip_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_giantopt_patch16_siglip_gap_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=1536, depth=40, num_heads=16, class_token=False,
+        global_pool='avg', fc_norm=False, act_layer='gelu_tanh'
+    )
+    return _create_vision_transformer('vit_giantopt_patch16_siglip_gap_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_wee_patch16_reg1_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=256, depth=14, num_heads=4, init_values=1e-5, mlp_ratio=5,
+        class_token=False, no_embed_class=True, reg_tokens=1, global_pool='avg',
+    )
+    return _create_vision_transformer('vit_wee_patch16_reg1_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_dwee_patch16_reg1_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=256, depth=14, num_heads=4, init_values=1e-5, mlp_ratio=5,
+        class_token=False, no_embed_class=True, reg_tokens=1, global_pool='avg', attn_layer='diff',
+    )
+    return _create_vision_transformer('vit_dwee_patch16_reg1_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_pwee_patch16_reg1_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=256, depth=16, num_heads=4, init_values=1e-5, mlp_ratio=5,
+        class_token=False, no_embed_class=True, reg_tokens=1, global_pool='avg', block_fn=ParallelScalingBlock,
+    )
+    return _create_vision_transformer('vit_pwee_patch16_reg1_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_dpwee_patch16_reg1_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=256, depth=16, num_heads=4, init_values=1e-5, mlp_ratio=5,
+        class_token=False, no_embed_class=True, reg_tokens=1, global_pool='avg', block_fn=DiffParallelScalingBlock,
+    )
+    return _create_vision_transformer('vit_dpwee_patch16_reg1_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_little_patch16_reg1_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=320, depth=14, num_heads=5, init_values=1e-5, mlp_ratio=5.6,
+        class_token=False, no_embed_class=True, reg_tokens=1, global_pool='avg',
+    )
+    return _create_vision_transformer('vit_little_patch16_reg1_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_medium_patch16_reg1_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=512, depth=12, num_heads=8, init_values=1e-5,
+        class_token=False, no_embed_class=True, reg_tokens=1, global_pool='avg',
+    )
+    return _create_vision_transformer('vit_medium_patch16_reg1_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_mediumd_patch16_reg4_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=512, depth=20, num_heads=8, init_values=1e-5,
+        class_token=False, no_embed_class=True, reg_tokens=4, global_pool='avg',
+    )
+    return _create_vision_transformer('vit_mediumd_patch16_reg4_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_mediumd_patch16_reg4_gap_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=512, depth=20, num_heads=8, init_values=1e-5,
+        class_token=False, no_embed_class=True, reg_tokens=4, global_pool='avg',
+    )
+    return _create_vision_transformer('vit_mediumd_patch16_reg4_gap_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_betwixt_patch16_reg1_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=640, depth=12, num_heads=10, init_values=1e-5,
+        class_token=False, no_embed_class=True, reg_tokens=1, global_pool='avg',
+    )
+    return _create_vision_transformer('vit_betwixt_patch16_reg1_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_betwixt_patch16_reg4_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=640, depth=12, num_heads=10, init_values=1e-5,
+        class_token=False, no_embed_class=True, reg_tokens=4, global_pool='avg',
+    )
+    return _create_vision_transformer('vit_betwixt_patch16_reg4_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_betwixt_patch16_reg4_gap_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=640, depth=12, num_heads=10, init_values=1e-5,
+        class_token=False, no_embed_class=True, reg_tokens=4, global_pool='avg',
+    )
+    return _create_vision_transformer('vit_betwixt_patch16_reg4_gap_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_base_patch16_reg4_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, class_token=False,
+        no_embed_class=True, global_pool='avg', reg_tokens=4,
+    )
+    return _create_vision_transformer('vit_base_patch16_reg4_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so150m_patch16_reg4_map_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """SO150M (shape optimized, but diff than paper def, optimized for GPU)"""
+    model_args = dict(
+        patch_size=16, embed_dim=896, depth=18, num_heads=14, mlp_ratio=2.572,
+        class_token=False, reg_tokens=4, global_pool='map',
+    )
+    return _create_vision_transformer('vit_so150m_patch16_reg4_map_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so150m_patch16_reg4_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """SO150M (shape optimized, but diff than paper def, optimized for GPU)"""
+    model_args = dict(
+        patch_size=16, embed_dim=896, depth=18, num_heads=14, mlp_ratio=2.572,
+        class_token=False, reg_tokens=4, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_so150m_patch16_reg4_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so150m_patch16_reg4_gap_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """SO150M (shape optimized, but diff than paper def, optimized for GPU)"""
+    model_args = dict(
+        patch_size=16, embed_dim=896, depth=18, num_heads=14, mlp_ratio=2.572,
+        class_token=False, reg_tokens=4, global_pool='avg', fc_norm=False,
+    )
+    return _create_vision_transformer('vit_so150m_patch16_reg4_gap_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so150m2_patch16_reg1_gap_256(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """SO150M v2 (shape optimized, but diff than paper def, optimized for GPU)"""
+    model_args = dict(
+        patch_size=16, embed_dim=832, depth=21, num_heads=13, mlp_ratio=34/13, init_values=1e-5,
+        qkv_bias=False, class_token=False, reg_tokens=1, global_pool='avg',
+    )
+    return _create_vision_transformer('vit_so150m2_patch16_reg1_gap_256', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so150m2_patch16_reg1_gap_384(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """SO150M v2 (shape optimized, but diff than paper def, optimized for GPU)"""
+    model_args = dict(
+        patch_size=16, embed_dim=832, depth=21, num_heads=13, mlp_ratio=34/13, init_values=1e-5,
+        qkv_bias=False, class_token=False, reg_tokens=1, global_pool='avg',
+    )
+    return _create_vision_transformer('vit_so150m2_patch16_reg1_gap_384', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_so150m2_patch16_reg1_gap_448(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """SO150M v2 (shape optimized, but diff than paper def, optimized for GPU)"""
+    model_args = dict(
+        patch_size=16, embed_dim=832, depth=21, num_heads=13, mlp_ratio=34/13, init_values=1e-5,
+        qkv_bias=False, class_token=False, reg_tokens=1, global_pool='avg',
+    )
+    return _create_vision_transformer('vit_so150m2_patch16_reg1_gap_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def vit_intern300m_patch14_448(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    model_args = dict(
+        patch_size=14, embed_dim=1024, depth=24, num_heads=16,
+        init_values=0.1, final_norm=False, dynamic_img_size=True,
+    )
+    return _create_vision_transformer('vit_intern300m_patch14_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_large_patch14_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT Large AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=1024, depth=24, num_heads=8, class_token=False, fc_norm=False,
+        mlp_ratio=2.75, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_large_patch14_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_huge_patch14_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT Huge AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=1536, depth=24, num_heads=12, class_token=False, fc_norm=False,
+        mlp_ratio=2.6667, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_huge_patch14_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_1b_patch14_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT 1B AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=2048, depth=24, num_heads=16, class_token=False, fc_norm=False,
+        mlp_ratio=2.75, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_1b_patch14_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_3b_patch14_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT 3B AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=3072, depth=24, num_heads=24, class_token=False, fc_norm=False,
+        mlp_ratio=2.6667, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_3b_patch14_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_large_patch14_336(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT Large AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=1024, depth=24, num_heads=8, class_token=False, fc_norm=False,
+        mlp_ratio=2.75, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_large_patch14_336', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_huge_patch14_336(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT Huge AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=1536, depth=24, num_heads=12, class_token=False, fc_norm=False,
+        mlp_ratio=2.6667, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_huge_patch14_336', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_1b_patch14_336(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT 1B AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=2048, depth=24, num_heads=16, class_token=False, fc_norm=False,
+        mlp_ratio=2.75, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_1b_patch14_336', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_3b_patch14_336(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT 3B AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=3072, depth=24, num_heads=24, class_token=False, fc_norm=False,
+        mlp_ratio=2.6667, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_3b_patch14_336', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_large_patch14_448(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT Large AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=1024, depth=24, num_heads=8, class_token=False, fc_norm=False,
+        mlp_ratio=2.75, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_large_patch14_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_huge_patch14_448(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT Huge AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=1536, depth=24, num_heads=12, class_token=False, fc_norm=False,
+        mlp_ratio=2.6667, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_huge_patch14_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_1b_patch14_448(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT 1B AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=2048, depth=24, num_heads=16, class_token=False, fc_norm=False,
+        mlp_ratio=2.75, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_1b_patch14_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def aimv2_3b_patch14_448(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """ViT 3B AIM-v2 model"""
+    model_args = dict(
+        patch_size=14, embed_dim=3072, depth=24, num_heads=24, class_token=False, fc_norm=False,
+        mlp_ratio=2.6667, global_pool='avg', qkv_bias=False, proj_bias=False, act_layer='silu',
+        norm_layer=partial(RmsNorm, eps=1e-5), embed_norm_layer=partial(RmsNorm, eps=1e-5), mlp_layer=SwiGLU,
+    )
+    return _create_vision_transformer('aimv2_3b_patch14_448', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def beit3_base_patch16_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """BEiT3 Base model (ViT-Base size) with patch size 16x16."""
+    model_args = dict(
+        patch_size=16, embed_dim=768, depth=12, num_heads=12, mlp_ratio=4,
+        scale_attn_norm=True, scale_mlp_norm=True, class_token=True, global_pool='avg',
+        norm_layer=partial(LayerNorm, eps=1e-5)
+    )
+    return _create_vision_transformer('beit3_base_patch16_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def beit3_large_patch16_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """BEiT3 Large model (ViT-Large size) with patch size 16x16."""
+    model_args = dict(
+        patch_size=16, embed_dim=1024, depth=24, num_heads=16, mlp_ratio=4,
+        scale_attn_norm=True, scale_mlp_norm=True, class_token=True, global_pool='avg',
+        norm_layer=partial(LayerNorm, eps=1e-5),
+    )
+    return _create_vision_transformer('beit3_large_patch16_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def beit3_giant_patch14_224(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """BEiT3 Giant model with patch size 14x14."""
+    model_args = dict(
+        patch_size=14, embed_dim=1408, depth=40, num_heads=16, mlp_ratio=4.3637,
+        scale_attn_norm=True, scale_mlp_norm=True, class_token=True, global_pool='avg',
+        norm_layer=partial(LayerNorm, eps=1e-5),
+    )
+    return _create_vision_transformer('beit3_giant_patch14_224', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def beit3_giant_patch14_336(pretrained: bool = False, **kwargs) -> VisionTransformer:
+    """BEiT3 Giant model with patch size 14x14 and image size 336x336."""
+    model_args = dict(
+        img_size=336, patch_size=14, embed_dim=1408, depth=40, num_heads=16, mlp_ratio=4.3637,
+        scale_attn_norm=True, scale_mlp_norm=True, class_token=True, global_pool='avg',
+        norm_layer=partial(LayerNorm, eps=1e-5),
+    )
+    return _create_vision_transformer('beit3_giant_patch14_336', pretrained=pretrained, **dict(model_args, **kwargs))

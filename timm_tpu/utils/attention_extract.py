@@ -57,14 +57,15 @@ class AttentionExtract:
         if getattr(attn, 'k_norm', None) is not None:
             k = attn.k_norm(k)
         if rope is not None:
+            half = getattr(attn, 'rotate_half', False)
             num_prefix = N - rope.shape[-2]
             if num_prefix > 0:
                 q = jnp.concatenate(
-                    [q[..., :num_prefix, :], apply_rot_embed_cat(q[..., num_prefix:, :], rope)], axis=-2)
+                    [q[..., :num_prefix, :], apply_rot_embed_cat(q[..., num_prefix:, :], rope, half=half)], axis=-2)
                 k = jnp.concatenate(
-                    [k[..., :num_prefix, :], apply_rot_embed_cat(k[..., num_prefix:, :], rope)], axis=-2)
+                    [k[..., :num_prefix, :], apply_rot_embed_cat(k[..., num_prefix:, :], rope, half=half)], axis=-2)
             else:
-                q, k = apply_rot_embed_cat(q, rope), apply_rot_embed_cat(k, rope)
+                q, k = apply_rot_embed_cat(q, rope, half=half), apply_rot_embed_cat(k, rope, half=half)
         scores = jnp.einsum('bhqd,bhkd->bhqk', q * attn.scale, k)
         return jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
 
@@ -73,8 +74,16 @@ class AttentionExtract:
         need = sorted({i - 1 for i in self.indices if i > 0})
         inputs = {}
         if any(i == 0 for i in self.indices):
+            grid = None
+            if getattr(self.model, 'dynamic_img_size', False):
+                grid = self.model.patch_embed.dynamic_feat_size(x.shape[1:3])
             tokens0 = self.model.patch_embed(x)
-            tokens0 = self.model._pos_embed(tokens0)
+            try:
+                tokens0 = self.model._pos_embed(tokens0, grid_size=grid)
+            except TypeError:
+                tokens0 = self.model._pos_embed(tokens0)
+            if isinstance(tokens0, tuple):  # Eva returns (tokens, rope table)
+                tokens0 = tokens0[0]
             if getattr(self.model, 'norm_pre', None) is not None:
                 tokens0 = self.model.norm_pre(tokens0)
             inputs[0] = tokens0
@@ -89,13 +98,19 @@ class AttentionExtract:
 
         rope = None
         if getattr(self.model, 'rope', None) is not None:
-            rope = self.model.rope.get_embed()
+            # dynamic-size models cache no feat_shape — derive the grid from x
+            shape = None
+            if self.model.rope.feat_shape is None:
+                shape = self.model.patch_embed.dynamic_feat_size(x.shape[1:3])
+            rope = self.model.rope.get_embed(shape)
 
         out = {}
         for i in self.indices:
             blk = self.model.blocks[i]
+            # mixed rope: per-depth table (depth, num_heads, N, head_dim)
+            blk_rope = rope[i] if (rope is not None and getattr(self.model, 'rope_mixed', False)) else rope
             # post-norm blocks (ResPost*) feed attention the RAW residual stream
             post_norm = 'ResPost' in type(blk).__name__
             tokens = inputs[i] if post_norm else blk.norm1(inputs[i])
-            out[f'blocks.{i}.attn'] = self._scores(blk.attn, tokens, rope=rope)
+            out[f'blocks.{i}.attn'] = self._scores(blk.attn, tokens, rope=blk_rope)
         return out

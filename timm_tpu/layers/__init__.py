@@ -1,4 +1,5 @@
 from .attention import Attention, AttentionRope, maybe_add_mask, scaled_dot_product_attention
+from .attention2d import Attention2d, MultiQueryAttention2d, MultiQueryAttentionV2
 from .attention_pool import AttentionPool2d, AttentionPoolLatent, RotAttentionPool2d
 from .classifier import ClNormMlpClassifierHead, ClassifierHead, NormMlpClassifierHead, create_classifier
 from .config import (

@@ -1,0 +1,268 @@
+"""2D (spatial) attention for conv nets, NHWC
+(reference: timm/layers/attention2d.py:1-380).
+
+TPU notes: everything stays NHWC end-to-end — the reference's NCHW permute
+dance disappears because a 1x1 conv on NHWC IS the (B*H*W, C) matmul the MXU
+wants. The multi-query variant's spatial down/upsampling (query avg-pool,
+key/value strided dw conv, bilinear output upsample) are static-shape ops XLA
+fuses around the single batched attention matmul.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from .attention import maybe_add_mask
+from .create_conv2d import create_conv2d
+from .drop import Dropout, dropout_rng_key
+from .helpers import to_2tuple
+
+__all__ = ['MultiQueryAttentionV2', 'MultiQueryAttention2d', 'Attention2d']
+
+
+def _avg_pool2d(x, kernel, stride=None, same: bool = False):
+    stride = stride or kernel
+    k = to_2tuple(kernel)
+    s = to_2tuple(stride)
+    pad = 'SAME' if same else 'VALID'
+    out = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, k[0], k[1], 1), (1, s[0], s[1], 1), pad)
+    return out / (k[0] * k[1])
+
+
+class MultiQueryAttentionV2(nnx.Module):
+    """Multi-query attention (one shared K/V head) over flattened spatial
+    positions (reference attention2d.py:13-92). Einsum-first layout."""
+
+    def __init__(
+            self,
+            dim: int,
+            dim_out: Optional[int] = None,
+            num_heads: int = 8,
+            key_dim: int = 64,
+            value_dim: int = 64,
+            attn_drop: float = 0.0,
+            proj_drop: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        dim_out = dim_out or dim
+        self.num_heads = num_heads
+        self.key_dim = key_dim
+        self.value_dim = value_dim
+        self.scale = key_dim ** -0.5
+        scale_init = dim ** -0.5
+        k = jax.random.split(rngs.params(), 4)
+        self.query_proj = nnx.Param(jax.random.normal(k[0], (num_heads, key_dim, dim), param_dtype) * scale_init)
+        self.key_proj = nnx.Param(jax.random.normal(k[1], (dim, key_dim), param_dtype) * scale_init)
+        self.value_proj = nnx.Param(jax.random.normal(k[2], (dim, value_dim), param_dtype) * scale_init)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.out_proj = nnx.Param(jax.random.normal(k[3], (dim_out, num_heads, value_dim), param_dtype) * dim_out ** -0.5)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x, m=None):
+        B, H, W, C = x.shape
+        m = m if m is not None else x
+        xr = x.reshape(B, -1, C)
+        mr = m.reshape(B, -1, m.shape[-1])
+        q = jnp.einsum('bnd,hkd->bnhk', xr, self.query_proj[...].astype(x.dtype))
+        k = jnp.einsum('bmd,dk->bmk', mr, self.key_proj[...].astype(x.dtype))
+        attn = jnp.einsum('bnhk,bmk->bnhm', q, k) * self.scale
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = self.attn_drop(attn)
+        v = jnp.einsum('bmd,dv->bmv', mr, self.value_proj[...].astype(x.dtype))
+        o = jnp.einsum('bnhm,bmv->bnhv', attn, v)
+        out = jnp.einsum('bnhv,dhv->bnd', o, self.out_proj[...].astype(x.dtype))
+        out = self.proj_drop(out)
+        return out.reshape(B, H, W, -1)
+
+
+class _QueryDown(nnx.Module):
+    """query branch: optional avg-pool down + norm, then 1x1 proj
+    (keeps the reference's ``query.{down_pool,norm,proj}`` state names)."""
+
+    def __init__(self, dim, out_dim, query_strides, norm_layer, use_bias, pad_same,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.query_strides = query_strides
+        self.pad_same = pad_same
+        has_stride = any(s > 1 for s in query_strides)
+        self.norm = norm_layer(dim, rngs=rngs) if has_stride else None
+        self.proj = create_conv2d(
+            dim, out_dim, 1, bias=use_bias, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        if self.norm is not None:
+            x = _avg_pool2d(x, self.query_strides, same=self.pad_same)
+            x = self.norm(x)
+        return self.proj(x)
+
+
+class _KvDown(nnx.Module):
+    """key/value branch: optional strided dw down conv + norm, then 1x1 proj
+    (reference ``key.{down_conv,norm,proj}``)."""
+
+    def __init__(self, dim, out_dim, kv_stride, dw_kernel_size, dilation, padding,
+                 norm_layer, use_bias, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        if kv_stride > 1:
+            self.down_conv = create_conv2d(
+                dim, dim, dw_kernel_size, stride=kv_stride, dilation=dilation,
+                padding=padding, depthwise=True, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.norm = norm_layer(dim, rngs=rngs)
+        else:
+            self.down_conv = None
+            self.norm = None
+        self.proj = create_conv2d(
+            dim, out_dim, 1, bias=use_bias, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        if self.down_conv is not None:
+            x = self.norm(self.down_conv(x))
+        return self.proj(x)
+
+
+class _UpProj(nnx.Module):
+    """output branch: optional bilinear upsample then 1x1 proj
+    (reference ``output.{upsample,proj,drop}``)."""
+
+    def __init__(self, dim, out_dim, query_strides, proj_drop, use_bias,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.query_strides = query_strides
+        self.upsample = any(s > 1 for s in query_strides)
+        self.proj = create_conv2d(
+            dim, out_dim, 1, bias=use_bias, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x):
+        if self.upsample:
+            B, H, W, C = x.shape
+            # torch Upsample(bilinear, align_corners=False)
+            x = jax.image.resize(
+                x, (B, H * self.query_strides[0], W * self.query_strides[1], C), method='bilinear')
+        return self.drop(self.proj(x))
+
+
+class MultiQueryAttention2d(nnx.Module):
+    """Multi-query attention with spatial down-sampling on Q (avg pool) and
+    K/V (strided dw conv), and bilinear upsampling of the output
+    (reference attention2d.py:94-318)."""
+
+    def __init__(
+            self,
+            dim: int,
+            dim_out: Optional[int] = None,
+            num_heads: int = 8,
+            key_dim: Optional[int] = None,
+            value_dim: Optional[int] = None,
+            query_strides: Union[int, tuple] = 1,
+            kv_stride: int = 1,
+            dw_kernel_size: int = 3,
+            dilation: int = 1,
+            padding: Union[str, int, List[int]] = '',
+            attn_drop: float = 0.0,
+            proj_drop: float = 0.0,
+            norm_layer: Optional[Callable] = None,
+            use_bias: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        from .norm import BatchNorm2d
+        norm_layer = norm_layer or BatchNorm2d
+        dim_out = dim_out or dim
+        self.num_heads = num_heads
+        self.key_dim = key_dim or dim // num_heads
+        self.value_dim = value_dim or dim // num_heads
+        self.query_strides = to_2tuple(query_strides)
+        self.kv_stride = kv_stride
+        self.scale = self.key_dim ** -0.5
+        self.attn_drop_rate = attn_drop
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        self.query = _QueryDown(
+            dim, num_heads * self.key_dim, self.query_strides, norm_layer, use_bias,
+            pad_same=padding == 'same', **kw)
+        self.key = _KvDown(
+            dim, self.key_dim, kv_stride, dw_kernel_size, dilation, padding, norm_layer, use_bias, **kw)
+        self.value = _KvDown(
+            dim, self.value_dim, kv_stride, dw_kernel_size, dilation, padding, norm_layer, use_bias, **kw)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.output = _UpProj(
+            self.value_dim * num_heads, dim_out, self.query_strides, proj_drop, use_bias, **kw)
+
+    def __call__(self, x, attn_mask=None):
+        B, H, W, C = x.shape
+        q = self.query(x)   # (B, H/qs, W/qs, h*k)
+        k = self.key(x)     # (B, H/kv, W/kv, k)
+        v = self.value(x)   # (B, H/kv, W/kv, v)
+        num_q = q.shape[1] * q.shape[2]
+        q = q.reshape(B, num_q, self.num_heads, self.key_dim)
+        k = k.reshape(B, -1, self.key_dim)
+        v = v.reshape(B, -1, self.value_dim)
+
+        attn = jnp.einsum('blhk,bpk->blhp', q, k) * self.scale
+        attn = maybe_add_mask(attn, attn_mask)
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = self.attn_drop(attn)
+        o = jnp.einsum('blhp,bpv->blhv', attn, v)   # (B, L, h, v)
+        o = o.reshape(B, H // self.query_strides[0], W // self.query_strides[1], -1)
+        return self.output(o)
+
+
+class Attention2d(nnx.Module):
+    """Multi-head attention over flattened spatial positions of an NHWC map
+    (reference attention2d.py:320-380)."""
+
+    def __init__(
+            self,
+            dim: int,
+            dim_out: Optional[int] = None,
+            num_heads: int = 32,
+            bias: bool = True,
+            expand_first: bool = False,
+            head_first: bool = False,
+            attn_drop: float = 0.0,
+            proj_drop: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        dim_out = dim_out or dim
+        dim_attn = dim_out if expand_first else dim
+        self.num_heads = num_heads
+        self.dim_head = dim_attn // num_heads
+        self.head_first = head_first
+        self.scale = self.dim_head ** -0.5
+        self.qkv = create_conv2d(
+            dim, dim_attn * 3, 1, bias=bias, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.attn_drop = Dropout(attn_drop, rngs=rngs)
+        self.proj = create_conv2d(
+            dim_attn, dim_out, 1, bias=bias, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.proj_drop = Dropout(proj_drop, rngs=rngs)
+
+    def __call__(self, x, attn_mask=None):
+        B, H, W, C = x.shape
+        N = H * W
+        qkv = self.qkv(x).reshape(B, N, -1)
+        if self.head_first:
+            qkv = qkv.reshape(B, N, self.num_heads, 3 * self.dim_head)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            qkv = qkv.reshape(B, N, 3, self.num_heads, self.dim_head)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        attn = (q * self.scale) @ k.transpose(0, 1, 3, 2)
+        attn = maybe_add_mask(attn, attn_mask)
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = self.attn_drop(attn)
+        x = (attn @ v).transpose(0, 2, 1, 3).reshape(B, H, W, -1)
+        x = self.proj(x)
+        return self.proj_drop(x)

@@ -12,10 +12,12 @@ __all__ = ['BlurPool2d', 'AvgPool2dAA', 'get_aa_layer']
 class BlurPool2d(nnx.Module):
     """Fixed binomial low-pass filter + stride (Zhang 2019), NHWC depthwise."""
 
-    def __init__(self, channels: int, filt_size: int = 3, stride: int = 2, *, rngs=None):
+    def __init__(self, channels: int, filt_size: int = 3, stride: int = 2,
+                 pad_mode: str = 'reflect', *, rngs=None):
         assert filt_size > 1
         self.channels = channels
         self.stride = stride
+        self.pad_mode = pad_mode
         coeffs = np.poly1d((0.5, 0.5)) ** (filt_size - 1)
         blur_1d = np.asarray(coeffs.coeffs, np.float32)
         blur_2d = blur_1d[:, None] * blur_1d[None, :]
@@ -26,7 +28,7 @@ class BlurPool2d(nnx.Module):
     def __call__(self, x):
         pad = (self.filt_size - 1) // 2
         pad_cfg = [(0, 0), (pad, self.filt_size - 1 - pad), (pad, self.filt_size - 1 - pad), (0, 0)]
-        x = jnp.pad(x, pad_cfg, mode='reflect')
+        x = jnp.pad(x, pad_cfg, mode=self.pad_mode)
         return jax.lax.conv_general_dilated(
             x, self._kernel.astype(x.dtype),
             window_strides=(self.stride, self.stride),
@@ -62,6 +64,7 @@ def get_aa_layer(aa_layer):
     if name in ('blur', 'blurpool'):
         return BlurPool2d
     if name == 'blurpc':
+        # constant-pad BlurPool (reference blur_pool.py:97-99)
         import functools
-        return functools.partial(BlurPool2d, filt_size=4)
+        return functools.partial(BlurPool2d, pad_mode='constant')
     raise ValueError(f'Unknown anti-aliasing layer {aa_layer}')

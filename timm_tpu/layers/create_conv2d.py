@@ -61,14 +61,33 @@ def create_conv2d(
         groups: int = 1,
         bias: bool = False,
         depthwise: bool = False,
+        num_experts: int = 0,
         *,
         dtype=None,
         param_dtype=jnp.float32,
         rngs: nnx.Rngs,
-) -> nnx.Conv:
-    """NHWC conv with timm argument conventions (conv weights are HWIO)."""
+):
+    """NHWC conv with timm argument conventions (conv weights are HWIO).
+
+    Dispatches like the reference create_conv2d (create_conv2d.py:1-36):
+    a list kernel_size → MixedConv2d, num_experts > 0 → CondConv2d, else
+    a plain nnx.Conv.
+    """
+    if isinstance(kernel_size, list):
+        from .mixed_conv2d import MixedConv2d
+        assert num_experts == 0
+        return MixedConv2d(
+            in_channels, out_channels, kernel_size, stride=stride, padding=padding,
+            dilation=dilation, depthwise=depthwise or groups == in_channels, bias=bias,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
     if depthwise:
         groups = in_channels
+    if num_experts > 0:
+        from .cond_conv2d import CondConv2d
+        return CondConv2d(
+            in_channels, out_channels, kernel_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, bias=bias, num_experts=num_experts,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
     kernel_size = to_2tuple(kernel_size)
     return nnx.Conv(
         in_channels, out_channels,

@@ -87,6 +87,7 @@ class GroupNormAct(GroupNorm):
             num_groups: int = 32,
             eps: float = 1e-5,
             affine: bool = True,
+            group_size: int = None,
             apply_act: bool = True,
             act_layer: Union[str, Callable, None] = 'relu',
             act_kwargs=None,
@@ -96,6 +97,10 @@ class GroupNormAct(GroupNorm):
             param_dtype=jnp.float32,
             rngs: nnx.Rngs,
     ):
+        if group_size:
+            # channels-per-group spec overrides num_groups (reference norm_act.py _num_groups)
+            assert num_channels % group_size == 0
+            num_groups = num_channels // group_size
         super().__init__(
             num_channels, num_groups=num_groups, eps=eps, affine=affine,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs,

@@ -5,12 +5,20 @@ from __future__ import annotations
 
 from typing import Callable, Optional, Union
 
+import jax
 import jax.numpy as jnp
 from flax import nnx
 
-from ..layers import BatchNormAct2d, DropPath, SqueezeExcite, create_conv2d, get_act_fn, make_divisible
+from ..layers import (
+    Attention2d, BatchNormAct2d, ConvNormAct, DropPath, LayerScale,
+    MultiQueryAttention2d, SqueezeExcite, create_conv2d, get_aa_layer,
+    get_act_fn, make_divisible, to_2tuple,
+)
 
-__all__ = ['ConvBnAct', 'DepthwiseSeparableConv', 'InvertedResidual', 'EdgeResidual', 'SqueezeExcite']
+__all__ = [
+    'ConvBnAct', 'DepthwiseSeparableConv', 'InvertedResidual', 'CondConvResidual',
+    'UniversalInvertedResidual', 'MobileAttention', 'EdgeResidual', 'SqueezeExcite',
+]
 
 
 def num_groups(group_size, channels):
@@ -33,6 +41,7 @@ class ConvBnAct(nnx.Module):
             skip: bool = False,
             act_layer: Union[str, Callable] = 'relu',
             norm_layer: Callable = BatchNormAct2d,
+            aa_layer: Optional[Callable] = None,
             drop_path_rate: float = 0.0,
             *,
             dtype=None,
@@ -41,10 +50,14 @@ class ConvBnAct(nnx.Module):
     ):
         groups = num_groups(group_size, in_chs)
         self.has_skip = skip and stride == 1 and in_chs == out_chs
+        aa_layer = get_aa_layer(aa_layer)
+        use_aa = aa_layer is not None and stride > 1
         self.conv = create_conv2d(
-            in_chs, out_chs, kernel_size, stride=stride, dilation=dilation, groups=groups,
+            in_chs, out_chs, kernel_size, stride=1 if use_aa else stride,
+            dilation=dilation, groups=groups,
             padding=pad_type or None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(out_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.aa = aa_layer(channels=out_chs, stride=stride, rngs=rngs) if use_aa else None
         self.drop_path = DropPath(drop_path_rate, rngs=rngs)
 
     def feature_info(self, location):
@@ -53,6 +66,8 @@ class ConvBnAct(nnx.Module):
     def __call__(self, x):
         shortcut = x
         x = self.bn1(self.conv(x))
+        if self.aa is not None:
+            x = self.aa(x)
         if self.has_skip:
             x = self.drop_path(x) + shortcut
         return x
@@ -73,8 +88,10 @@ class DepthwiseSeparableConv(nnx.Module):
             noskip: bool = False,
             pw_kernel_size: int = 1,
             pw_act: bool = False,
+            s2d: int = 0,
             act_layer: Union[str, Callable] = 'relu',
             norm_layer: Callable = BatchNormAct2d,
+            aa_layer: Optional[Callable] = None,
             se_layer: Optional[Callable] = None,
             drop_path_rate: float = 0.0,
             *,
@@ -84,11 +101,31 @@ class DepthwiseSeparableConv(nnx.Module):
     ):
         self.has_skip = (stride == 1 and in_chs == out_chs) and not noskip
         self.has_pw_act = pw_act
+        aa_layer = get_aa_layer(aa_layer)
+        use_aa = aa_layer is not None and stride > 1
 
+        # space-to-depth: 2x2/s2 conv front (reference _efficientnet_blocks.py:176-185)
+        if s2d == 1:
+            sd_chs = int(in_chs * 4)
+            self.conv_s2d = create_conv2d(
+                in_chs, sd_chs, 2, stride=2, padding='same',
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.bn_s2d = norm_layer(sd_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            dw_kernel_size = (dw_kernel_size + 1) // 2
+            dw_pad_type = 'same' if dw_kernel_size == 2 else pad_type
+            in_chs = sd_chs
+            use_aa = False
+        else:
+            self.conv_s2d = None
+            self.bn_s2d = None
+            dw_pad_type = pad_type
+
+        groups = num_groups(group_size, in_chs)
         self.conv_dw = create_conv2d(
-            in_chs, in_chs, dw_kernel_size, stride=stride, dilation=dilation,
-            depthwise=True, padding=pad_type or None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            in_chs, in_chs, dw_kernel_size, stride=1 if use_aa else stride, dilation=dilation,
+            groups=groups, padding=dw_pad_type or None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(in_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.aa = aa_layer(channels=in_chs, stride=stride, rngs=rngs) if use_aa else None
         self.se = se_layer(in_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
             if se_layer else None
         self.conv_pw = create_conv2d(
@@ -104,7 +141,11 @@ class DepthwiseSeparableConv(nnx.Module):
 
     def __call__(self, x):
         shortcut = x
+        if self.conv_s2d is not None:
+            x = self.bn_s2d(self.conv_s2d(x))
         x = self.bn1(self.conv_dw(x))
+        if self.aa is not None:
+            x = self.aa(x)
         if self.se is not None:
             x = self.se(x)
         x = self.bn2(self.conv_pw(x))
@@ -129,31 +170,57 @@ class InvertedResidual(nnx.Module):
             exp_ratio: float = 1.0,
             exp_kernel_size: int = 1,
             pw_kernel_size: int = 1,
+            s2d: int = 0,
             act_layer: Union[str, Callable] = 'relu',
             norm_layer: Callable = BatchNormAct2d,
+            aa_layer: Optional[Callable] = None,
             se_layer: Optional[Callable] = None,
+            conv_kwargs: Optional[dict] = None,
             drop_path_rate: float = 0.0,
             *,
             dtype=None,
             param_dtype=jnp.float32,
             rngs: nnx.Rngs,
     ):
-        mid_chs = make_divisible(in_chs * exp_ratio)
+        conv_kwargs = conv_kwargs or {}
         self.has_skip = (in_chs == out_chs and stride == 1) and not noskip
+        aa_layer = get_aa_layer(aa_layer)
+        use_aa = aa_layer is not None and stride > 1
+
+        # space-to-depth front (reference _efficientnet_blocks.py:276-287)
+        if s2d == 1:
+            sd_chs = int(in_chs * 4)
+            self.conv_s2d = create_conv2d(
+                in_chs, sd_chs, 2, stride=2, padding='same',
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.bn_s2d = norm_layer(sd_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            dw_kernel_size = (dw_kernel_size + 1) // 2
+            dw_pad_type = 'same' if dw_kernel_size == 2 else pad_type
+            in_chs = sd_chs
+            use_aa = False
+        else:
+            self.conv_s2d = None
+            self.bn_s2d = None
+            dw_pad_type = pad_type
+
+        mid_chs = make_divisible(in_chs * exp_ratio)
+        groups = num_groups(group_size, mid_chs)
 
         self.conv_pw = create_conv2d(
             in_chs, mid_chs, exp_kernel_size, padding=pad_type or None,
-            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs, **conv_kwargs)
         self.bn1 = norm_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.conv_dw = create_conv2d(
-            mid_chs, mid_chs, dw_kernel_size, stride=stride, dilation=dilation,
-            depthwise=True, padding=pad_type or None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            mid_chs, mid_chs, dw_kernel_size, stride=1 if use_aa else stride, dilation=dilation,
+            groups=groups, padding=dw_pad_type or None, dtype=dtype, param_dtype=param_dtype,
+            rngs=rngs, **conv_kwargs)
         self.bn2 = norm_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.aa = aa_layer(channels=mid_chs, stride=stride, rngs=rngs) if use_aa else None
         self.se = se_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
             if se_layer else None
         self.conv_pwl = create_conv2d(
             mid_chs, out_chs, pw_kernel_size, padding=pad_type or None,
-            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs, **conv_kwargs)
         self.bn3 = norm_layer(out_chs, apply_act=False, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.drop_path = DropPath(drop_path_rate, rngs=rngs)
 
@@ -162,11 +229,252 @@ class InvertedResidual(nnx.Module):
 
     def __call__(self, x):
         shortcut = x
+        if self.conv_s2d is not None:
+            x = self.bn_s2d(self.conv_s2d(x))
         x = self.bn1(self.conv_pw(x))
         x = self.bn2(self.conv_dw(x))
+        if self.aa is not None:
+            x = self.aa(x)
         if self.se is not None:
             x = self.se(x)
         x = self.bn3(self.conv_pwl(x))
+        if self.has_skip:
+            x = self.drop_path(x) + shortcut
+        return x
+
+
+class CondConvResidual(InvertedResidual):
+    """Inverted residual with CondConv expert routing
+    (reference _efficientnet_blocks.py:612-677): a sigmoid routing head over
+    globally-pooled input mixes per-example expert kernels for all three convs."""
+
+    def __init__(
+            self,
+            in_chs: int,
+            out_chs: int,
+            num_experts: int = 0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+            **kwargs,
+    ):
+        self.num_experts = num_experts
+        super().__init__(
+            in_chs, out_chs, conv_kwargs=dict(num_experts=num_experts),
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs, **kwargs)
+        from ..layers import trunc_normal_, zeros_
+        self.routing_fn = nnx.Linear(
+            in_chs, num_experts, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        shortcut = x
+        pooled = x.mean(axis=(1, 2))  # CondConv routing over NHWC spatial dims
+        routing_weights = jax.nn.sigmoid(self.routing_fn(pooled))
+        x = self.bn1(self.conv_pw(x, routing_weights))
+        x = self.bn2(self.conv_dw(x, routing_weights))
+        if self.se is not None:
+            x = self.se(x)
+        x = self.bn3(self.conv_pwl(x, routing_weights))
+        if self.has_skip:
+            x = self.drop_path(x) + shortcut
+        return x
+
+
+class UniversalInvertedResidual(nnx.Module):
+    """Universal Inverted Bottleneck (MobileNetV4)
+    (reference _efficientnet_blocks.py:342-489): optional dw at start/mid/end
+    around the pw expand/project, with layer scale."""
+
+    def __init__(
+            self,
+            in_chs: int,
+            out_chs: int,
+            dw_kernel_size_start: int = 0,
+            dw_kernel_size_mid: int = 3,
+            dw_kernel_size_end: int = 0,
+            stride: int = 1,
+            dilation: int = 1,
+            group_size: int = 1,
+            pad_type: str = '',
+            noskip: bool = False,
+            exp_ratio: float = 1.0,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            aa_layer: Optional[Callable] = None,
+            se_layer: Optional[Callable] = None,
+            conv_kwargs: Optional[dict] = None,
+            drop_path_rate: float = 0.0,
+            layer_scale_init_value: Optional[float] = 1e-5,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        conv_kwargs = conv_kwargs or {}
+        self.has_skip = (in_chs == out_chs and stride == 1) and not noskip
+        if stride > 1:
+            assert dw_kernel_size_start or dw_kernel_size_mid or dw_kernel_size_end
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        if dw_kernel_size_start:
+            dw_start_stride = stride if not dw_kernel_size_mid else 1
+            self.dw_start = ConvNormAct(
+                in_chs, in_chs, dw_kernel_size_start, stride=dw_start_stride, dilation=dilation,
+                groups=num_groups(group_size, in_chs), padding=pad_type or None, apply_act=False,
+                act_layer=act_layer, norm_layer=norm_layer, aa_layer=aa_layer, **conv_kwargs, **kw)
+        else:
+            self.dw_start = None
+
+        mid_chs = make_divisible(in_chs * exp_ratio)
+        self.pw_exp = ConvNormAct(
+            in_chs, mid_chs, 1, padding=pad_type or None,
+            act_layer=act_layer, norm_layer=norm_layer, **conv_kwargs, **kw)
+
+        if dw_kernel_size_mid:
+            self.dw_mid = ConvNormAct(
+                mid_chs, mid_chs, dw_kernel_size_mid, stride=stride, dilation=dilation,
+                groups=num_groups(group_size, mid_chs), padding=pad_type or None,
+                act_layer=act_layer, norm_layer=norm_layer, aa_layer=aa_layer, **conv_kwargs, **kw)
+        else:
+            self.dw_mid = None
+
+        self.se = se_layer(mid_chs, act_layer=act_layer, **kw) if se_layer else None
+
+        self.pw_proj = ConvNormAct(
+            mid_chs, out_chs, 1, padding=pad_type or None, apply_act=False,
+            act_layer=act_layer, norm_layer=norm_layer, **conv_kwargs, **kw)
+
+        if dw_kernel_size_end:
+            dw_end_stride = stride if not dw_kernel_size_start and not dw_kernel_size_mid else 1
+            if dw_end_stride > 1:
+                assert not aa_layer
+            self.dw_end = ConvNormAct(
+                out_chs, out_chs, dw_kernel_size_end, stride=dw_end_stride, dilation=dilation,
+                groups=num_groups(group_size, out_chs), padding=pad_type or None, apply_act=False,
+                act_layer=act_layer, norm_layer=norm_layer, **conv_kwargs, **kw)
+        else:
+            self.dw_end = None
+
+        self.layer_scale = LayerScale(out_chs, layer_scale_init_value, param_dtype=param_dtype, rngs=rngs) \
+            if layer_scale_init_value is not None else None
+        self.drop_path = DropPath(drop_path_rate, rngs=rngs)
+
+    def feature_info(self, location):
+        return dict(module='pw_proj.conv', num_chs=self.pw_proj.conv.out_features)
+
+    def __call__(self, x):
+        shortcut = x
+        if self.dw_start is not None:
+            x = self.dw_start(x)
+        x = self.pw_exp(x)
+        if self.dw_mid is not None:
+            x = self.dw_mid(x)
+        if self.se is not None:
+            x = self.se(x)
+        x = self.pw_proj(x)
+        if self.dw_end is not None:
+            x = self.dw_end(x)
+        if self.layer_scale is not None:
+            x = self.layer_scale(x)
+        if self.has_skip:
+            x = self.drop_path(x) + shortcut
+        return x
+
+
+class MobileAttention(nnx.Module):
+    """Mobile attention block (MobileNetV4 hybrid)
+    (reference _efficientnet_blocks.py:489-610): norm → (multi-query or plain)
+    2D attention → layer scale, with optional per-block CPE dw conv."""
+
+    def __init__(
+            self,
+            in_chs: int,
+            out_chs: int,
+            stride: int = 1,
+            dw_kernel_size: int = 3,
+            dilation: int = 1,
+            group_size: int = 1,
+            pad_type: str = '',
+            num_heads: int = 8,
+            key_dim: int = 64,
+            value_dim: int = 64,
+            use_multi_query: bool = False,
+            query_strides=(1, 1),
+            kv_stride: int = 1,
+            cpe_dw_kernel_size: int = 3,
+            noskip: bool = False,
+            act_layer: Union[str, Callable] = 'relu',
+            norm_layer: Callable = BatchNormAct2d,
+            aa_layer: Optional[Callable] = None,
+            drop_path_rate: float = 0.0,
+            attn_drop: float = 0.0,
+            proj_drop: float = 0.0,
+            layer_scale_init_value: Optional[float] = 1e-5,
+            use_bias: bool = False,
+            use_cpe: bool = False,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        self.has_skip = (stride == 1 and in_chs == out_chs) and not noskip
+        self.query_strides = to_2tuple(query_strides)
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        if use_cpe:
+            self.conv_cpe_dw = create_conv2d(
+                in_chs, in_chs, cpe_dw_kernel_size, dilation=dilation, depthwise=True, bias=True, **kw)
+        else:
+            self.conv_cpe_dw = None
+
+        self.norm = norm_layer(in_chs, apply_act=False, **kw)
+
+        if num_heads is None:
+            assert in_chs % key_dim == 0
+            num_heads = in_chs // key_dim
+
+        # raw norm class for the attention-internal norms (no act composite)
+        from ..layers import BatchNorm2d
+        if use_multi_query:
+            self.attn = MultiQueryAttention2d(
+                in_chs,
+                dim_out=out_chs,
+                num_heads=num_heads,
+                key_dim=key_dim,
+                value_dim=value_dim,
+                query_strides=query_strides,
+                kv_stride=kv_stride,
+                dw_kernel_size=dw_kernel_size,
+                dilation=dilation,
+                padding=pad_type,
+                attn_drop=attn_drop,
+                proj_drop=proj_drop,
+                norm_layer=BatchNorm2d,
+                **kw,
+            )
+        else:
+            self.attn = Attention2d(
+                in_chs, dim_out=out_chs, num_heads=num_heads,
+                attn_drop=attn_drop, proj_drop=proj_drop, bias=use_bias, **kw)
+
+        self.layer_scale = LayerScale(out_chs, layer_scale_init_value, param_dtype=param_dtype, rngs=rngs) \
+            if layer_scale_init_value is not None else None
+        self.drop_path = DropPath(drop_path_rate, rngs=rngs)
+
+    def feature_info(self, location):
+        return dict(module='attn', num_chs=self.attn.proj.out_features
+                    if hasattr(self.attn, 'proj') else None)
+
+    def __call__(self, x):
+        if self.conv_cpe_dw is not None:
+            x = x + self.conv_cpe_dw(x)
+        shortcut = x
+        x = self.norm(x)
+        x = self.attn(x)
+        if self.layer_scale is not None:
+            x = self.layer_scale(x)
         if self.has_skip:
             x = self.drop_path(x) + shortcut
         return x
@@ -190,6 +498,7 @@ class EdgeResidual(nnx.Module):
             pw_kernel_size: int = 1,
             act_layer: Union[str, Callable] = 'relu',
             norm_layer: Callable = BatchNormAct2d,
+            aa_layer: Optional[Callable] = None,
             se_layer: Optional[Callable] = None,
             drop_path_rate: float = 0.0,
             *,
@@ -201,12 +510,16 @@ class EdgeResidual(nnx.Module):
             mid_chs = make_divisible(force_in_chs * exp_ratio)
         else:
             mid_chs = make_divisible(in_chs * exp_ratio)
+        groups = num_groups(group_size, mid_chs)
         self.has_skip = (in_chs == out_chs and stride == 1) and not noskip
+        aa_layer = get_aa_layer(aa_layer)
+        use_aa = aa_layer is not None and stride > 1
 
         self.conv_exp = create_conv2d(
-            in_chs, mid_chs, exp_kernel_size, stride=stride, dilation=dilation,
-            padding=pad_type or None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            in_chs, mid_chs, exp_kernel_size, stride=1 if use_aa else stride, dilation=dilation,
+            groups=groups, padding=pad_type or None, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.aa = aa_layer(channels=mid_chs, stride=stride, rngs=rngs) if use_aa else None
         self.se = se_layer(mid_chs, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
             if se_layer else None
         self.conv_pwl = create_conv2d(
@@ -221,6 +534,8 @@ class EdgeResidual(nnx.Module):
     def __call__(self, x):
         shortcut = x
         x = self.bn1(self.conv_exp(x))
+        if self.aa is not None:
+            x = self.aa(x)
         if self.se is not None:
             x = self.se(x)
         x = self.bn2(self.conv_pwl(x))

@@ -16,8 +16,11 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import jax.numpy as jnp
 from flax import nnx
 
-from ..layers import BatchNormAct2d, SqueezeExcite, get_act_fn, make_divisible
-from ._efficientnet_blocks import ConvBnAct, DepthwiseSeparableConv, EdgeResidual, InvertedResidual
+from ..layers import BatchNormAct2d, SqueezeExcite, get_aa_layer, get_act_fn, make_divisible
+from ._efficientnet_blocks import (
+    CondConvResidual, ConvBnAct, DepthwiseSeparableConv, EdgeResidual,
+    InvertedResidual, MobileAttention, UniversalInvertedResidual,
+)
 
 _logger = logging.getLogger(__name__)
 
@@ -51,10 +54,10 @@ def round_channels(channels, multiplier: float = 1.0, divisor: int = 8, channel_
     return make_divisible(channels * multiplier, divisor, channel_min, round_limit=round_limit)
 
 
-def _parse_ksize(ss: str) -> int:
+def _parse_ksize(ss: str):
     if ss.isdigit():
         return int(ss)
-    return [int(k) for k in ss.split('.')][0]  # mixed kernels collapse to first
+    return [int(k) for k in ss.split('.')]  # mixed kernels (MixNet) stay a list
 
 
 def _decode_block_str(block_str: str) -> Dict[str, Any]:
@@ -101,7 +104,10 @@ def _decode_block_str(block_str: str) -> Dict[str, Any]:
             exp_ratio=float(options.get('e', 1.0)),
             se_ratio=float(options.get('se', 0.0)),
             noskip=skip is False,
+            s2d=int(options.get('d', 0)) > 0,
         ))
+        if 'cc' in options:
+            start_kwargs['num_experts'] = int(options['cc'])
     elif block_type == 'ds' or block_type == 'dsa':
         start_kwargs.update(dict(
             dw_kernel_size=_parse_ksize(options['k']),
@@ -109,6 +115,7 @@ def _decode_block_str(block_str: str) -> Dict[str, Any]:
             se_ratio=float(options.get('se', 0.0)),
             pw_act=block_type == 'dsa',
             noskip=block_type == 'dsa' or skip is False,
+            s2d=int(options.get('d', 0)) > 0,
         ))
     elif block_type == 'er':
         start_kwargs.update(dict(
@@ -124,8 +131,31 @@ def _decode_block_str(block_str: str) -> Dict[str, Any]:
             kernel_size=int(options['k']),
             skip=skip is True,
         ))
+    elif block_type == 'uir':
+        # dw kernel sizes at start/mid/end; 0 disables ('a'/'p' overloaded)
+        start_kwargs.update(dict(
+            dw_kernel_size_start=_parse_ksize(options.get('a', '0')),
+            dw_kernel_size_mid=_parse_ksize(options['k']),
+            dw_kernel_size_end=_parse_ksize(options.get('p', '0')),
+            exp_ratio=float(options.get('e', 1.0)),
+            se_ratio=float(options.get('se', 0.0)),
+            noskip=skip is False,
+        ))
+    elif block_type in ('mha', 'mqa'):
+        kv_dim = int(options['d'])
+        start_kwargs.update(dict(
+            dw_kernel_size=_parse_ksize(options['k']),
+            num_heads=int(options['h']),
+            key_dim=kv_dim,
+            value_dim=kv_dim,
+            kv_stride=int(options.get('v', 1)),
+            noskip=skip is False,
+        ))
     else:
         raise AssertionError(f'Unknown block type ({block_type})')
+
+    if 'gs' in options:
+        start_kwargs['group_size'] = int(options['gs'])
 
     return start_kwargs, num_repeat
 
@@ -172,6 +202,10 @@ def decode_arch_def(
         repeats = []
         for block_str in block_strings:
             ba, rep = _decode_block_str(block_str)
+            if ba.get('num_experts', 0) > 0 and experts_multiplier > 1:
+                ba['num_experts'] *= experts_multiplier
+            if group_size is not None:
+                ba.setdefault('group_size', group_size)
             stack_args.append(ba)
             repeats.append(rep)
         if fix_first_last and (stack_idx == 0 or stack_idx == len(arch_def) - 1):
@@ -192,8 +226,10 @@ class EfficientNetBuilder:
             se_from_exp: bool = False,
             act_layer: Union[str, Callable] = 'relu',
             norm_layer: Callable = BatchNormAct2d,
+            aa_layer: Optional[Callable] = None,
             se_layer: Callable = SqueezeExcite,
             drop_path_rate: float = 0.0,
+            layer_scale_init_value: Optional[float] = None,
             feature_location: str = '',
             *,
             dtype=None,
@@ -206,8 +242,10 @@ class EfficientNetBuilder:
         self.se_from_exp = se_from_exp
         self.act_layer = act_layer
         self.norm_layer = norm_layer
+        self.aa_layer = get_aa_layer(aa_layer)
         self.se_layer = se_layer
         self.drop_path_rate = drop_path_rate
+        self.layer_scale_init_value = layer_scale_init_value
         self.dtype = dtype
         self.param_dtype = param_dtype
         self.rngs = rngs
@@ -219,19 +257,37 @@ class EfficientNetBuilder:
         bt = ba.pop('block_type')
         ba['in_chs'] = self.in_chs
         ba['out_chs'] = self.round_chs_fn(ba['out_chs'])
+        s2d = ba.get('s2d', 0)
+        if s2d > 0:
+            # adjust while space2depth active (reference _efficientnet_builder.py:374-377)
+            ba['out_chs'] *= 4
         if 'force_in_chs' in ba and ba['force_in_chs']:
             ba['force_in_chs'] = self.round_chs_fn(ba['force_in_chs'])
         ba['pad_type'] = self.pad_type
         ba['act_layer'] = ba.pop('act_layer', None) or self.act_layer
         ba['norm_layer'] = self.norm_layer
+        if self.aa_layer is not None:
+            ba['aa_layer'] = self.aa_layer
         se_ratio = ba.pop('se_ratio', 0.0)
         se_layer = None
         if se_ratio > 0.0 and self.se_layer is not None:
             if not self.se_from_exp:
                 se_ratio /= ba.get('exp_ratio', 1.0)
+            if s2d == 1:
+                # adjust for start of space2depth
+                se_ratio /= 4
+            import inspect
             bound = getattr(self.se_layer, 'keywords', {}) or {}
-            if 'rd_round_fn' in bound:
-                se_layer = partial(self.se_layer, rd_ratio=se_ratio)
+            base = self.se_layer.func if isinstance(self.se_layer, partial) else self.se_layer
+            try:
+                params = inspect.signature(base.__init__).parameters
+            except (TypeError, ValueError):
+                params = {}
+            if 'rd_round_fn' in bound or 'rd_round_fn' not in params:
+                # alt attn modules (e.g. GlobalContext) take rd_ratio only
+                se_layer = partial(self.se_layer, rd_ratio=se_ratio) \
+                    if 'rd_ratio' in params or 'rd_ratio' in bound or isinstance(self.se_layer, partial) \
+                    else self.se_layer
             else:
                 # EfficientNet-family SE uses plain rounding (reference
                 # _efficientnet_blocks.py: rd_round_fn or round)
@@ -239,7 +295,11 @@ class EfficientNetBuilder:
         common = dict(dtype=self.dtype, param_dtype=self.param_dtype, rngs=self.rngs)
 
         if bt == 'ir':
-            block = InvertedResidual(drop_path_rate=drop_path_rate, se_layer=se_layer, **ba, **common)
+            ba.setdefault('s2d', 0)
+            if ba.get('num_experts', 0):
+                block = CondConvResidual(drop_path_rate=drop_path_rate, se_layer=se_layer, **ba, **common)
+            else:
+                block = InvertedResidual(drop_path_rate=drop_path_rate, se_layer=se_layer, **ba, **common)
         elif bt in ('ds', 'dsa'):
             ba.pop('exp_ratio', None)
             ba.pop('exp_kernel_size', None)
@@ -248,6 +308,14 @@ class EfficientNetBuilder:
             block = EdgeResidual(drop_path_rate=drop_path_rate, se_layer=se_layer, **ba, **common)
         elif bt == 'cn':
             block = ConvBnAct(drop_path_rate=drop_path_rate, **ba, **common)
+        elif bt == 'uir':
+            block = UniversalInvertedResidual(
+                drop_path_rate=drop_path_rate, se_layer=se_layer,
+                layer_scale_init_value=self.layer_scale_init_value, **ba, **common)
+        elif bt in ('mqa', 'mha'):
+            block = MobileAttention(
+                drop_path_rate=drop_path_rate, use_multi_query=bt == 'mqa',
+                layer_scale_init_value=self.layer_scale_init_value, **ba, **common)
         else:
             raise AssertionError(f'Unknown block type ({bt})')
         self.in_chs = ba['out_chs']
@@ -261,12 +329,26 @@ class EfficientNetBuilder:
         current_dilation = 1
         stages = []
         self.features = []
+        space2depth = 0
         for stack_idx, stack_args in enumerate(model_block_args):
             blocks = []
             for i, ba in enumerate(stack_args):
                 ba = deepcopy(ba)
                 if i > 0:
                     ba['stride'] = 1
+                # space-to-depth region state machine
+                # (reference _efficientnet_builder.py:471-484, 509-510)
+                if not space2depth and ba.pop('s2d', False):
+                    assert ba.get('stride', 1) == 1
+                    space2depth = 1
+                if space2depth > 0:
+                    if space2depth == 2 and ba.get('stride', 1) == 2:
+                        ba['stride'] = 1
+                        # end s2d region: correct expansion relative to input
+                        ba['exp_ratio'] /= 4
+                        space2depth = 0
+                    else:
+                        ba['s2d'] = space2depth
                 # stride→dilation conversion compounds across stages
                 # (reference _efficientnet_builder.py:495-503)
                 next_dilation = current_dilation
@@ -281,6 +363,8 @@ class EfficientNetBuilder:
                 current_dilation = next_dilation
                 blocks.append(self._make_block(ba, block_idx, total_block_count))
                 block_idx += 1
+                if space2depth == 1:
+                    space2depth = 2
             stages.append(nnx.List(blocks))
             self.features.append(dict(
                 num_chs=self.in_chs, reduction=current_stride, module=f'blocks.{stack_idx}'))

@@ -13,12 +13,16 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 import jax.numpy as jnp
 from flax import nnx
 
-from ..layers import BatchNormAct2d, SelectAdaptivePool2d, create_conv2d, get_act_fn, get_norm_layer
+from ..layers import (
+    BatchNormAct2d, EvoNorm2dS0, GroupNormAct, LayerNormAct2d, SelectAdaptivePool2d,
+    SqueezeExcite, create_conv2d, get_act_fn, get_attn, get_norm_layer,
+)
 from ..layers.drop import Dropout
 from ..layers.weight_init import trunc_normal_, zeros_
 from ._builder import build_model_with_cfg
 from ._efficientnet_builder import (
-    EfficientNetBuilder, decode_arch_def, resolve_act_layer, resolve_bn_args, round_channels,
+    BN_EPS_TF_DEFAULT, EfficientNetBuilder, decode_arch_def, resolve_act_layer,
+    resolve_bn_args, round_channels,
 )
 from ._features import feature_take_indices
 from ._manipulate import checkpoint_seq
@@ -41,6 +45,8 @@ class EfficientNet(nnx.Module):
             pad_type: str = '',
             act_layer: Union[str, Callable] = 'relu',
             norm_layer: Callable = BatchNormAct2d,
+            aa_layer: Optional[Union[str, Callable]] = None,
+            se_layer: Optional[Union[str, Callable]] = None,
             se_from_exp: bool = False,
             round_chs_fn: Callable = round_channels,
             drop_rate: float = 0.0,
@@ -61,6 +67,7 @@ class EfficientNet(nnx.Module):
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.bn1 = norm_layer(stem_size, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
 
+        builder_se = get_attn(se_layer) if isinstance(se_layer, str) else se_layer
         builder = EfficientNetBuilder(
             output_stride=output_stride,
             pad_type=pad_type,
@@ -68,6 +75,8 @@ class EfficientNet(nnx.Module):
             se_from_exp=se_from_exp,
             act_layer=act_layer,
             norm_layer=norm_layer,
+            aa_layer=aa_layer,
+            se_layer=builder_se if builder_se is not None else SqueezeExcite,
             drop_path_rate=drop_path_rate,
             dtype=dtype,
             param_dtype=param_dtype,
@@ -77,12 +86,17 @@ class EfficientNet(nnx.Module):
         self.feature_info = builder.features
         head_chs = builder.in_chs
 
-        # head
+        # head (num_features == 0 → no head conv, reference efficientnet.py:159-166)
+        if num_features > 0:
+            self.conv_head = create_conv2d(
+                head_chs, num_features, 1, padding=pad_type or None,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+            self.bn2 = norm_layer(num_features, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        else:
+            self.conv_head = None
+            self.bn2 = None
+            num_features = head_chs
         self.num_features = num_features
-        self.conv_head = create_conv2d(
-            head_chs, num_features, 1, padding=pad_type or None,
-            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
-        self.bn2 = norm_layer(num_features, act_layer=act_layer, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.head_hidden_size = num_features
         self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=True)
         self.head_drop = Dropout(drop_rate, rngs=rngs)
@@ -130,7 +144,8 @@ class EfficientNet(nnx.Module):
             else:
                 for b in stage:
                     x = b(x)
-        x = self.bn2(self.conv_head(x))
+        if self.conv_head is not None:
+            x = self.bn2(self.conv_head(x))
         return x
 
     def forward_head(self, x, pre_logits: bool = False):
@@ -164,7 +179,8 @@ class EfficientNet(nnx.Module):
                 intermediates.append(x)
         if intermediates_only:
             return intermediates
-        x = self.bn2(self.conv_head(x))
+        if self.conv_head is not None:
+            x = self.bn2(self.conv_head(x))
         return x, intermediates
 
     def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
@@ -179,6 +195,10 @@ def _create_effnet(variant, pretrained=False, **kwargs):
     """Common builder: resolves tf-origin BN overrides (bn_eps/bn_momentum via
     resolve_bn_args) into the norm layer (reference _create_effnet +
     tf entrypoints' kwargs.setdefault('bn_eps', 1e-3))."""
+    if kwargs.pop('pruned', None) and pretrained:
+        # channel-pruned checkpoints need the _prune structure adaptation
+        # (reference _builder.py adapt_model_from_file) which is not wired yet
+        raise NotImplementedError('pruned pretrained weights not supported yet')
     bn_args = resolve_bn_args(kwargs)
     if bn_args:
         kwargs['norm_layer'] = partial(BatchNormAct2d, **bn_args)
@@ -190,7 +210,7 @@ def _create_effnet(variant, pretrained=False, **kwargs):
     )
 
 
-def _gen_efficientnet(variant, channel_multiplier=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
+def _gen_efficientnet(variant, channel_multiplier=1.0, depth_multiplier=1.0, channel_divisor=8, group_size=None, pretrained=False, **kwargs):
     """EfficientNet B0-B8/L2 generator (reference efficientnet.py:718-766)."""
     arch_def = [
         ['ds_r1_k3_s1_e1_c16_se0.25'],
@@ -201,9 +221,9 @@ def _gen_efficientnet(variant, channel_multiplier=1.0, depth_multiplier=1.0, pre
         ['ir_r4_k5_s2_e6_c192_se0.25'],
         ['ir_r1_k3_s1_e6_c320_se0.25'],
     ]
-    round_chs_fn = partial(round_channels, multiplier=channel_multiplier)
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier, divisor=channel_divisor)
     model_kwargs = dict(
-        block_args=decode_arch_def(arch_def, depth_multiplier),
+        block_args=decode_arch_def(arch_def, depth_multiplier, group_size=group_size),
         num_features=round_chs_fn(1280),
         stem_size=32,
         round_chs_fn=round_chs_fn,
@@ -518,13 +538,36 @@ def _gen_tinynet(variant, model_width=1.0, depth_multiplier=1.0, pretrained=Fals
 
 
 def _filter_fn(state_dict, model):
-    """Reference SE layers name their convs conv_reduce/conv_expand."""
+    """Reference SE layers name their convs conv_reduce/conv_expand; MixedConv
+    stores its per-kernel convs as ModuleDict digits; CondConv stores flattened
+    OIHW expert banks that must be re-flattened HWIO."""
+    import re
+
+    import numpy as np
+
     from ._torch_convert import convert_torch_state_dict
     out = {}
+    done = {}
     for k, v in state_dict.items():
         k = k.replace('.se.conv_reduce.', '.se.fc1.').replace('.se.conv_expand.', '.se.fc2.')
+        # MixedConv2d: conv_dw.0.weight → conv_dw.convs.0.kernel (via generic map)
+        k = re.sub(r'\.(conv_pw|conv_dw|conv_pwl|conv_exp)\.(\d+)\.', r'.\1.convs.\2.', k)
+        if k.endswith('.weight') and np.asarray(v).ndim == 2 and '.conv_' in k:
+            # CondConv expert bank: (E, out*in/g*kh*kw) OIHW-flat → HWIO-flat;
+            # final key keeps the torch name (our CondConv2d param is `weight`),
+            # so it bypasses the generic .weight→.kernel transpose below
+            path = k[:-len('.weight')].split('.')
+            mod = model
+            for p in path:
+                mod = mod[int(p)] if p.isdigit() else getattr(mod, p)
+            kh, kw, in_g, out_ch = mod.weight_shape
+            v = np.asarray(v).reshape(-1, out_ch, in_g, kh, kw).transpose(0, 3, 4, 2, 1)
+            done[k] = v.reshape(v.shape[0], -1)
+            continue
         out[k] = v
-    return convert_torch_state_dict(out, model)
+    converted = convert_torch_state_dict(out, model)
+    converted.update(done)
+    return converted
 
 
 checkpoint_filter_fn = _filter_fn
@@ -647,6 +690,46 @@ default_cfgs = generate_default_cfgs({
     'tinynet_d.in1k': _res_cfg(152, 0.875, hf_hub_id='timm/'),
     'tinynet_e.in1k': _res_cfg(106, 0.875, hf_hub_id='timm/'),
     'test_efficientnet.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), crop_pct=0.95),
+    'mobilenetv1_100.ra4_e3600_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), test_input_size=(3, 256, 256), test_crop_pct=0.95, first_conv='conv_stem', classifier='classifier'),
+    'mobilenetv1_100h.ra4_e3600_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), test_input_size=(3, 256, 256), test_crop_pct=0.95, first_conv='conv_stem', classifier='classifier'),
+    'mobilenetv1_125.ra4_e3600_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.9, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), test_input_size=(3, 256, 256), test_crop_pct=1.0, first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_b0_gn.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_b0_g8_gn.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_b0_g16_evos.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_b3_gn.untrained': _cfg(input_size=(3, 288, 288), pool_size=(9, 9), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 320, 320), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_b3_g8_gn.untrained': _cfg(input_size=(3, 288, 288), pool_size=(9, 9), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 320, 320), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_blur_b0.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_es_pruned.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_el_pruned.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 300, 300), pool_size=(10, 10), crop_pct=0.904, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_cc_b0_4e.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_cc_b0_8e.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_cc_b1_8e.untrained': _cfg(input_size=(3, 240, 240), pool_size=(8, 8), crop_pct=0.882, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'gc_efficientnetv2_rw_t.agc_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=1.0, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 288, 288), first_conv='conv_stem', classifier='classifier'),
+    'tf_efficientnet_cc_b0_4e.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), first_conv='conv_stem', classifier='classifier'),
+    'tf_efficientnet_cc_b0_8e.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), first_conv='conv_stem', classifier='classifier'),
+    'tf_efficientnet_cc_b1_8e.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), pool_size=(8, 8), crop_pct=0.882, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_x_b3.untrained': _cfg(input_size=(3, 288, 288), pool_size=(9, 9), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_x_b5.sw_r448_e450_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 448, 448), pool_size=(14, 14), crop_pct=1.0, crop_mode='squash', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 576, 576), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_h_b5.sw_r448_e450_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 448, 448), pool_size=(14, 14), crop_pct=1.0, crop_mode='squash', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 576, 576), first_conv='conv_stem', classifier='classifier'),
+    'mixnet_s.ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'mixnet_m.ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'mixnet_l.ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'mixnet_xl.ra_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'mixnet_xxl.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'tf_mixnet_s.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'tf_mixnet_m.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'tf_mixnet_l.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'mobilenet_edgetpu_100.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.9, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'mobilenet_edgetpu_v2_xs.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.9, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'mobilenet_edgetpu_v2_s.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.9, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'mobilenet_edgetpu_v2_m.ra4_e3600_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.9, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), test_input_size=(3, 256, 256), test_crop_pct=0.95, first_conv='conv_stem', classifier='classifier'),
+    'mobilenet_edgetpu_v2_l.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.9, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'test_efficientnet_gn.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), pool_size=(5, 5), crop_pct=0.95, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), first_conv='conv_stem', classifier='classifier'),
+    'test_efficientnet_ln.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), pool_size=(5, 5), crop_pct=0.95, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), first_conv='conv_stem', classifier='classifier'),
+    'test_efficientnet_evos.r160_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 160, 160), pool_size=(5, 5), crop_pct=0.95, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), first_conv='conv_stem', classifier='classifier'),
+    'efficientnet_b1_pruned.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 240, 240), pool_size=(8, 8), crop_pct=0.882, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'efficientnet_b2_pruned.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 260, 260), pool_size=(9, 9), crop_pct=0.89, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'efficientnet_b3_pruned.in1k': _cfg(hf_hub_id='timm/', input_size=(3, 300, 300), pool_size=(10, 10), crop_pct=0.904, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
 })
 
 
@@ -654,12 +737,12 @@ def _register_effnet_b(name: str):
     cm, dm, _, _ = _B_PARAMS[name]
 
     def base(pretrained=False, **kwargs):
-        return _gen_efficientnet(f'efficientnet_{name}', cm, dm, pretrained, **kwargs)
+        return _gen_efficientnet(f'efficientnet_{name}', cm, dm, pretrained=pretrained, **kwargs)
 
     def tf(pretrained=False, **kwargs):
         kwargs.setdefault('bn_eps', 1e-3)
         kwargs.setdefault('pad_type', 'same')
-        return _gen_efficientnet(f'tf_efficientnet_{name}', cm, dm, pretrained, **kwargs)
+        return _gen_efficientnet(f'tf_efficientnet_{name}', cm, dm, pretrained=pretrained, **kwargs)
 
     base.__name__ = f'efficientnet_{name}'
     base.__doc__ = f'EfficientNet-{name.upper()} (reference efficientnet.py entrypoints)'
@@ -965,9 +1048,8 @@ def tinynet_e(pretrained=False, **kwargs) -> EfficientNet:
     return _gen_tinynet('tinynet_e', 0.51, 0.6, pretrained=pretrained, **kwargs)
 
 
-@register_model
-def test_efficientnet(pretrained=False, **kwargs) -> EfficientNet:
-    """Tiny fixture (reference efficientnet.py:2902)."""
+def _gen_test_efficientnet(variant, channel_multiplier=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
+    """Minimal test EfficientNet generator (reference efficientnet.py:1300-1321)."""
     arch_def = [
         ['cn_r1_k3_s1_e1_c16_skip'],
         ['er_r1_k3_s2_e4_c24'],
@@ -975,16 +1057,591 @@ def test_efficientnet(pretrained=False, **kwargs) -> EfficientNet:
         ['ir_r1_k3_s2_e4_c48_se0.25'],
         ['ir_r1_k3_s2_e4_c64_se0.25'],
     ]
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier, round_limit=0.)
     model_kwargs = dict(
-        block_args=decode_arch_def(arch_def),
-        num_features=256,
-        stem_size=16,
+        block_args=decode_arch_def(arch_def, depth_multiplier),
+        num_features=round_chs_fn(256),
+        stem_size=24,
+        round_chs_fn=round_chs_fn,
         act_layer=resolve_act_layer(kwargs, 'silu'),
         **kwargs,
     )
-    return build_model_with_cfg(
-        EfficientNet, 'test_efficientnet', pretrained,
-        pretrained_filter_fn=_filter_fn,
-        feature_cfg=dict(out_indices=(0, 1, 2, 3, 4)),
-        **model_kwargs,
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_mobilenet_v1(
+        variant, channel_multiplier=1.0, depth_multiplier=1.0,
+        group_size=None, fix_stem_head=False, head_conv=False, pretrained=False, **kwargs):
+    """MobileNet-V1 (reference efficientnet.py:580-613)."""
+    arch_def = [
+        ['dsa_r1_k3_s1_c64'],
+        ['dsa_r2_k3_s2_c128'],
+        ['dsa_r2_k3_s2_c256'],
+        ['dsa_r6_k3_s2_c512'],
+        ['dsa_r2_k3_s2_c1024'],
+    ]
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier)
+    head_features = (1024 if fix_stem_head else max(1024, round_chs_fn(1024))) if head_conv else 0
+    model_kwargs = dict(
+        block_args=decode_arch_def(
+            arch_def, depth_multiplier=depth_multiplier, fix_first_last=fix_stem_head,
+            group_size=group_size),
+        num_features=head_features,
+        stem_size=32,
+        fix_stem=fix_stem_head,
+        round_chs_fn=round_chs_fn,
+        act_layer=resolve_act_layer(kwargs, 'relu6'),
+        **kwargs,
     )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_efficientnet_condconv(
+        variant, channel_multiplier=1.0, depth_multiplier=1.0, experts_multiplier=1,
+        pretrained=False, **kwargs):
+    """EfficientNet-CondConv (reference efficientnet.py:800-830)."""
+    arch_def = [
+        ['ds_r1_k3_s1_e1_c16_se0.25'],
+        ['ir_r2_k3_s2_e6_c24_se0.25'],
+        ['ir_r2_k5_s2_e6_c40_se0.25'],
+        ['ir_r3_k3_s2_e6_c80_se0.25'],
+        ['ir_r3_k5_s1_e6_c112_se0.25_cc4'],
+        ['ir_r4_k5_s2_e6_c192_se0.25_cc4'],
+        ['ir_r1_k3_s1_e6_c320_se0.25_cc4'],
+    ]
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier)
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, depth_multiplier, experts_multiplier=experts_multiplier),
+        num_features=round_chs_fn(1280),
+        stem_size=32,
+        round_chs_fn=round_chs_fn,
+        act_layer=resolve_act_layer(kwargs, 'swish'),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_efficientnet_x(
+        variant, channel_multiplier=1.0, depth_multiplier=1.0, channel_divisor=8,
+        group_size=None, version=1, pretrained=False, **kwargs):
+    """EfficientNet-X (reference efficientnet.py:1039-1120): edge-residual
+    early stages w/ relu, depthwise-separable-style later stages w/ silu."""
+    if version == 1:
+        arch_def = [
+            ['ds_r1_k3_s1_e1_c16_se0.25_d1'],
+            ['er_r2_k3_s2_e6_c24_se0.25_nre'],
+            ['er_r2_k5_s2_e6_c40_se0.25_nre'],
+            ['ir_r3_k3_s2_e6_c80_se0.25'],
+            ['ir_r3_k5_s1_e6_c112_se0.25'],
+            ['ir_r4_k5_s2_e6_c192_se0.25'],
+            ['ir_r1_k3_s1_e6_c320_se0.25'],
+        ]
+    else:
+        arch_def = [
+            ['ds_r1_k3_s1_e1_c16_se0.25_d1'],
+            ['er_r2_k3_s2_e4_c24_se0.25_nre'],
+            ['er_r2_k5_s2_e4_c40_se0.25_nre'],
+            ['ir_r3_k3_s2_e4_c80_se0.25'],
+            ['ir_r3_k5_s1_e6_c112_se0.25'],
+            ['ir_r4_k5_s2_e6_c192_se0.25'],
+            ['ir_r1_k3_s1_e6_c320_se0.25'],
+        ]
+    round_chs_fn = partial(round_channels, multiplier=channel_multiplier, divisor=channel_divisor)
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, depth_multiplier, group_size=group_size),
+        num_features=round_chs_fn(1280),
+        stem_size=32,
+        round_chs_fn=round_chs_fn,
+        act_layer=resolve_act_layer(kwargs, 'silu'),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_mixnet_s(variant, channel_multiplier=1.0, pretrained=False, **kwargs):
+    """MixNet Small — mixed (grouped multi-size) depthwise kernels
+    (reference efficientnet.py:1122-1153)."""
+    arch_def = [
+        ['ds_r1_k3_s1_e1_c16'],  # relu
+        ['ir_r1_k3_a1.1_p1.1_s2_e6_c24', 'ir_r1_k3_a1.1_p1.1_s1_e3_c24'],  # relu
+        ['ir_r1_k3.5.7_s2_e6_c40_se0.5_nsw', 'ir_r3_k3.5_a1.1_p1.1_s1_e6_c40_se0.5_nsw'],  # swish
+        ['ir_r1_k3.5.7_p1.1_s2_e6_c80_se0.25_nsw', 'ir_r2_k3.5_p1.1_s1_e6_c80_se0.25_nsw'],  # swish
+        ['ir_r1_k3.5.7_a1.1_p1.1_s1_e6_c120_se0.5_nsw', 'ir_r2_k3.5.7.9_a1.1_p1.1_s1_e3_c120_se0.5_nsw'],  # swish
+        ['ir_r1_k3.5.7.9.11_s2_e6_c200_se0.5_nsw', 'ir_r2_k3.5.7.9_p1.1_s1_e6_c200_se0.5_nsw'],  # swish
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def),
+        num_features=1536,
+        stem_size=16,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_mixnet_m(variant, channel_multiplier=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
+    """MixNet Medium/Large/XL (reference efficientnet.py:1155-1188)."""
+    arch_def = [
+        ['ds_r1_k3_s1_e1_c24'],  # relu
+        ['ir_r1_k3.5.7_a1.1_p1.1_s2_e6_c32', 'ir_r1_k3_a1.1_p1.1_s1_e3_c32'],  # relu
+        ['ir_r1_k3.5.7.9_s2_e6_c40_se0.5_nsw', 'ir_r3_k3.5_a1.1_p1.1_s1_e6_c40_se0.5_nsw'],  # swish
+        ['ir_r1_k3.5.7_s2_e6_c80_se0.25_nsw', 'ir_r3_k3.5.7.9_a1.1_p1.1_s1_e6_c80_se0.25_nsw'],  # swish
+        ['ir_r1_k3_s1_e6_c120_se0.5_nsw', 'ir_r3_k3.5.7.9_a1.1_p1.1_s1_e3_c120_se0.5_nsw'],  # swish
+        ['ir_r1_k3.5.7.9_s2_e6_c200_se0.5_nsw', 'ir_r3_k3.5.7.9_p1.1_s1_e6_c200_se0.5_nsw'],  # swish
+    ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, depth_multiplier, depth_trunc='round'),
+        num_features=1536,
+        stem_size=24,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+def _gen_mobilenet_edgetpu(variant, channel_multiplier=1.0, depth_multiplier=1.0, pretrained=False, **kwargs):
+    """MobileNet-EdgeTPU v1/v2 (reference efficientnet.py:1211-1298)."""
+    if 'edgetpu_v2' in variant:
+        stem_size = 64
+        stem_kernel_size = 5
+        group_size = 64
+        num_features = 1280
+        act_layer = resolve_act_layer(kwargs, 'relu')
+
+        def _arch_def(chs, group_size):
+            return [
+                [f'cn_r1_k1_s1_c{chs[0]}'],
+                [f'er_r1_k3_s2_e8_c{chs[1]}', f'er_r1_k3_s1_e4_gs{group_size}_c{chs[1]}'],
+                [
+                    f'er_r1_k3_s2_e8_c{chs[2]}',
+                    f'er_r1_k3_s1_e4_gs{group_size}_c{chs[2]}',
+                    f'er_r1_k3_s1_e4_c{chs[2]}',
+                    f'er_r1_k3_s1_e4_gs{group_size}_c{chs[2]}',
+                ],
+                [f'er_r1_k3_s2_e8_c{chs[3]}', f'ir_r3_k3_s1_e4_c{chs[3]}'],
+                [f'ir_r1_k3_s1_e8_c{chs[4]}', f'ir_r3_k3_s1_e4_c{chs[4]}'],
+                [f'ir_r1_k3_s2_e8_c{chs[5]}', f'ir_r3_k3_s1_e4_c{chs[5]}'],
+                [f'ir_r1_k3_s1_e8_c{chs[6]}'],
+            ]
+
+        if 'edgetpu_v2_xs' in variant:
+            stem_size = 32
+            stem_kernel_size = 3
+            channels = [16, 32, 48, 96, 144, 160, 192]
+        elif 'edgetpu_v2_s' in variant:
+            channels = [24, 48, 64, 128, 160, 192, 256]
+        elif 'edgetpu_v2_m' in variant:
+            channels = [32, 64, 80, 160, 192, 240, 320]
+            num_features = 1344
+        elif 'edgetpu_v2_l' in variant:
+            stem_kernel_size = 7
+            group_size = 128
+            channels = [32, 64, 96, 192, 240, 256, 384]
+            num_features = 1408
+        else:
+            raise AssertionError(f'unknown edgetpu v2 variant {variant}')
+        arch_def = _arch_def(channels, group_size)
+    else:  # v1
+        stem_size = 32
+        stem_kernel_size = 3
+        num_features = 1280
+        act_layer = resolve_act_layer(kwargs, 'relu')
+        arch_def = [
+            ['cn_r1_k1_s1_c16'],
+            ['er_r1_k3_s2_e8_c32', 'er_r3_k3_s1_e4_c32'],
+            ['er_r1_k3_s2_e8_c48', 'er_r3_k3_s1_e4_c48'],
+            ['ir_r1_k3_s2_e8_c96', 'ir_r3_k3_s1_e4_c96'],
+            ['ir_r1_k3_s1_e8_c96_noskip', 'ir_r3_k3_s1_e4_c96'],
+            ['ir_r1_k5_s2_e8_c160', 'ir_r3_k5_s1_e4_c160'],
+            ['ir_r1_k3_s1_e8_c192'],
+        ]
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, depth_multiplier),
+        num_features=num_features,
+        stem_size=stem_size,
+        stem_kernel_size=stem_kernel_size,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        act_layer=act_layer,
+        **kwargs,
+    )
+    return _create_effnet(variant, pretrained, **model_kwargs)
+
+
+@register_model
+def test_efficientnet(pretrained=False, **kwargs) -> EfficientNet:
+    """Tiny fixture (reference efficientnet.py:2902)."""
+    return _gen_test_efficientnet('test_efficientnet', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def mobilenetv1_100(pretrained=False, **kwargs) -> EfficientNet:
+    """ MobileNet V1 """
+    model = _gen_mobilenet_v1('mobilenetv1_100', 1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv1_100h(pretrained=False, **kwargs) -> EfficientNet:
+    """ MobileNet V1 """
+    model = _gen_mobilenet_v1('mobilenetv1_100h', 1.0, head_conv=True, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv1_125(pretrained=False, **kwargs) -> EfficientNet:
+    """ MobileNet V1 """
+    model = _gen_mobilenet_v1('mobilenetv1_125', 1.25, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_b0_gn(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B0 + GroupNorm"""
+    model = _gen_efficientnet(
+        'efficientnet_b0_gn', norm_layer=partial(GroupNormAct, group_size=8), pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_b0_g8_gn(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B0 w/ group conv + GroupNorm"""
+    model = _gen_efficientnet(
+        'efficientnet_b0_g8_gn', group_size=8, norm_layer=partial(GroupNormAct, group_size=8),
+        pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_b0_g16_evos(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B0 w/ group 16 conv + EvoNorm"""
+    model = _gen_efficientnet(
+        'efficientnet_b0_g16_evos', group_size=16, channel_divisor=16,
+        pretrained=pretrained, **kwargs) #norm_layer=partial(EvoNorm2dS0, group_size=16),
+    return model
+
+
+@register_model
+def efficientnet_b3_gn(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B3 w/ GroupNorm """
+    # NOTE for train, drop_rate should be 0.3, drop_path_rate should be 0.2
+    model = _gen_efficientnet(
+        'efficientnet_b3_gn', channel_multiplier=1.2, depth_multiplier=1.4, channel_divisor=16,
+        norm_layer=partial(GroupNormAct, group_size=16), pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_b3_g8_gn(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B3 w/ grouped conv + BN"""
+    # NOTE for train, drop_rate should be 0.3, drop_path_rate should be 0.2
+    model = _gen_efficientnet(
+        'efficientnet_b3_g8_gn', channel_multiplier=1.2, depth_multiplier=1.4, group_size=8, channel_divisor=16,
+        norm_layer=partial(GroupNormAct, group_size=16), pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_blur_b0(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B0 w/ BlurPool """
+    # NOTE for train, drop_rate should be 0.2, drop_path_rate should be 0.2
+    model = _gen_efficientnet(
+        'efficientnet_blur_b0', channel_multiplier=1.0, depth_multiplier=1.0, pretrained=pretrained,
+        aa_layer='blurpc', **kwargs
+    )
+    return model
+
+
+@register_model
+def efficientnet_es_pruned(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-Edge Small Pruned. For more info: https://github.com/DeGirum/pruned-models/releases/tag/efficientnet_v1.0"""
+    model = _gen_efficientnet_edge(
+        'efficientnet_es_pruned', channel_multiplier=1.0, depth_multiplier=1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_el_pruned(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-Edge-Large pruned. For more info: https://github.com/DeGirum/pruned-models/releases/tag/efficientnet_v1.0"""
+    model = _gen_efficientnet_edge(
+        'efficientnet_el_pruned', channel_multiplier=1.2, depth_multiplier=1.4, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_cc_b0_4e(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-CondConv-B0 w/ 8 Experts """
+    # NOTE for train, drop_rate should be 0.2, drop_path_rate should be 0.2
+    model = _gen_efficientnet_condconv(
+        'efficientnet_cc_b0_4e', channel_multiplier=1.0, depth_multiplier=1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_cc_b0_8e(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-CondConv-B0 w/ 8 Experts """
+    # NOTE for train, drop_rate should be 0.2, drop_path_rate should be 0.2
+    model = _gen_efficientnet_condconv(
+        'efficientnet_cc_b0_8e', channel_multiplier=1.0, depth_multiplier=1.0, experts_multiplier=2,
+        pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_cc_b1_8e(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-CondConv-B1 w/ 8 Experts """
+    # NOTE for train, drop_rate should be 0.2, drop_path_rate should be 0.2
+    model = _gen_efficientnet_condconv(
+        'efficientnet_cc_b1_8e', channel_multiplier=1.0, depth_multiplier=1.1, experts_multiplier=2,
+        pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def gc_efficientnetv2_rw_t(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-V2 Tiny w/ Global Context Attn (Custom variant, tiny not in paper). """
+    model = _gen_efficientnetv2_s(
+        'gc_efficientnetv2_rw_t', channel_multiplier=0.8, depth_multiplier=0.9,
+        rw=False, se_layer='gc', pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def tf_efficientnet_cc_b0_4e(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-CondConv-B0 w/ 4 Experts. Tensorflow compatible variant """
+    # NOTE for train, drop_rate should be 0.2, drop_path_rate should be 0.2
+    kwargs.setdefault('bn_eps', BN_EPS_TF_DEFAULT)
+    kwargs.setdefault('pad_type', 'same')
+    model = _gen_efficientnet_condconv(
+        'tf_efficientnet_cc_b0_4e', channel_multiplier=1.0, depth_multiplier=1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def tf_efficientnet_cc_b0_8e(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-CondConv-B0 w/ 8 Experts. Tensorflow compatible variant """
+    # NOTE for train, drop_rate should be 0.2, drop_path_rate should be 0.2
+    kwargs.setdefault('bn_eps', BN_EPS_TF_DEFAULT)
+    kwargs.setdefault('pad_type', 'same')
+    model = _gen_efficientnet_condconv(
+        'tf_efficientnet_cc_b0_8e', channel_multiplier=1.0, depth_multiplier=1.0, experts_multiplier=2,
+        pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def tf_efficientnet_cc_b1_8e(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-CondConv-B1 w/ 8 Experts. Tensorflow compatible variant """
+    # NOTE for train, drop_rate should be 0.2, drop_path_rate should be 0.2
+    kwargs.setdefault('bn_eps', BN_EPS_TF_DEFAULT)
+    kwargs.setdefault('pad_type', 'same')
+    model = _gen_efficientnet_condconv(
+        'tf_efficientnet_cc_b1_8e', channel_multiplier=1.0, depth_multiplier=1.1, experts_multiplier=2,
+        pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_x_b3(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B3 """
+    # NOTE for train, drop_rate should be 0.3, drop_path_rate should be 0.2
+    model = _gen_efficientnet_x(
+        'efficientnet_x_b3', channel_multiplier=1.2, depth_multiplier=1.4, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_x_b5(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B5 """
+    model = _gen_efficientnet_x(
+        'efficientnet_x_b5', channel_multiplier=1.6, depth_multiplier=2.2, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_h_b5(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B5 """
+    model = _gen_efficientnet_x(
+        'efficientnet_h_b5', channel_multiplier=1.92, depth_multiplier=2.2, version=2, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mixnet_s(pretrained=False, **kwargs) -> EfficientNet:
+    """Creates a MixNet Small model.
+    """
+    model = _gen_mixnet_s(
+        'mixnet_s', channel_multiplier=1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mixnet_m(pretrained=False, **kwargs) -> EfficientNet:
+    """Creates a MixNet Medium model.
+    """
+    model = _gen_mixnet_m(
+        'mixnet_m', channel_multiplier=1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mixnet_l(pretrained=False, **kwargs) -> EfficientNet:
+    """Creates a MixNet Large model.
+    """
+    model = _gen_mixnet_m(
+        'mixnet_l', channel_multiplier=1.3, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mixnet_xl(pretrained=False, **kwargs) -> EfficientNet:
+    """Creates a MixNet Extra-Large model.
+    Not a paper spec, experimental def by RW w/ depth scaling.
+    """
+    model = _gen_mixnet_m(
+        'mixnet_xl', channel_multiplier=1.6, depth_multiplier=1.2, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mixnet_xxl(pretrained=False, **kwargs) -> EfficientNet:
+    """Creates a MixNet Double Extra Large model.
+    Not a paper spec, experimental def by RW w/ depth scaling.
+    """
+    model = _gen_mixnet_m(
+        'mixnet_xxl', channel_multiplier=2.4, depth_multiplier=1.3, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def tf_mixnet_s(pretrained=False, **kwargs) -> EfficientNet:
+    """Creates a MixNet Small model. Tensorflow compatible variant
+    """
+    kwargs.setdefault('bn_eps', BN_EPS_TF_DEFAULT)
+    kwargs.setdefault('pad_type', 'same')
+    model = _gen_mixnet_s(
+        'tf_mixnet_s', channel_multiplier=1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def tf_mixnet_m(pretrained=False, **kwargs) -> EfficientNet:
+    """Creates a MixNet Medium model. Tensorflow compatible variant
+    """
+    kwargs.setdefault('bn_eps', BN_EPS_TF_DEFAULT)
+    kwargs.setdefault('pad_type', 'same')
+    model = _gen_mixnet_m(
+        'tf_mixnet_m', channel_multiplier=1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def tf_mixnet_l(pretrained=False, **kwargs) -> EfficientNet:
+    """Creates a MixNet Large model. Tensorflow compatible variant
+    """
+    kwargs.setdefault('bn_eps', BN_EPS_TF_DEFAULT)
+    kwargs.setdefault('pad_type', 'same')
+    model = _gen_mixnet_m(
+        'tf_mixnet_l', channel_multiplier=1.3, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenet_edgetpu_100(pretrained=False, **kwargs) -> EfficientNet:
+    """ MobileNet-EdgeTPU-v1 100. """
+    model = _gen_mobilenet_edgetpu('mobilenet_edgetpu_100', pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenet_edgetpu_v2_xs(pretrained=False, **kwargs) -> EfficientNet:
+    """ MobileNet-EdgeTPU-v2 Extra Small. """
+    model = _gen_mobilenet_edgetpu('mobilenet_edgetpu_v2_xs', pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenet_edgetpu_v2_s(pretrained=False, **kwargs) -> EfficientNet:
+    """ MobileNet-EdgeTPU-v2 Small. """
+    model = _gen_mobilenet_edgetpu('mobilenet_edgetpu_v2_s', pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenet_edgetpu_v2_m(pretrained=False, **kwargs) -> EfficientNet:
+    """ MobileNet-EdgeTPU-v2 Medium. """
+    model = _gen_mobilenet_edgetpu('mobilenet_edgetpu_v2_m', pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenet_edgetpu_v2_l(pretrained=False, **kwargs) -> EfficientNet:
+    """ MobileNet-EdgeTPU-v2 Large. """
+    model = _gen_mobilenet_edgetpu('mobilenet_edgetpu_v2_l', pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def test_efficientnet_gn(pretrained=False, **kwargs) -> EfficientNet:
+
+    model = _gen_test_efficientnet(
+        'test_efficientnet_gn',
+        pretrained=pretrained,
+        norm_layer=kwargs.pop('norm_layer', partial(GroupNormAct, group_size=8)),
+        **kwargs
+    )
+    return model
+
+
+@register_model
+def test_efficientnet_ln(pretrained=False, **kwargs) -> EfficientNet:
+    model = _gen_test_efficientnet(
+        'test_efficientnet_ln',
+        pretrained=pretrained,
+        norm_layer=kwargs.pop('norm_layer', LayerNormAct2d),
+        **kwargs
+    )
+    return model
+
+
+@register_model
+def test_efficientnet_evos(pretrained=False, **kwargs) -> EfficientNet:
+    model = _gen_test_efficientnet(
+        'test_efficientnet_evos',
+        pretrained=pretrained,
+        norm_layer=kwargs.pop('norm_layer', partial(EvoNorm2dS0, group_size=8)),
+        **kwargs
+    )
+    return model
+
+
+@register_model
+def efficientnet_b1_pruned(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B1 Pruned. The pruning has been obtained using https://arxiv.org/pdf/2002.08258.pdf  """
+    kwargs.setdefault('bn_eps', BN_EPS_TF_DEFAULT)
+    kwargs.setdefault('pad_type', 'same')
+    variant = 'efficientnet_b1_pruned'
+    model = _gen_efficientnet(
+        variant, channel_multiplier=1.0, depth_multiplier=1.1, pruned=True, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_b2_pruned(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B2 Pruned. The pruning has been obtained using https://arxiv.org/pdf/2002.08258.pdf """
+    kwargs.setdefault('bn_eps', BN_EPS_TF_DEFAULT)
+    kwargs.setdefault('pad_type', 'same')
+    model = _gen_efficientnet(
+        'efficientnet_b2_pruned', channel_multiplier=1.1, depth_multiplier=1.2, pruned=True,
+        pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def efficientnet_b3_pruned(pretrained=False, **kwargs) -> EfficientNet:
+    """ EfficientNet-B3 Pruned. The pruning has been obtained using https://arxiv.org/pdf/2002.08258.pdf """
+    kwargs.setdefault('bn_eps', BN_EPS_TF_DEFAULT)
+    kwargs.setdefault('pad_type', 'same')
+    model = _gen_efficientnet(
+        'efficientnet_b3_pruned', channel_multiplier=1.2, depth_multiplier=1.4, pruned=True,
+        pretrained=pretrained, **kwargs)
+    return model

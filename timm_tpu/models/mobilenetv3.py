@@ -39,11 +39,13 @@ class MobileNetV3(nnx.Module):
             pad_type: str = '',
             act_layer: Union[str, Callable] = 'hard_swish',
             norm_layer: Callable = BatchNormAct2d,
+            aa_layer: Optional[Union[str, Callable]] = None,
             se_layer: Callable = None,
             se_from_exp: bool = True,
             round_chs_fn: Callable = round_channels,
             drop_rate: float = 0.0,
             drop_path_rate: float = 0.0,
+            layer_scale_init_value=None,
             global_pool: str = 'avg',
             *,
             dtype=None,
@@ -70,8 +72,10 @@ class MobileNetV3(nnx.Module):
             se_from_exp=se_from_exp,
             act_layer=act_layer,
             norm_layer=norm_layer,
+            aa_layer=aa_layer,
             se_layer=se_layer,
             drop_path_rate=drop_path_rate,
+            layer_scale_init_value=layer_scale_init_value,
             dtype=dtype,
             param_dtype=param_dtype,
             rngs=rngs,
@@ -181,15 +185,16 @@ class MobileNetV3(nnx.Module):
 
 def _create_mnv3(variant, pretrained=False, arch_def=None, **model_kwargs):
     from .efficientnet import checkpoint_filter_fn as _eff_filter
+    n_stages = len(arch_def) if arch_def is not None else len(model_kwargs.get('block_args', ()))
     return build_model_with_cfg(
         MobileNetV3, variant, pretrained,
         pretrained_filter_fn=_eff_filter,
-        feature_cfg=dict(out_indices=tuple(range(len(arch_def)))),
+        feature_cfg=dict(out_indices=tuple(range(n_stages))),
         **model_kwargs,
     )
 
 
-def _gen_mobilenet_v3(variant: str, channel_multiplier: float = 1.0, pretrained: bool = False, **kwargs):
+def _gen_mobilenet_v3(variant: str, channel_multiplier: float = 1.0, depth_multiplier: float = 1.0, group_size=None, pretrained: bool = False, **kwargs):
     """MobileNet-V3 large/small (+ 'minimal' SE/hswish-free twins)
     (reference mobilenetv3.py:557-666)."""
     if 'small' in variant:
@@ -240,7 +245,7 @@ def _gen_mobilenet_v3(variant: str, channel_multiplier: float = 1.0, pretrained:
             ]
     round_chs_fn = partial(round_channels, multiplier=channel_multiplier)
     model_kwargs = dict(
-        block_args=decode_arch_def(arch_def),
+        block_args=decode_arch_def(arch_def, depth_multiplier=depth_multiplier, group_size=group_size),
         num_features=num_features,
         stem_size=16,
         fix_stem=channel_multiplier < 0.75,
@@ -385,32 +390,61 @@ default_cfgs = generate_default_cfgs({
     'lcnet_075.ra2_in1k': _cfg(hf_hub_id='timm/'),
     'lcnet_100.ra2_in1k': _cfg(hf_hub_id='timm/'),
     'lcnet_150.untrained': _cfg(),
+    'mobilenetv3_large_150d.ra4_e3600_r256_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.95, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), test_input_size=(3, 320, 320), test_crop_pct=1.0),
+    'mobilenetv4_conv_small_035.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, interpolation='bicubic', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), test_input_size=(3, 256, 256), test_crop_pct=0.95),
+    'mobilenetv4_conv_small_050.e3000_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, interpolation='bicubic', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), test_input_size=(3, 256, 256), test_crop_pct=0.95),
+    'mobilenetv4_conv_small.e2400_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 256, 256), test_crop_pct=0.95),
+    'mobilenetv4_conv_small.e1200_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.875, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 256, 256), test_crop_pct=0.95),
+    'mobilenetv4_conv_small.e3600_r256_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.95, interpolation='bicubic', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5), test_input_size=(3, 320, 320), test_crop_pct=1.0),
+    'mobilenetv4_conv_medium.e500_r256_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 320, 320), test_crop_pct=1.0),
+    'mobilenetv4_conv_medium.e500_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 256, 256), test_crop_pct=1.0),
+    'mobilenetv4_conv_medium.e250_r384_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'mobilenetv4_conv_medium.e180_r384_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'mobilenetv4_conv_medium.e180_ad_r384_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'mobilenetv4_conv_medium.e250_r384_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=1.0, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'mobilenetv4_conv_large.e600_r384_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 448, 448), test_crop_pct=1.0),
+    'mobilenetv4_conv_large.e500_r256_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 320, 320), test_crop_pct=1.0),
+    'mobilenetv4_hybrid_medium.e200_r256_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 320, 320), test_crop_pct=1.0),
+    'mobilenetv4_hybrid_medium.ix_e550_r256_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 320, 320), test_crop_pct=1.0),
+    'mobilenetv4_hybrid_medium.ix_e550_r384_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 448, 448), test_crop_pct=1.0),
+    'mobilenetv4_hybrid_medium.e500_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 256, 256), test_crop_pct=1.0),
+    'mobilenetv4_hybrid_medium.e200_r256_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 320, 320), test_crop_pct=1.0),
+    'mobilenetv4_hybrid_large.ix_e600_r384_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 448, 448), test_crop_pct=1.0),
+    'mobilenetv4_hybrid_large.e600_r384_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 448, 448), test_crop_pct=1.0),
+    'mobilenetv4_conv_aa_medium.untrained': _cfg(input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'mobilenetv4_conv_blur_medium.e500_r224_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 256, 256), test_crop_pct=1.0),
+    'mobilenetv4_conv_aa_large.e230_r448_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 448, 448), pool_size=(14, 14), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 544, 544), test_crop_pct=1.0),
+    'mobilenetv4_conv_aa_large.e230_r384_in12k_ft_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 480, 480), test_crop_pct=1.0),
+    'mobilenetv4_conv_aa_large.e600_r384_in1k': _cfg(hf_hub_id='timm/', input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 480, 480), test_crop_pct=1.0),
+    'mobilenetv4_conv_aa_large.e230_r384_in12k': _cfg(hf_hub_id='timm/', num_classes=11821, input_size=(3, 384, 384), pool_size=(12, 12), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225), test_input_size=(3, 448, 448), test_crop_pct=1.0),
+    'mobilenetv4_hybrid_medium_075.untrained': _cfg(input_size=(3, 224, 224), pool_size=(7, 7), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    'mobilenetv4_hybrid_large_075.untrained': _cfg(input_size=(3, 256, 256), pool_size=(8, 8), crop_pct=0.95, interpolation='bicubic', mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
 })
 
 
 @register_model
 def mobilenetv3_large_075(pretrained=False, **kwargs) -> MobileNetV3:
-    return _gen_mobilenet_v3('mobilenetv3_large_075', 0.75, pretrained, **kwargs)
+    return _gen_mobilenet_v3('mobilenetv3_large_075', 0.75, pretrained=pretrained, **kwargs)
 
 
 @register_model
 def mobilenetv3_large_100(pretrained=False, **kwargs) -> MobileNetV3:
-    return _gen_mobilenet_v3('mobilenetv3_large_100', 1.0, pretrained, **kwargs)
+    return _gen_mobilenet_v3('mobilenetv3_large_100', 1.0, pretrained=pretrained, **kwargs)
 
 
 @register_model
 def mobilenetv3_small_050(pretrained=False, **kwargs) -> MobileNetV3:
-    return _gen_mobilenet_v3('mobilenetv3_small_050', 0.5, pretrained, **kwargs)
+    return _gen_mobilenet_v3('mobilenetv3_small_050', 0.5, pretrained=pretrained, **kwargs)
 
 
 @register_model
 def mobilenetv3_small_075(pretrained=False, **kwargs) -> MobileNetV3:
-    return _gen_mobilenet_v3('mobilenetv3_small_075', 0.75, pretrained, **kwargs)
+    return _gen_mobilenet_v3('mobilenetv3_small_075', 0.75, pretrained=pretrained, **kwargs)
 
 
 @register_model
 def mobilenetv3_small_100(pretrained=False, **kwargs) -> MobileNetV3:
-    return _gen_mobilenet_v3('mobilenetv3_small_100', 1.0, pretrained, **kwargs)
+    return _gen_mobilenet_v3('mobilenetv3_small_100', 1.0, pretrained=pretrained, **kwargs)
 
 
 @register_model
@@ -424,42 +458,42 @@ def mobilenetv3_rw(pretrained=False, **kwargs) -> MobileNetV3:
 def tf_mobilenetv3_large_075(pretrained=False, **kwargs) -> MobileNetV3:
     kwargs.setdefault('bn_eps', 1e-3)
     kwargs.setdefault('pad_type', 'same')
-    return _gen_mobilenet_v3('tf_mobilenetv3_large_075', 0.75, pretrained, **kwargs)
+    return _gen_mobilenet_v3('tf_mobilenetv3_large_075', 0.75, pretrained=pretrained, **kwargs)
 
 
 @register_model
 def tf_mobilenetv3_large_100(pretrained=False, **kwargs) -> MobileNetV3:
     kwargs.setdefault('bn_eps', 1e-3)
     kwargs.setdefault('pad_type', 'same')
-    return _gen_mobilenet_v3('tf_mobilenetv3_large_100', 1.0, pretrained, **kwargs)
+    return _gen_mobilenet_v3('tf_mobilenetv3_large_100', 1.0, pretrained=pretrained, **kwargs)
 
 
 @register_model
 def tf_mobilenetv3_large_minimal_100(pretrained=False, **kwargs) -> MobileNetV3:
     kwargs.setdefault('bn_eps', 1e-3)
     kwargs.setdefault('pad_type', 'same')
-    return _gen_mobilenet_v3('tf_mobilenetv3_large_minimal_100', 1.0, pretrained, **kwargs)
+    return _gen_mobilenet_v3('tf_mobilenetv3_large_minimal_100', 1.0, pretrained=pretrained, **kwargs)
 
 
 @register_model
 def tf_mobilenetv3_small_075(pretrained=False, **kwargs) -> MobileNetV3:
     kwargs.setdefault('bn_eps', 1e-3)
     kwargs.setdefault('pad_type', 'same')
-    return _gen_mobilenet_v3('tf_mobilenetv3_small_075', 0.75, pretrained, **kwargs)
+    return _gen_mobilenet_v3('tf_mobilenetv3_small_075', 0.75, pretrained=pretrained, **kwargs)
 
 
 @register_model
 def tf_mobilenetv3_small_100(pretrained=False, **kwargs) -> MobileNetV3:
     kwargs.setdefault('bn_eps', 1e-3)
     kwargs.setdefault('pad_type', 'same')
-    return _gen_mobilenet_v3('tf_mobilenetv3_small_100', 1.0, pretrained, **kwargs)
+    return _gen_mobilenet_v3('tf_mobilenetv3_small_100', 1.0, pretrained=pretrained, **kwargs)
 
 
 @register_model
 def tf_mobilenetv3_small_minimal_100(pretrained=False, **kwargs) -> MobileNetV3:
     kwargs.setdefault('bn_eps', 1e-3)
     kwargs.setdefault('pad_type', 'same')
-    return _gen_mobilenet_v3('tf_mobilenetv3_small_minimal_100', 1.0, pretrained, **kwargs)
+    return _gen_mobilenet_v3('tf_mobilenetv3_small_minimal_100', 1.0, pretrained=pretrained, **kwargs)
 
 
 @register_model
@@ -503,3 +537,278 @@ def lcnet_150(pretrained=False, **kwargs) -> MobileNetV3:
 
 
 from .efficientnet import checkpoint_filter_fn  # noqa: E402,F401
+
+
+def _gen_mobilenet_v4(
+        variant: str,
+        channel_multiplier: float = 1.0,
+        group_size=None,
+        pretrained: bool = False,
+        **kwargs,
+) -> MobileNetV3:
+    """MobileNet-V4 (reference mobilenetv3.py:785-1041): universal inverted
+    bottleneck (uir) stages, with multi-query mobile attention (mqa) blocks in
+    the hybrid variants."""
+    num_features = 1280
+    if 'hybrid' in variant:
+        layer_scale_init_value = 1e-5
+        if 'medium' in variant:
+            stem_size = 32
+            act_layer = resolve_act_layer(kwargs, 'relu')
+            arch_def = [
+                ['er_r1_k3_s2_e4_c48'],
+                ['uir_r1_a3_k5_s2_e4_c80', 'uir_r1_a3_k3_s1_e2_c80'],
+                [
+                    'uir_r1_a3_k5_s2_e6_c160',
+                    'uir_r1_a0_k0_s1_e2_c160',
+                    'uir_r1_a3_k3_s1_e4_c160',
+                    'uir_r1_a3_k5_s1_e4_c160',
+                    'mqa_r1_k3_h4_s1_v2_d64_c160',
+                    'uir_r1_a3_k3_s1_e4_c160',
+                    'mqa_r1_k3_h4_s1_v2_d64_c160',
+                    'uir_r1_a3_k0_s1_e4_c160',
+                    'mqa_r1_k3_h4_s1_v2_d64_c160',
+                    'uir_r1_a3_k3_s1_e4_c160',
+                    'mqa_r1_k3_h4_s1_v2_d64_c160',
+                    'uir_r1_a3_k0_s1_e4_c160',
+                ],
+                [
+                    'uir_r1_a5_k5_s2_e6_c256',
+                    'uir_r1_a5_k5_s1_e4_c256',
+                    'uir_r2_a3_k5_s1_e4_c256',
+                    'uir_r1_a0_k0_s1_e2_c256',
+                    'uir_r1_a3_k5_s1_e2_c256',
+                    'uir_r1_a0_k0_s1_e2_c256',
+                    'uir_r1_a0_k0_s1_e4_c256',
+                    'mqa_r1_k3_h4_s1_d64_c256',
+                    'uir_r1_a3_k0_s1_e4_c256',
+                    'mqa_r1_k3_h4_s1_d64_c256',
+                    'uir_r1_a5_k5_s1_e4_c256',
+                    'mqa_r1_k3_h4_s1_d64_c256',
+                    'uir_r1_a5_k0_s1_e4_c256',
+                    'mqa_r1_k3_h4_s1_d64_c256',
+                    'uir_r1_a5_k0_s1_e4_c256',
+                ],
+                ['cn_r1_k1_s1_c960'],
+            ]
+        elif 'large' in variant:
+            stem_size = 24
+            act_layer = resolve_act_layer(kwargs, 'gelu')
+            arch_def = [
+                ['er_r1_k3_s2_e4_c48'],
+                ['uir_r1_a3_k5_s2_e4_c96', 'uir_r1_a3_k3_s1_e4_c96'],
+                [
+                    'uir_r1_a3_k5_s2_e4_c192',
+                    'uir_r3_a3_k3_s1_e4_c192',
+                    'uir_r1_a3_k5_s1_e4_c192',
+                    'uir_r2_a5_k3_s1_e4_c192',
+                    'mqa_r1_k3_h8_s1_v2_d48_c192',
+                    'uir_r1_a5_k3_s1_e4_c192',
+                    'mqa_r1_k3_h8_s1_v2_d48_c192',
+                    'uir_r1_a5_k3_s1_e4_c192',
+                    'mqa_r1_k3_h8_s1_v2_d48_c192',
+                    'uir_r1_a5_k3_s1_e4_c192',
+                    'mqa_r1_k3_h8_s1_v2_d48_c192',
+                    'uir_r1_a3_k0_s1_e4_c192',
+                ],
+                [
+                    'uir_r4_a5_k5_s2_e4_c512',
+                    'uir_r1_a5_k0_s1_e4_c512',
+                    'uir_r1_a5_k3_s1_e4_c512',
+                    'uir_r2_a5_k0_s1_e4_c512',
+                    'uir_r1_a5_k3_s1_e4_c512',
+                    'uir_r1_a5_k5_s1_e4_c512',
+                    'mqa_r1_k3_h8_s1_d64_c512',
+                    'uir_r1_a5_k0_s1_e4_c512',
+                    'mqa_r1_k3_h8_s1_d64_c512',
+                    'uir_r1_a5_k0_s1_e4_c512',
+                    'mqa_r1_k3_h8_s1_d64_c512',
+                    'uir_r1_a5_k0_s1_e4_c512',
+                    'mqa_r1_k3_h8_s1_d64_c512',
+                    'uir_r1_a5_k0_s1_e4_c512',
+                ],
+                ['cn_r1_k1_s1_c960'],
+            ]
+        else:
+            raise AssertionError(f'Unknown variant {variant}.')
+    else:
+        layer_scale_init_value = None
+        if 'small' in variant:
+            stem_size = 32
+            act_layer = resolve_act_layer(kwargs, 'relu')
+            arch_def = [
+                ['cn_r1_k3_s2_e1_c32', 'cn_r1_k1_s1_e1_c32'],
+                ['cn_r1_k3_s2_e1_c96', 'cn_r1_k1_s1_e1_c64'],
+                [
+                    'uir_r1_a5_k5_s2_e3_c96',
+                    'uir_r4_a0_k3_s1_e2_c96',
+                    'uir_r1_a3_k0_s1_e4_c96',
+                ],
+                [
+                    'uir_r1_a3_k3_s2_e6_c128',
+                    'uir_r1_a5_k5_s1_e4_c128',
+                    'uir_r1_a0_k5_s1_e4_c128',
+                    'uir_r1_a0_k5_s1_e3_c128',
+                    'uir_r2_a0_k3_s1_e4_c128',
+                ],
+                ['cn_r1_k1_s1_c960'],
+            ]
+        elif 'medium' in variant:
+            stem_size = 32
+            act_layer = resolve_act_layer(kwargs, 'relu')
+            arch_def = [
+                ['er_r1_k3_s2_e4_c48'],
+                ['uir_r1_a3_k5_s2_e4_c80', 'uir_r1_a3_k3_s1_e2_c80'],
+                [
+                    'uir_r1_a3_k5_s2_e6_c160',
+                    'uir_r2_a3_k3_s1_e4_c160',
+                    'uir_r1_a3_k5_s1_e4_c160',
+                    'uir_r1_a3_k3_s1_e4_c160',
+                    'uir_r1_a3_k0_s1_e4_c160',
+                    'uir_r1_a0_k0_s1_e2_c160',
+                    'uir_r1_a3_k0_s1_e4_c160',
+                ],
+                [
+                    'uir_r1_a5_k5_s2_e6_c256',
+                    'uir_r1_a5_k5_s1_e4_c256',
+                    'uir_r2_a3_k5_s1_e4_c256',
+                    'uir_r1_a0_k0_s1_e4_c256',
+                    'uir_r1_a3_k0_s1_e4_c256',
+                    'uir_r1_a3_k5_s1_e2_c256',
+                    'uir_r1_a5_k5_s1_e4_c256',
+                    'uir_r2_a0_k0_s1_e4_c256',
+                    'uir_r1_a5_k0_s1_e2_c256',
+                ],
+                ['cn_r1_k1_s1_c960'],
+            ]
+        elif 'large' in variant:
+            stem_size = 24
+            act_layer = resolve_act_layer(kwargs, 'relu')
+            arch_def = [
+                ['er_r1_k3_s2_e4_c48'],
+                ['uir_r1_a3_k5_s2_e4_c96', 'uir_r1_a3_k3_s1_e4_c96'],
+                [
+                    'uir_r1_a3_k5_s2_e4_c192',
+                    'uir_r3_a3_k3_s1_e4_c192',
+                    'uir_r1_a3_k5_s1_e4_c192',
+                    'uir_r5_a5_k3_s1_e4_c192',
+                    'uir_r1_a3_k0_s1_e4_c192',
+                ],
+                [
+                    'uir_r4_a5_k5_s2_e4_c512',
+                    'uir_r1_a5_k0_s1_e4_c512',
+                    'uir_r1_a5_k3_s1_e4_c512',
+                    'uir_r2_a5_k0_s1_e4_c512',
+                    'uir_r1_a5_k3_s1_e4_c512',
+                    'uir_r1_a5_k5_s1_e4_c512',
+                    'uir_r3_a5_k0_s1_e4_c512',
+                ],
+                ['cn_r1_k1_s1_c960'],
+            ]
+        else:
+            raise AssertionError(f'Unknown variant {variant}.')
+
+    model_kwargs = dict(
+        block_args=decode_arch_def(arch_def, group_size=group_size),
+        head_bias=False,
+        head_norm=True,
+        num_features=num_features,
+        stem_size=stem_size,
+        fix_stem=channel_multiplier < 1.0,
+        round_chs_fn=partial(round_channels, multiplier=channel_multiplier),
+        act_layer=act_layer,
+        layer_scale_init_value=layer_scale_init_value,
+        **kwargs,
+    )
+    return _create_mnv3(variant, pretrained, **model_kwargs)
+
+
+@register_model
+def mobilenetv3_large_150d(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V3 """
+    model = _gen_mobilenet_v3('mobilenetv3_large_150d', 1.5, depth_multiplier=1.2, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_conv_small_035(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 """
+    model = _gen_mobilenet_v4('mobilenetv4_conv_small_035', 0.35, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_conv_small_050(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 """
+    model = _gen_mobilenet_v4('mobilenetv4_conv_small_050', 0.50, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_conv_small(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 """
+    model = _gen_mobilenet_v4('mobilenetv4_conv_small', 1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_conv_medium(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 """
+    model = _gen_mobilenet_v4('mobilenetv4_conv_medium', 1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_conv_large(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 """
+    model = _gen_mobilenet_v4('mobilenetv4_conv_large', 1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_hybrid_medium(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 Hybrid """
+    model = _gen_mobilenet_v4('mobilenetv4_hybrid_medium', 1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_hybrid_large(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 Hybrid"""
+    model = _gen_mobilenet_v4('mobilenetv4_hybrid_large', 1.0, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_conv_aa_medium(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 w/ AvgPool AA """
+    model = _gen_mobilenet_v4('mobilenetv4_conv_aa_medium', 1.0, pretrained=pretrained, aa_layer='avg', **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_conv_blur_medium(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 Conv w/ Blur AA """
+    model = _gen_mobilenet_v4('mobilenetv4_conv_blur_medium', 1.0, pretrained=pretrained, aa_layer='blurpc', **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_conv_aa_large(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 w/ AvgPool AA """
+    model = _gen_mobilenet_v4('mobilenetv4_conv_aa_large', 1.0, pretrained=pretrained, aa_layer='avg', **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_hybrid_medium_075(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 Hybrid """
+    model = _gen_mobilenet_v4('mobilenetv4_hybrid_medium_075', 0.75, pretrained=pretrained, **kwargs)
+    return model
+
+
+@register_model
+def mobilenetv4_hybrid_large_075(pretrained: bool = False, **kwargs) -> MobileNetV3:
+    """ MobileNet V4 Hybrid"""
+    model = _gen_mobilenet_v4('mobilenetv4_hybrid_large_075', 0.75, pretrained=pretrained, **kwargs)
+    return model

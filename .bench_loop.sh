@@ -1,14 +1,15 @@
 #!/bin/bash
-# Round-4 bench self-measurement loop: keep trying until the TPU answers,
-# then refresh the self-measured result every ~45 min.
+# Round-5 bench self-measurement loop: keep trying until the TPU answers,
+# then refresh the self-measured result every ~45 min. The self loop can
+# afford a much larger wall-clock budget than the driver's run.
 cd /root/repo
 while true; do
-  python bench.py --save-self >> /tmp/bench_loop.log 2>&1
+  BENCH_TOTAL_BUDGET=1800 python bench.py --save-self >> /tmp/bench_loop.log 2>&1
   rc=$?
   echo "[$(date -u +%FT%TZ)] bench.py --save-self rc=$rc" >> /tmp/bench_loop.log
   if [ $rc -eq 0 ]; then
     sleep 2700
   else
-    sleep 300
+    sleep 180
   fi
 done

@@ -20,17 +20,9 @@ from .attention import maybe_add_mask
 from .create_conv2d import create_conv2d
 from .drop import Dropout, dropout_rng_key
 from .helpers import to_2tuple
+from .pool import Pool2d
 
 __all__ = ['MultiQueryAttentionV2', 'MultiQueryAttention2d', 'Attention2d']
-
-
-def _avg_pool2d(x, kernel, stride=None, same: bool = False):
-    stride = stride or kernel
-    k = to_2tuple(kernel)
-    s = to_2tuple(stride)
-    pad = 'SAME' if same else 'VALID'
-    out = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, k[0], k[1], 1), (1, s[0], s[1], 1), pad)
-    return out / (k[0] * k[1])
 
 
 class MultiQueryAttentionV2(nnx.Module):
@@ -97,7 +89,7 @@ class _QueryDown(nnx.Module):
 
     def __call__(self, x):
         if self.norm is not None:
-            x = _avg_pool2d(x, self.query_strides, same=self.pad_same)
+            x = Pool2d('avg', self.query_strides, padding='same' if self.pad_same else 0)(x)
             x = self.norm(x)
         return self.proj(x)
 

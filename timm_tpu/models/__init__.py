@@ -55,3 +55,7 @@ from .volo import VOLO
 from .xcit import Xcit
 from .vision_transformer import VisionTransformer
 from .vision_transformer_hybrid import *  # noqa: F401,F403 — registers hybrid vit entrypoints
+from .convmixer import ConvMixer
+from .hardcorenas import *  # noqa: F401,F403 — registers hardcorenas entrypoints
+from .starnet import StarNet
+from .xception import Xception

@@ -244,6 +244,15 @@ class EfficientNetBuilder:
         self.norm_layer = norm_layer
         self.aa_layer = get_aa_layer(aa_layer)
         self.se_layer = se_layer
+        import inspect
+        _se_base = se_layer.func if isinstance(se_layer, partial) else se_layer
+        try:
+            _se_params = inspect.signature(_se_base.__init__).parameters
+        except (TypeError, ValueError):
+            _se_params = {}
+        _se_bound = getattr(se_layer, 'keywords', {}) or {}
+        self.se_has_ratio = 'rd_ratio' in _se_params or 'rd_ratio' in _se_bound
+        self.se_plain_round = 'rd_round_fn' in _se_params and 'rd_round_fn' not in _se_bound
         self.drop_path_rate = drop_path_rate
         self.layer_scale_init_value = layer_scale_init_value
         self.dtype = dtype
@@ -276,22 +285,15 @@ class EfficientNetBuilder:
             if s2d == 1:
                 # adjust for start of space2depth
                 se_ratio /= 4
-            import inspect
-            bound = getattr(self.se_layer, 'keywords', {}) or {}
-            base = self.se_layer.func if isinstance(self.se_layer, partial) else self.se_layer
-            try:
-                params = inspect.signature(base.__init__).parameters
-            except (TypeError, ValueError):
-                params = {}
-            if 'rd_round_fn' in bound or 'rd_round_fn' not in params:
-                # alt attn modules (e.g. GlobalContext) take rd_ratio only
-                se_layer = partial(self.se_layer, rd_ratio=se_ratio) \
-                    if 'rd_ratio' in params or 'rd_ratio' in bound or isinstance(self.se_layer, partial) \
-                    else self.se_layer
-            else:
+            if self.se_plain_round:
                 # EfficientNet-family SE uses plain rounding (reference
                 # _efficientnet_blocks.py: rd_round_fn or round)
                 se_layer = partial(self.se_layer, rd_ratio=se_ratio, rd_round_fn=round)
+            elif self.se_has_ratio:
+                se_layer = partial(self.se_layer, rd_ratio=se_ratio)
+            else:
+                # layer takes no ratio (reference builder drops it too)
+                se_layer = self.se_layer
         common = dict(dtype=self.dtype, param_dtype=self.param_dtype, rngs=self.rngs)
 
         if bt == 'ir':

@@ -1,0 +1,201 @@
+"""ConvMixer (reference: timm/models/convmixer.py:1-150), TPU-native NHWC.
+
+Patch-embed stem then depth x (residual dw conv + pw conv), each followed by
+act + BN. NHWC keeps the pw conv a plain matmul on the MXU and the large-k
+depthwise conv maps to the vector unit without layout shuffles.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNorm2d, SelectAdaptivePool2d, create_conv2d, get_act_fn, trunc_normal_, zeros_
+from ..layers.drop import Dropout
+from ._builder import build_model_with_cfg
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['ConvMixer']
+
+
+class ConvMixerBlock(nnx.Module):
+    """Residual dw conv (+act+BN) then pw conv (+act+BN)
+    (reference convmixer.py:56-66 Sequential layout)."""
+
+    def __init__(self, dim, kernel_size, act_layer, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv_dw = create_conv2d(dim, dim, kernel_size, padding='same', depthwise=True, bias=True, **kw)
+        self.bn1 = BatchNorm2d(dim, rngs=rngs)
+        self.conv_pw = create_conv2d(dim, dim, 1, bias=True, **kw)
+        self.bn2 = BatchNorm2d(dim, rngs=rngs)
+        self.act = get_act_fn(act_layer)
+
+    def __call__(self, x):
+        x = x + self.bn1(self.act(self.conv_dw(x)))
+        return self.bn2(self.act(self.conv_pw(x)))
+
+
+class ConvMixer(nnx.Module):
+    """(reference convmixer.py:27-106)."""
+
+    def __init__(
+            self,
+            dim: int,
+            depth: int,
+            kernel_size: int = 9,
+            patch_size: int = 7,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            drop_rate: float = 0.0,
+            act_layer: Union[str, Callable] = 'gelu',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.num_classes = num_classes
+        self.num_features = self.head_hidden_size = dim
+        self.grad_checkpointing = False
+
+        self.stem_conv = create_conv2d(in_chans, dim, patch_size, stride=patch_size, padding=0, bias=True, **kw)
+        self.stem_bn = BatchNorm2d(dim, rngs=rngs)
+        self.act = get_act_fn(act_layer)
+        self.blocks = nnx.List([
+            ConvMixerBlock(dim, kernel_size, act_layer, **kw) for _ in range(depth)])
+        self.feature_info = [dict(num_chs=dim, reduction=patch_size, module=f'blocks.{i}') for i in range(depth)]
+        self.pooling = SelectAdaptivePool2d(pool_type=global_pool, flatten=True)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.head = nnx.Linear(
+            dim, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            **kw) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(stem=r'^stem', blocks=r'^blocks\.(\d+)')
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.pooling = SelectAdaptivePool2d(pool_type=global_pool, flatten=True)
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    def forward_features(self, x):
+        x = self.stem_bn(self.act(self.stem_conv(x)))
+        if self.grad_checkpointing:
+            x = checkpoint_seq(self.blocks, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = self.pooling(x)
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        from ._features import feature_take_indices
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        x = self.stem_bn(self.act(self.stem_conv(x)))
+        intermediates = []
+        blocks = self.blocks if not stop_early else list(self.blocks)[:max_index + 1]
+        for i, blk in enumerate(blocks):
+            x = blk(x)
+            if i in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        from ._features import feature_take_indices
+        take_indices, max_index = feature_take_indices(len(self.blocks), indices)
+        self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    """Reference uses nested Sequential indices
+    (stem.0/2, blocks.N.0.fn.0/2, blocks.N.1/3)."""
+    import re
+
+    from ._torch_convert import convert_torch_state_dict
+    out = {}
+    for k, v in state_dict.items():
+        k = re.sub(r'^stem\.0\.', 'stem_conv.', k)
+        k = re.sub(r'^stem\.2\.', 'stem_bn.', k)
+        k = re.sub(r'^blocks\.(\d+)\.0\.fn\.0\.', r'blocks.\1.conv_dw.', k)
+        k = re.sub(r'^blocks\.(\d+)\.0\.fn\.2\.', r'blocks.\1.bn1.', k)
+        k = re.sub(r'^blocks\.(\d+)\.1\.', r'blocks.\1.conv_pw.', k)
+        k = re.sub(r'^blocks\.(\d+)\.3\.', r'blocks.\1.bn2.', k)
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_convmixer(variant, pretrained=False, **kwargs):
+    return build_model_with_cfg(
+        ConvMixer, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        **kwargs,
+    )
+
+
+def _cfg(url='', **kwargs):
+    return {
+        'url': url,
+        'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': None,
+        'crop_pct': 0.96, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225), 'classifier': 'head',
+        'first_conv': 'stem_conv', 'license': 'mit',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'convmixer_1536_20.in1k': _cfg(hf_hub_id='timm/'),
+    'convmixer_768_32.in1k': _cfg(hf_hub_id='timm/'),
+    'convmixer_1024_20_ks9_p14.in1k': _cfg(hf_hub_id='timm/'),
+})
+
+
+@register_model
+def convmixer_1536_20(pretrained=False, **kwargs) -> ConvMixer:
+    model_args = dict(dim=1536, depth=20, kernel_size=9, patch_size=7)
+    return _create_convmixer('convmixer_1536_20', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convmixer_768_32(pretrained=False, **kwargs) -> ConvMixer:
+    model_args = dict(dim=768, depth=32, kernel_size=7, patch_size=7, act_layer='relu')
+    return _create_convmixer('convmixer_768_32', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def convmixer_1024_20_ks9_p14(pretrained=False, **kwargs) -> ConvMixer:
+    model_args = dict(dim=1024, depth=20, kernel_size=9, patch_size=14)
+    return _create_convmixer('convmixer_1024_20_ks9_p14', pretrained, **dict(model_args, **kwargs))

@@ -202,10 +202,15 @@ def _create_effnet(variant, pretrained=False, **kwargs):
     bn_args = resolve_bn_args(kwargs)
     if bn_args:
         kwargs['norm_layer'] = partial(BatchNormAct2d, **bn_args)
+    n_stacks = len(kwargs.get('block_args', ()))
+    # standard 7-stack effnet/mnv2 shapes expose the 5 stride-level stacks like
+    # the reference; shorter archs (mobilenetv1, mixnet, test fixtures) expose
+    # every stack
+    out_indices = (1, 2, 3, 4, 5) if n_stacks == 7 else tuple(range(n_stacks))
     return build_model_with_cfg(
         EfficientNet, variant, pretrained,
         pretrained_filter_fn=_filter_fn,
-        feature_cfg=dict(out_indices=(1, 2, 3, 4, 5)),
+        feature_cfg=dict(out_indices=out_indices),
         **kwargs,
     )
 

@@ -1,0 +1,282 @@
+"""StarNet (reference: timm/models/starnet.py:1-362), TPU-native NHWC.
+
+Element-wise-multiplication ("star") blocks: dw 7x7 conv, two parallel 1x1
+expansions whose product (act(f1) * f2) forms the mixer, then 1x1 + dw back
+down. All convs stay NHWC; the two 1x1 branches are one fused matmul pair on
+the MXU.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    BatchNorm2d, DropPath, SelectAdaptivePool2d, calculate_drop_path_rates,
+    create_conv2d, get_act_fn, trunc_normal_, zeros_,
+)
+from ..layers.drop import Dropout
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['StarNet']
+
+
+class ConvBN(nnx.Module):
+    """conv (+ optional BN) keeping the reference's ``.conv``/``.bn`` names
+    (reference starnet.py:28-48)."""
+
+    def __init__(self, in_chs, out_chs, kernel_size=1, stride=1, padding=0, groups=1,
+                 with_bn=True, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.conv = create_conv2d(
+            in_chs, out_chs, kernel_size, stride=stride, padding=padding, groups=groups,
+            bias=True, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.bn = BatchNorm2d(out_chs, rngs=rngs) if with_bn else None
+
+    def __call__(self, x):
+        x = self.conv(x)
+        if self.bn is not None:
+            x = self.bn(x)
+        return x
+
+
+class StarBlock(nnx.Module):
+    """(reference starnet.py:51-80)."""
+
+    def __init__(self, dim, mlp_ratio=3, drop_path=0.0, act_layer='relu6',
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.dwconv = ConvBN(dim, dim, 7, 1, 3, groups=dim, with_bn=True, **kw)
+        self.f1 = ConvBN(dim, mlp_ratio * dim, 1, with_bn=False, **kw)
+        self.f2 = ConvBN(dim, mlp_ratio * dim, 1, with_bn=False, **kw)
+        self.g = ConvBN(mlp_ratio * dim, dim, 1, with_bn=True, **kw)
+        self.dwconv2 = ConvBN(dim, dim, 7, 1, 3, groups=dim, with_bn=False, **kw)
+        self.act = get_act_fn(act_layer)
+        self.drop_path = DropPath(drop_path, rngs=rngs)
+
+    def __call__(self, x):
+        residual = x
+        x = self.dwconv(x)
+        x1, x2 = self.f1(x), self.f2(x)
+        x = self.act(x1) * x2
+        x = self.dwconv2(self.g(x))
+        return residual + self.drop_path(x)
+
+
+class StarNet(nnx.Module):
+    """(reference starnet.py:83-270)."""
+
+    def __init__(
+            self,
+            base_dim: int = 32,
+            depths: Tuple[int, ...] = (3, 3, 12, 5),
+            mlp_ratio: int = 4,
+            drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            act_layer: Union[str, Callable] = 'relu6',
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            global_pool: str = 'avg',
+            output_stride: int = 32,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert output_stride == 32
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        self.grad_checkpointing = False
+        self.feature_info = []
+        stem_chs = 32
+
+        # stem: ConvBN at Sequential index 0 (act is paramless)
+        self.stem = nnx.List([ConvBN(in_chans, stem_chs, 3, stride=2, padding=1, **kw)])
+        self.stem_act = get_act_fn(act_layer)
+        prev_chs = stem_chs
+
+        dpr = calculate_drop_path_rates(drop_path_rate, sum(depths))
+        stages = []
+        cur = 0
+        for i_layer, depth in enumerate(depths):
+            embed_dim = base_dim * 2 ** i_layer
+            # stage keeps the reference Sequential layout: index 0 is the
+            # downsampler, 1..depth are blocks
+            stage = [ConvBN(prev_chs, embed_dim, 3, stride=2, padding=1, **kw)]
+            stage += [StarBlock(embed_dim, mlp_ratio, dpr[cur + i], act_layer, **kw) for i in range(depth)]
+            cur += depth
+            prev_chs = embed_dim
+            stages.append(nnx.List(stage))
+            self.feature_info.append(dict(
+                num_chs=prev_chs, reduction=2 ** (i_layer + 2), module=f'stages.{i_layer}'))
+        self.stages = nnx.List(stages)
+
+        self.num_features = self.head_hidden_size = prev_chs
+        self.norm = BatchNorm2d(self.num_features, rngs=rngs)
+        self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=bool(global_pool))
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.head = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^stem\.\d+',
+            blocks=[
+                (r'^stages\.(\d+)' if coarse else r'^stages\.(\d+)\.(\d+)', None),
+                (r'norm', (99999,)),
+            ])
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=bool(global_pool))
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.head = nnx.Linear(
+            self.head_hidden_size, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def _stem(self, x):
+        return self.stem_act(self.stem[0](x))
+
+    def forward_features(self, x):
+        x = self._stem(x)
+        for stage in self.stages:
+            if self.grad_checkpointing:
+                x = checkpoint_seq(stage, x)
+            else:
+                for m in stage:
+                    x = m(x)
+        return self.norm(x)
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = self.global_pool(x)
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        x = self._stem(x)
+        intermediates = []
+        stages = self.stages if not stop_early else list(self.stages)[:max_index + 1]
+        for i, stage in enumerate(stages):
+            for m in stage:
+                x = m(x)
+            if i in take_indices:
+                intermediates.append(self.norm(x) if (norm and i == len(self.stages) - 1) else x)
+        if intermediates_only:
+            return intermediates
+        x = self.norm(x)
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        self.stages = nnx.List(list(self.stages)[:max_index + 1])
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    state_dict = state_dict.get('state_dict', state_dict)
+    return convert_torch_state_dict(state_dict, model)
+
+
+def _cfg(url: str = '', **kwargs):
+    return {
+        'url': url, 'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'stem.0.conv', 'classifier': 'head',
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'starnet_s1.in1k': _cfg(hf_hub_id='timm/'),
+    'starnet_s2.in1k': _cfg(hf_hub_id='timm/'),
+    'starnet_s3.in1k': _cfg(hf_hub_id='timm/'),
+    'starnet_s4.in1k': _cfg(hf_hub_id='timm/'),
+    'starnet_s050.untrained': _cfg(),
+    'starnet_s100.untrained': _cfg(),
+    'starnet_s150.untrained': _cfg(),
+})
+
+
+def _create_starnet(variant: str, pretrained: bool = False, **kwargs) -> StarNet:
+    return build_model_with_cfg(
+        StarNet, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=(0, 1, 2, 3)),
+        **kwargs,
+    )
+
+
+@register_model
+def starnet_s1(pretrained: bool = False, **kwargs) -> StarNet:
+    model_args = dict(base_dim=24, depths=[2, 2, 8, 3])
+    return _create_starnet('starnet_s1', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def starnet_s2(pretrained: bool = False, **kwargs) -> StarNet:
+    model_args = dict(base_dim=32, depths=[1, 2, 6, 2])
+    return _create_starnet('starnet_s2', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def starnet_s3(pretrained: bool = False, **kwargs) -> StarNet:
+    model_args = dict(base_dim=32, depths=[2, 2, 8, 4])
+    return _create_starnet('starnet_s3', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def starnet_s4(pretrained: bool = False, **kwargs) -> StarNet:
+    model_args = dict(base_dim=32, depths=[3, 3, 12, 5])
+    return _create_starnet('starnet_s4', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def starnet_s050(pretrained: bool = False, **kwargs) -> StarNet:
+    model_args = dict(base_dim=16, depths=[1, 1, 3, 1], mlp_ratio=3)
+    return _create_starnet('starnet_s050', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def starnet_s100(pretrained: bool = False, **kwargs) -> StarNet:
+    model_args = dict(base_dim=20, depths=[1, 2, 4, 1], mlp_ratio=4)
+    return _create_starnet('starnet_s100', pretrained=pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def starnet_s150(pretrained: bool = False, **kwargs) -> StarNet:
+    model_args = dict(base_dim=24, depths=[1, 2, 4, 2], mlp_ratio=3)
+    return _create_starnet('starnet_s150', pretrained=pretrained, **dict(model_args, **kwargs))

@@ -1,0 +1,272 @@
+"""Xception (legacy Keras port) (reference: timm/models/xception.py:1-298),
+TPU-native NHWC.
+
+Depthwise-separable conv blocks with conv shortcuts; 299x299 eval. The
+reference stores block bodies as Sequentials with interleaved paramless ReLU /
+MaxPool entries — here blocks keep (sep, bn) pairs and the checkpoint filter
+maps the reference's Sequential indices onto them.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNorm2d, Pool2d, SelectAdaptivePool2d, create_conv2d, trunc_normal_, zeros_
+from ..layers.drop import Dropout
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['Xception']
+
+
+class SeparableConv2d(nnx.Module):
+    """dw conv (named ``conv1``) + pw conv (named ``pointwise``)
+    (reference xception.py:25-54)."""
+
+    def __init__(self, in_chs, out_chs, kernel_size=1, stride=1, padding=0, dilation=1,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv1 = create_conv2d(
+            in_chs, in_chs, kernel_size, stride=stride, padding=padding, dilation=dilation,
+            depthwise=True, **kw)
+        self.pointwise = create_conv2d(in_chs, out_chs, 1, padding=0, **kw)
+
+    def __call__(self, x):
+        return self.pointwise(self.conv1(x))
+
+
+class XceptionBlock(nnx.Module):
+    """(reference xception.py:56-103)."""
+
+    def __init__(self, in_chs, out_chs, reps, strides=1, start_with_relu=True, grow_first=True,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        if out_chs != in_chs or strides != 1:
+            self.skip = create_conv2d(in_chs, out_chs, 1, stride=strides, padding=0, **kw)
+            self.skipbn = BatchNorm2d(out_chs, rngs=rngs)
+        else:
+            self.skip = None
+            self.skipbn = None
+        self.start_with_relu = start_with_relu
+        self.strides = strides
+        pairs = []
+        for i in range(reps):
+            if grow_first:
+                inc = in_chs if i == 0 else out_chs
+                outc = out_chs
+            else:
+                inc = in_chs
+                outc = in_chs if i < (reps - 1) else out_chs
+            pairs.append(nnx.List([
+                SeparableConv2d(inc, outc, 3, stride=1, padding=1, **kw),
+                BatchNorm2d(outc, rngs=rngs),
+            ]))
+        self.rep = nnx.List(pairs)
+
+    def __call__(self, x):
+        inp = x
+        for i, pair in enumerate(self.rep):
+            if not (i == 0 and not self.start_with_relu):
+                x = jax.nn.relu(x)
+            x = pair[1](pair[0](x))
+        if self.strides != 1:
+            x = Pool2d('max', 3, self.strides, padding=1)(x)
+        if self.skip is not None:
+            skip = self.skipbn(self.skip(inp))
+        else:
+            skip = inp
+        return x + skip
+
+
+class Xception(nnx.Module):
+    """(reference xception.py:105-250)."""
+
+    def __init__(
+            self,
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            drop_rate: float = 0.0,
+            global_pool: str = 'avg',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.drop_rate = drop_rate
+        self.num_classes = num_classes
+        self.num_features = self.head_hidden_size = 2048
+
+        self.conv1 = create_conv2d(in_chans, 32, 3, stride=2, padding=0, **kw)
+        self.bn1 = BatchNorm2d(32, rngs=rngs)
+        self.conv2 = create_conv2d(32, 64, 3, padding=0, **kw)
+        self.bn2 = BatchNorm2d(64, rngs=rngs)
+
+        self.block1 = XceptionBlock(64, 128, 2, 2, start_with_relu=False, **kw)
+        self.block2 = XceptionBlock(128, 256, 2, 2, **kw)
+        self.block3 = XceptionBlock(256, 728, 2, 2, **kw)
+        self.block4 = XceptionBlock(728, 728, 3, 1, **kw)
+        self.block5 = XceptionBlock(728, 728, 3, 1, **kw)
+        self.block6 = XceptionBlock(728, 728, 3, 1, **kw)
+        self.block7 = XceptionBlock(728, 728, 3, 1, **kw)
+        self.block8 = XceptionBlock(728, 728, 3, 1, **kw)
+        self.block9 = XceptionBlock(728, 728, 3, 1, **kw)
+        self.block10 = XceptionBlock(728, 728, 3, 1, **kw)
+        self.block11 = XceptionBlock(728, 728, 3, 1, **kw)
+        self.block12 = XceptionBlock(728, 1024, 2, 2, grow_first=False, **kw)
+
+        self.conv3 = SeparableConv2d(1024, 1536, 3, 1, 1, **kw)
+        self.bn3 = BatchNorm2d(1536, rngs=rngs)
+        self.conv4 = SeparableConv2d(1536, self.num_features, 3, 1, 1, **kw)
+        self.bn4 = BatchNorm2d(self.num_features, rngs=rngs)
+        self.feature_info = [
+            dict(num_chs=64, reduction=2, module='bn2'),
+            dict(num_chs=128, reduction=4, module='block1'),
+            dict(num_chs=256, reduction=8, module='block2'),
+            dict(num_chs=728, reduction=16, module='block11'),
+            dict(num_chs=2048, reduction=32, module='bn4'),
+        ]
+        self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=True)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.fc = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^conv[12]|bn[12]',
+            blocks=[(r'^block(\d+)', None), (r'^conv[34]|bn[34]', (99,))],
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        assert not enable, 'gradient checkpointing not supported'
+
+    def get_classifier(self):
+        return self.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = 'avg', *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=True)
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.fc = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    def forward_features(self, x):
+        x = jax.nn.relu(self.bn1(self.conv1(x)))
+        x = jax.nn.relu(self.bn2(self.conv2(x)))
+        for i in range(1, 13):
+            x = getattr(self, f'block{i}')(x)
+        x = jax.nn.relu(self.bn3(self.conv3(x)))
+        x = jax.nn.relu(self.bn4(self.conv4(x)))
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = self.global_pool(x)
+        x = self.head_drop(x)
+        if pre_logits or self.fc is None:
+            return x
+        return self.fc(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        """Feature points match feature_info: post-stem, block1, block2,
+        block11 (pre-downsample input to block12), final act."""
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(5, indices)
+        intermediates = []
+        x = jax.nn.relu(self.bn1(self.conv1(x)))
+        x = jax.nn.relu(self.bn2(self.conv2(x)))
+        if 0 in take_indices:
+            intermediates.append(x)
+        feat_points = {1: 1, 2: 2, 3: 11}
+        for i in range(1, 13):
+            x = getattr(self, f'block{i}')(x)
+            for fi, blk_i in feat_points.items():
+                if blk_i == i and fi in take_indices:
+                    intermediates.append(x)
+            if stop_early and max_index < 4 and i >= feat_points.get(max_index, 12):
+                if intermediates_only:
+                    return intermediates
+                return x, intermediates
+        x = jax.nn.relu(self.bn3(self.conv3(x)))
+        x = jax.nn.relu(self.bn4(self.conv4(x)))
+        if 4 in take_indices:
+            intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, _ = feature_take_indices(5, indices)
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    """Map reference Sequential rep indices → (sep, bn) pair list. With a
+    leading ReLU (all blocks but block1) sep_i is at 3i+1 and bn_i at 3i+2;
+    without it sep_i is at 3i and bn_i at 3i+1."""
+    import re
+
+    from ._torch_convert import convert_torch_state_dict
+    out = {}
+    for k, v in state_dict.items():
+        m = re.match(r'^(block\d+)\.rep\.(\d+)\.(.*)$', k)
+        if m:
+            blk, idx, rest = m.group(1), int(m.group(2)), m.group(3)
+            swr = blk != 'block1'
+            if swr:
+                pair, kind = (idx - 1) // 3, (idx - 1) % 3
+            else:
+                pair, kind = idx // 3, idx % 3
+            sub = 0 if kind == 0 else 1  # 0 → separable conv, 1 → bn
+            k = f'{blk}.rep.{pair}.{sub}.{rest}'
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _cfg(url: str = '', **kwargs):
+    return {
+        'url': url, 'num_classes': 1000, 'input_size': (3, 299, 299), 'pool_size': (10, 10),
+        'crop_pct': 0.8975, 'interpolation': 'bicubic',
+        'mean': (0.5, 0.5, 0.5), 'std': (0.5, 0.5, 0.5),
+        'first_conv': 'conv1', 'classifier': 'fc',
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'legacy_xception.tf_in1k': _cfg(hf_hub_id='timm/'),
+})
+
+
+def _xception(variant, pretrained=False, **kwargs):
+    return build_model_with_cfg(
+        Xception, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(feature_cls='getter'),
+        **kwargs,
+    )
+
+
+@register_model
+def legacy_xception(pretrained=False, **kwargs) -> Xception:
+    return _xception('legacy_xception', pretrained=pretrained, **kwargs)

@@ -14,7 +14,7 @@ from .diff_attention import DiffAttention
 from .eca import CecaModule, EcaModule
 from .evo_norm import EvoNorm2dB0, EvoNorm2dS0, EvoNorm2dS0a
 from .std_conv import ScaledStdConv2d, StdConv2d
-from .create_conv2d import ConvNormAct, create_conv2d, get_padding
+from .create_conv2d import ConvNormAct, SeparableConvNormAct, create_conv2d, get_padding
 from .cond_conv2d import CondConv2d, get_condconv_initializer
 from .create_norm import create_norm_layer, get_norm_layer
 from .drop import DropBlock2d, DropPath, Dropout, calculate_drop_path_rates, drop_block_2d, drop_path

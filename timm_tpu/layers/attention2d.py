@@ -20,7 +20,6 @@ from .attention import maybe_add_mask
 from .create_conv2d import create_conv2d
 from .drop import Dropout, dropout_rng_key
 from .helpers import to_2tuple
-from .pool import Pool2d
 
 __all__ = ['MultiQueryAttentionV2', 'MultiQueryAttention2d', 'Attention2d']
 
@@ -89,7 +88,13 @@ class _QueryDown(nnx.Module):
 
     def __call__(self, x):
         if self.norm is not None:
-            x = Pool2d('avg', self.query_strides, padding='same' if self.pad_same else 0)(x)
+            # torch AvgPool2d / AvgPool2dSame divide by k*k even over padding
+            # (count_include_pad=True) — Pool2d's valid-count divisor differs
+            # on padded edges, so keep the fixed divisor here
+            k = self.query_strides
+            pad = 'SAME' if self.pad_same else 'VALID'
+            x = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, (1, k[0], k[1], 1), (1, k[0], k[1], 1), pad) / (k[0] * k[1])
             x = self.norm(x)
         return self.proj(x)
 

@@ -163,3 +163,42 @@ class ConvNormAct(nnx.Module):
         if self.aa is not None:
             x = self.aa(x)
         return x
+
+
+class SeparableConvNormAct(nnx.Module):
+    """Separable conv (dw + pw) with trailing norm-act
+    (reference separable_conv.py:16-79; keeps conv_dw/conv_pw/bn names)."""
+
+    def __init__(
+            self,
+            in_channels: int,
+            out_channels: int,
+            kernel_size: int = 3,
+            stride: int = 1,
+            dilation: int = 1,
+            padding='',
+            bias: bool = False,
+            channel_multiplier: float = 1.0,
+            pw_kernel_size: int = 1,
+            norm_layer=None,
+            act_layer='relu',
+            apply_act: bool = True,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        from .norm_act import BatchNormAct2d
+        self.conv_dw = create_conv2d(
+            in_channels, int(in_channels * channel_multiplier), kernel_size,
+            stride=stride, dilation=dilation, padding=padding, depthwise=True,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.conv_pw = create_conv2d(
+            int(in_channels * channel_multiplier), out_channels, pw_kernel_size,
+            padding=padding, bias=bias, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        norm_act = norm_layer or BatchNormAct2d
+        self.bn = norm_act(out_channels, apply_act=apply_act, act_layer=act_layer,
+                           dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        return self.bn(self.conv_pw(self.conv_dw(x)))

@@ -59,3 +59,6 @@ from .convmixer import ConvMixer
 from .hardcorenas import *  # noqa: F401,F403 — registers hardcorenas entrypoints
 from .starnet import StarNet
 from .xception import Xception
+from .pvt_v2 import PyramidVisionTransformerV2
+from .repghost import RepGhostNet
+from .vovnet import VovNet

@@ -23,9 +23,6 @@ def checkpoint_filter_fn(state_dict, model):
 
 def _gen_hardcorenas(pretrained, variant, arch_def, **kwargs):
     """(reference hardcorenas.py:16-52)."""
-    _eff_filter = checkpoint_filter_fn
-    from functools import partial as _partial
-
     from ..layers import BatchNormAct2d
     se_layer = partial(
         SqueezeExcite, gate_layer='hard_sigmoid', force_act_layer='relu', rd_round_fn=round_channels)
@@ -39,10 +36,10 @@ def _gen_hardcorenas(pretrained, variant, arch_def, **kwargs):
         **kwargs,
     )
     if bn_args:
-        model_kwargs['norm_layer'] = _partial(BatchNormAct2d, **bn_args)
+        model_kwargs['norm_layer'] = partial(BatchNormAct2d, **bn_args)
     return build_model_with_cfg(
         MobileNetV3, variant, pretrained,
-        pretrained_filter_fn=_eff_filter,
+        pretrained_filter_fn=checkpoint_filter_fn,
         feature_cfg=dict(out_indices=tuple(range(len(arch_def)))),
         **model_kwargs,
     )

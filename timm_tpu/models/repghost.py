@@ -1,0 +1,398 @@
+"""RepGhostNet (reference: timm/models/repghost.py:1-584), TPU-native NHWC.
+
+Ghost modules with a re-parameterizable fusion branch: at train time the cheap
+dw conv output is summed with a parallel BN branch; `reparameterize()` folds
+the BN branch into the dw conv (+bias) for deployment, matching the
+reference's switch_to_deploy numerics.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    BatchNorm2d, SelectAdaptivePool2d, SqueezeExcite, create_conv2d,
+    make_divisible, trunc_normal_, zeros_,
+)
+from ..layers.drop import Dropout
+from ._builder import build_model_with_cfg
+from ._efficientnet_blocks import ConvBnAct
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['RepGhostNet']
+
+_SE_LAYER = partial(SqueezeExcite, gate_layer='hard_sigmoid', rd_round_fn=partial(make_divisible, divisor=4))
+
+
+class RepGhostModule(nnx.Module):
+    """(reference repghost.py:23-122): primary 1x1 conv-bn-relu, cheap dw
+    conv-bn, plus a BN-only fusion branch summed in (reparam form folds it)."""
+
+    def __init__(self, in_chs, out_chs, kernel_size=1, dw_size=3, stride=1,
+                 relu=True, reparam=True, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.out_chs = out_chs
+        init_chs = out_chs
+        new_chs = out_chs
+        self.relu_out = relu
+        # Sequential indices match the reference state dict (relu is paramless)
+        self.primary_conv = nnx.List([
+            create_conv2d(in_chs, init_chs, kernel_size, stride=stride, padding=kernel_size // 2, **kw),
+            BatchNorm2d(init_chs, rngs=rngs),
+        ])
+        self.fusion_bn = nnx.List([BatchNorm2d(init_chs, rngs=rngs)]) if reparam else nnx.List([])
+        self.cheap_operation = nnx.List([
+            create_conv2d(init_chs, new_chs, dw_size, stride=1, padding=dw_size // 2, groups=init_chs, **kw),
+            BatchNorm2d(new_chs, rngs=rngs),
+        ])
+        self.cheap_bias = None  # populated by reparameterize()
+
+    def __call__(self, x):
+        x1 = self.primary_conv[1](self.primary_conv[0](x))
+        if self.relu_out:
+            x1 = jax.nn.relu(x1)
+        x2 = self.cheap_operation[0](x1)
+        if len(self.cheap_operation) > 1:
+            x2 = self.cheap_operation[1](x2)
+        if self.cheap_bias is not None:
+            x2 = x2 + self.cheap_bias[...].astype(x2.dtype)
+        for bn in self.fusion_bn:
+            x2 = x2 + bn(x1)
+        if self.relu_out:
+            x2 = jax.nn.relu(x2)
+        return x2
+
+    def reparameterize(self):
+        """Fold cheap-op BN + fusion BN (an identity-conv + BN) into a single
+        biased dw conv (reference repghost.py:66-122)."""
+        if not len(self.fusion_bn):
+            return
+        conv = self.cheap_operation[0]
+        bn = self.cheap_operation[1]
+        kernel = conv.kernel[...]  # (kh, kw, 1, C) depthwise HWIO
+        std = jnp.sqrt(bn.var[...] + bn.epsilon)
+        t = (bn.scale[...] / std)
+        k3 = kernel * t[None, None, None, :]
+        b3 = bn.bias[...] - bn.mean[...] * bn.scale[...] / std
+        kh = kernel.shape[0]
+        for fbn in self.fusion_bn:
+            stdf = jnp.sqrt(fbn.var[...] + fbn.epsilon)
+            tf = fbn.scale[...] / stdf
+            ident = jnp.zeros_like(k3).at[kh // 2, kh // 2, 0, :].set(tf)
+            k3 = k3 + ident
+            b3 = b3 + (fbn.bias[...] - fbn.mean[...] * fbn.scale[...] / stdf)
+        conv.kernel[...] = k3
+        self.cheap_operation = nnx.List([conv])
+        self.cheap_bias = nnx.data(nnx.Param(b3))
+        self.fusion_bn = nnx.List([])
+
+
+class RepGhostBottleneck(nnx.Module):
+    """(reference repghost.py:124-195)."""
+
+    def __init__(self, in_chs, mid_chs, out_chs, dw_kernel_size=3, stride=1,
+                 se_ratio=0.0, reparam=True, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        has_se = se_ratio is not None and se_ratio > 0.0
+        self.stride = stride
+        self.ghost1 = RepGhostModule(in_chs, mid_chs, relu=True, reparam=reparam, **kw)
+        if stride > 1:
+            self.conv_dw = create_conv2d(
+                mid_chs, mid_chs, dw_kernel_size, stride=stride,
+                padding=(dw_kernel_size - 1) // 2, groups=mid_chs, **kw)
+            self.bn_dw = BatchNorm2d(mid_chs, rngs=rngs)
+        else:
+            self.conv_dw = None
+            self.bn_dw = None
+        self.se = _SE_LAYER(mid_chs, rd_ratio=se_ratio, **kw) if has_se else None
+        self.ghost2 = RepGhostModule(mid_chs, out_chs, relu=False, reparam=reparam, **kw)
+        if in_chs == out_chs and stride == 1:
+            self.shortcut = None
+        else:
+            self.shortcut = nnx.List([
+                create_conv2d(in_chs, in_chs, dw_kernel_size, stride=stride,
+                              padding=(dw_kernel_size - 1) // 2, groups=in_chs, **kw),
+                BatchNorm2d(in_chs, rngs=rngs),
+                create_conv2d(in_chs, out_chs, 1, padding=0, **kw),
+                BatchNorm2d(out_chs, rngs=rngs),
+            ])
+
+    def __call__(self, x):
+        shortcut = x
+        x = self.ghost1(x)
+        if self.conv_dw is not None:
+            x = self.bn_dw(self.conv_dw(x))
+        if self.se is not None:
+            x = self.se(x)
+        x = self.ghost2(x)
+        if self.shortcut is not None:
+            for m in self.shortcut:
+                shortcut = m(shortcut)
+        return x + shortcut
+
+
+class RepGhostNet(nnx.Module):
+    """(reference repghost.py:197-372)."""
+
+    def __init__(
+            self,
+            cfgs: List[List[List]],
+            num_classes: int = 1000,
+            width: float = 1.0,
+            in_chans: int = 3,
+            output_stride: int = 32,
+            global_pool: str = 'avg',
+            drop_rate: float = 0.2,
+            reparam: bool = True,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert output_stride == 32
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.cfgs = cfgs
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        self.grad_checkpointing = False
+        self.feature_info = []
+
+        stem_chs = make_divisible(16 * width, 4)
+        self.conv_stem = create_conv2d(in_chans, stem_chs, 3, stride=2, padding=1, **kw)
+        self.feature_info.append(dict(num_chs=stem_chs, reduction=2, module='conv_stem'))
+        self.bn1 = BatchNorm2d(stem_chs, rngs=rngs)
+
+        prev_chs = stem_chs
+        stages = []
+        net_stride = 2
+        stage_idx = 0
+        exp_size = 16
+        for cfg in cfgs:
+            layers = []
+            s = 1
+            for k, exp_size, c, se_ratio, s in cfg:
+                out_chs = make_divisible(c * width, 4)
+                mid_chs = make_divisible(exp_size * width, 4)
+                layers.append(RepGhostBottleneck(
+                    prev_chs, mid_chs, out_chs, k, s, se_ratio=se_ratio, reparam=reparam, **kw))
+                prev_chs = out_chs
+            if s > 1:
+                net_stride *= 2
+                self.feature_info.append(dict(
+                    num_chs=prev_chs, reduction=net_stride, module=f'blocks.{stage_idx}'))
+            stages.append(nnx.List(layers))
+            stage_idx += 1
+        out_chs = make_divisible(exp_size * width * 2, 4)
+        stages.append(nnx.List([ConvBnAct(prev_chs, out_chs, 1, **kw)]))
+        self.pool_dim = prev_chs = out_chs
+        self.blocks = nnx.List(stages)
+
+        self.num_features = prev_chs
+        self.head_hidden_size = out_chs = 1280
+        self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=False)
+        self.conv_head = create_conv2d(prev_chs, out_chs, 1, padding=0, bias=True, **kw)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.classifier = nnx.Linear(
+            out_chs, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            **kw) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^conv_stem|bn1',
+            blocks=[
+                (r'^blocks\.(\d+)' if coarse else r'^blocks\.(\d+)\.(\d+)', None),
+                (r'conv_head', (99999,)),
+            ])
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        self.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.classifier
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=False)
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.classifier = nnx.Linear(
+            self.head_hidden_size, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    def convert_to_deploy(self):
+        for stage in self.blocks:
+            for blk in stage:
+                if isinstance(blk, RepGhostBottleneck):
+                    blk.ghost1.reparameterize()
+                    blk.ghost2.reparameterize()
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = jax.nn.relu(self.bn1(self.conv_stem(x)))
+        for stage in self.blocks:
+            for blk in stage:
+                x = blk(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = self.global_pool(x)
+        if x.ndim == 2:
+            x = x[:, None, None, :]
+        x = jax.nn.relu(self.conv_head(x))
+        x = x.reshape(x.shape[0], -1)
+        x = self.head_drop(x)
+        if pre_logits or self.classifier is None:
+            return x
+        return self.classifier(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        stage_ends = [-1] + [int(info['module'].split('.')[-1]) for info in self.feature_info[1:]]
+        take_indices, max_index = feature_take_indices(len(stage_ends), indices)
+        take_indices = [stage_ends[i] + 1 for i in take_indices]
+        max_index = stage_ends[max_index]
+        intermediates = []
+        feat_idx = 0
+        x = self.conv_stem(x)
+        if feat_idx in take_indices:
+            intermediates.append(x)
+        x = jax.nn.relu(self.bn1(x))
+        stages = self.blocks if not stop_early else list(self.blocks)[:max_index + 1]
+        for feat_idx, stage in enumerate(stages, start=1):
+            for blk in stage:
+                x = blk(x)
+            if feat_idx in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        stage_ends = [-1] + [int(info['module'].split('.')[-1]) for info in self.feature_info[1:]]
+        take_indices, max_index = feature_take_indices(len(stage_ends), indices)
+        max_index = stage_ends[max_index]
+        self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    """Sequential index remaps: primary_conv/cheap_operation/shortcut keep
+    their indices; fusion_bn.0 maps 1:1; ghost relu entries are paramless."""
+    from ._torch_convert import convert_torch_state_dict
+    out = {}
+    for k, v in state_dict.items():
+        # reference SE convs are conv_reduce/conv_expand (ours fc1/fc2)
+        k = k.replace('.se.conv_reduce.', '.se.fc1.').replace('.se.conv_expand.', '.se.fc2.')
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_repghostnet(variant, width=1.0, pretrained=False, **kwargs):
+    """(reference repghost.py:389-427) — stage cfg table."""
+    cfgs = [
+        [[3, 8, 16, 0, 1]],
+        [[3, 24, 24, 0, 2]],
+        [[3, 36, 24, 0, 1]],
+        [[5, 36, 40, 0.25, 2]],
+        [[5, 60, 40, 0.25, 1]],
+        [[3, 120, 80, 0, 2]],
+        [[3, 100, 80, 0, 1],
+         [3, 120, 80, 0, 1],
+         [3, 120, 80, 0, 1],
+         [3, 240, 112, 0.25, 1],
+         [3, 336, 112, 0.25, 1]],
+        [[5, 336, 160, 0.25, 2]],
+        [[5, 480, 160, 0, 1],
+         [5, 480, 160, 0.25, 1],
+         [5, 480, 160, 0, 1],
+         [5, 480, 160, 0.25, 1]],
+    ]
+    return build_model_with_cfg(
+        RepGhostNet, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(),
+        cfgs=cfgs, width=width,
+        **kwargs,
+    )
+
+
+def _cfg(url='', **kwargs):
+    return {
+        'url': url, 'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'conv_stem', 'classifier': 'classifier',
+        'license': 'mit',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'repghostnet_050.in1k': _cfg(hf_hub_id='timm/'),
+    'repghostnet_058.in1k': _cfg(hf_hub_id='timm/'),
+    'repghostnet_080.in1k': _cfg(hf_hub_id='timm/'),
+    'repghostnet_100.in1k': _cfg(hf_hub_id='timm/'),
+    'repghostnet_111.in1k': _cfg(hf_hub_id='timm/'),
+    'repghostnet_130.in1k': _cfg(hf_hub_id='timm/'),
+    'repghostnet_150.in1k': _cfg(hf_hub_id='timm/'),
+    'repghostnet_200.in1k': _cfg(hf_hub_id='timm/'),
+})
+
+
+@register_model
+def repghostnet_050(pretrained=False, **kwargs) -> RepGhostNet:
+    return _create_repghostnet('repghostnet_050', width=0.5, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def repghostnet_058(pretrained=False, **kwargs) -> RepGhostNet:
+    return _create_repghostnet('repghostnet_058', width=0.58, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def repghostnet_080(pretrained=False, **kwargs) -> RepGhostNet:
+    return _create_repghostnet('repghostnet_080', width=0.8, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def repghostnet_100(pretrained=False, **kwargs) -> RepGhostNet:
+    return _create_repghostnet('repghostnet_100', width=1.0, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def repghostnet_111(pretrained=False, **kwargs) -> RepGhostNet:
+    return _create_repghostnet('repghostnet_111', width=1.11, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def repghostnet_130(pretrained=False, **kwargs) -> RepGhostNet:
+    return _create_repghostnet('repghostnet_130', width=1.3, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def repghostnet_150(pretrained=False, **kwargs) -> RepGhostNet:
+    return _create_repghostnet('repghostnet_150', width=1.5, pretrained=pretrained, **kwargs)
+
+
+@register_model
+def repghostnet_200(pretrained=False, **kwargs) -> RepGhostNet:
+    return _create_repghostnet('repghostnet_200', width=2.0, pretrained=pretrained, **kwargs)

@@ -1,0 +1,455 @@
+"""VoVNet V1/V2 (reference: timm/models/vovnet.py:1-559), TPU-native NHWC.
+
+One-Shot-Aggregation (OSA) blocks: a chain of 3x3 (or separable) convs whose
+every intermediate output is concatenated and fused with a 1x1 conv; V2 adds
+identity residuals and effective-SE attention. The concat is a pure layout op
+in NHWC, and the 1x1 fuse is a single big MXU matmul over all branches.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import (
+    BatchNormAct2d, ClassifierHead, ConvNormAct, DropPath, SeparableConvNormAct,
+    calculate_drop_path_rates, create_attn,
+)
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._manipulate import checkpoint_seq
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['VovNet']
+
+
+def _max_pool2d_ceil(x, kernel=3, stride=2):
+    """Torch MaxPool2d(3, 2, ceil_mode=True): pad right/bottom so every
+    window start inside the input is kept."""
+    B, H, W, C = x.shape
+    out_h = -(-(H - kernel) // stride) + 1
+    out_w = -(-(W - kernel) // stride) + 1
+    pad_h = max(0, (out_h - 1) * stride + kernel - H)
+    pad_w = max(0, (out_w - 1) * stride + kernel - W)
+    neg = -jnp.inf if x.dtype == jnp.float32 else jnp.finfo(x.dtype).min
+    x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)), constant_values=neg)
+    return jax.lax.reduce_window(
+        x, neg, jax.lax.max, (1, kernel, kernel, 1), (1, stride, stride, 1), 'VALID')
+
+
+class OsaBlock(nnx.Module):
+    """(reference vovnet.py:34-90)."""
+
+    def __init__(self, in_chs, mid_chs, out_chs, layer_per_block, residual=False,
+                 depthwise=False, attn='', norm_layer=BatchNormAct2d, act_layer='relu',
+                 drop_path=0.0, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        conv_kwargs = dict(norm_layer=norm_layer, act_layer=act_layer,
+                           dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.residual = residual
+        self.depthwise = depthwise
+        next_in_chs = in_chs
+        if depthwise and next_in_chs != mid_chs:
+            assert not residual
+            self.conv_reduction = ConvNormAct(next_in_chs, mid_chs, 1, **conv_kwargs)
+        else:
+            self.conv_reduction = None
+        mid_convs = []
+        for i in range(layer_per_block):
+            if depthwise:
+                mid_convs.append(SeparableConvNormAct(mid_chs, mid_chs, **conv_kwargs))
+            else:
+                mid_convs.append(ConvNormAct(next_in_chs, mid_chs, 3, **conv_kwargs))
+            next_in_chs = mid_chs
+        self.conv_mid = nnx.List(mid_convs)
+        next_in_chs = in_chs + layer_per_block * mid_chs
+        self.conv_concat = ConvNormAct(next_in_chs, out_chs, **conv_kwargs)
+        self.attn = create_attn(attn, out_chs, dtype=dtype, param_dtype=param_dtype, rngs=rngs) \
+            if attn else None
+        self.drop_path = DropPath(drop_path, rngs=rngs) if drop_path else None
+
+    def __call__(self, x):
+        outputs = [x]
+        if self.conv_reduction is not None:
+            x = self.conv_reduction(x)
+        for conv in self.conv_mid:
+            x = conv(x)
+            outputs.append(x)
+        x = jnp.concatenate(outputs, axis=-1)
+        x = self.conv_concat(x)
+        if self.attn is not None:
+            x = self.attn(x)
+        if self.drop_path is not None:
+            x = self.drop_path(x)
+        if self.residual:
+            x = x + outputs[0]
+        return x
+
+
+class OsaStage(nnx.Module):
+    """(reference vovnet.py:92-143)."""
+
+    def __init__(self, in_chs, mid_chs, out_chs, block_per_stage, layer_per_block,
+                 downsample=True, residual=True, depthwise=False, attn='ese',
+                 norm_layer=BatchNormAct2d, act_layer='relu', drop_path_rates=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.grad_checkpointing = False
+        self.downsample = downsample
+        blocks = []
+        for i in range(block_per_stage):
+            last_block = i == block_per_stage - 1
+            dpr = drop_path_rates[i] if drop_path_rates is not None else 0.0
+            blocks.append(OsaBlock(
+                in_chs, mid_chs, out_chs, layer_per_block,
+                residual=residual and i > 0,
+                depthwise=depthwise,
+                attn=attn if last_block else '',
+                norm_layer=norm_layer, act_layer=act_layer, drop_path=dpr,
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs))
+            in_chs = out_chs
+        self.blocks = nnx.List(blocks)
+
+    def __call__(self, x):
+        if self.downsample:
+            x = _max_pool2d_ceil(x, 3, 2)
+        if self.grad_checkpointing:
+            x = checkpoint_seq(self.blocks, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
+        return x
+
+
+class VovNet(nnx.Module):
+    """(reference vovnet.py:145-353)."""
+
+    def __init__(
+            self,
+            cfg: dict,
+            in_chans: int = 3,
+            num_classes: int = 1000,
+            global_pool: str = 'avg',
+            output_stride: int = 32,
+            norm_layer=BatchNormAct2d,
+            act_layer='relu',
+            drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+            **kwargs,
+    ):
+        assert output_stride == 32
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        cfg = dict(cfg, **kwargs)
+        stem_stride = cfg.get('stem_stride', 4)
+        stem_chs = cfg['stem_chs']
+        stage_conv_chs = cfg['stage_conv_chs']
+        stage_out_chs = cfg['stage_out_chs']
+        block_per_stage = cfg['block_per_stage']
+        layer_per_block = cfg['layer_per_block']
+        conv_kwargs = dict(norm_layer=norm_layer, act_layer=act_layer,
+                           dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        last_stem_stride = stem_stride // 2
+        conv_type = SeparableConvNormAct if cfg['depthwise'] else ConvNormAct
+        self.stem = nnx.List([
+            ConvNormAct(in_chans, stem_chs[0], 3, stride=2, **conv_kwargs),
+            conv_type(stem_chs[0], stem_chs[1], 3, stride=1, **conv_kwargs),
+            conv_type(stem_chs[1], stem_chs[2], 3, stride=last_stem_stride, **conv_kwargs),
+        ])
+        self.feature_info = [dict(
+            num_chs=stem_chs[1], reduction=2, module=f'stem.{1 if stem_stride == 4 else 2}')]
+        current_stride = stem_stride
+
+        stage_dpr = calculate_drop_path_rates(drop_path_rate, block_per_stage, stagewise=True)
+        in_ch_list = stem_chs[-1:] + stage_out_chs[:-1]
+        stage_args = dict(residual=cfg['residual'], depthwise=cfg['depthwise'], attn=cfg['attn'], **conv_kwargs)
+        stages = []
+        for i in range(4):
+            downsample = stem_stride == 2 or i > 0
+            stages.append(OsaStage(
+                in_ch_list[i], stage_conv_chs[i], stage_out_chs[i], block_per_stage[i],
+                layer_per_block, downsample=downsample, drop_path_rates=stage_dpr[i], **stage_args))
+            self.num_features = stage_out_chs[i]
+            current_stride *= 2 if downsample else 1
+            self.feature_info += [dict(num_chs=self.num_features, reduction=current_stride, module=f'stages.{i}')]
+        self.stages = nnx.List(stages)
+
+        self.head_hidden_size = self.num_features
+        self.head = ClassifierHead(
+            self.num_features, num_classes, pool_type=global_pool, drop_rate=drop_rate,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(
+            stem=r'^stem',
+            blocks=r'^stages\.(\d+)' if coarse else r'^stages\.(\d+).blocks\.(\d+)',
+        )
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        for s in self.stages:
+            s.grad_checkpointing = enable
+
+    def get_classifier(self):
+        return self.head.fc
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        self.head.reset(num_classes, pool_type=global_pool, rngs=rngs)
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        for m in self.stem:
+            x = m(x)
+        for stage in self.stages:
+            x = stage(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        return self.head(x, pre_logits=pre_logits)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        for m in self.stem:
+            x = m(x)
+        intermediates = []
+        stages = self.stages if not stop_early else list(self.stages)[:max_index + 1]
+        for i, stage in enumerate(stages):
+            x = stage(x)
+            if i in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.stages), indices)
+        self.stages = nnx.List(list(self.stages)[:max_index + 1])
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+# stage cfg tables (reference vovnet.py:355-461)
+model_cfgs = dict(
+    vovnet39a=dict(
+        stem_chs=[64, 64, 128],
+        stage_conv_chs=[128, 160, 192, 224],
+        stage_out_chs=[256, 512, 768, 1024],
+        layer_per_block=5,
+        block_per_stage=[1, 1, 2, 2],
+        residual=False,
+        depthwise=False,
+        attn='',
+    ),
+    vovnet57a=dict(
+        stem_chs=[64, 64, 128],
+        stage_conv_chs=[128, 160, 192, 224],
+        stage_out_chs=[256, 512, 768, 1024],
+        layer_per_block=5,
+        block_per_stage=[1, 1, 4, 3],
+        residual=False,
+        depthwise=False,
+        attn='',
+    ),
+    ese_vovnet19b_slim_dw=dict(
+        stem_chs=[64, 64, 64],
+        stage_conv_chs=[64, 80, 96, 112],
+        stage_out_chs=[112, 256, 384, 512],
+        layer_per_block=3,
+        block_per_stage=[1, 1, 1, 1],
+        residual=True,
+        depthwise=True,
+        attn='ese',
+    ),
+    ese_vovnet19b_dw=dict(
+        stem_chs=[64, 64, 64],
+        stage_conv_chs=[128, 160, 192, 224],
+        stage_out_chs=[256, 512, 768, 1024],
+        layer_per_block=3,
+        block_per_stage=[1, 1, 1, 1],
+        residual=True,
+        depthwise=True,
+        attn='ese',
+    ),
+    ese_vovnet19b_slim=dict(
+        stem_chs=[64, 64, 128],
+        stage_conv_chs=[64, 80, 96, 112],
+        stage_out_chs=[112, 256, 384, 512],
+        layer_per_block=3,
+        block_per_stage=[1, 1, 1, 1],
+        residual=True,
+        depthwise=False,
+        attn='ese',
+    ),
+    ese_vovnet19b=dict(
+        stem_chs=[64, 64, 128],
+        stage_conv_chs=[128, 160, 192, 224],
+        stage_out_chs=[256, 512, 768, 1024],
+        layer_per_block=3,
+        block_per_stage=[1, 1, 1, 1],
+        residual=True,
+        depthwise=False,
+        attn='ese',
+    ),
+    ese_vovnet39b=dict(
+        stem_chs=[64, 64, 128],
+        stage_conv_chs=[128, 160, 192, 224],
+        stage_out_chs=[256, 512, 768, 1024],
+        layer_per_block=5,
+        block_per_stage=[1, 1, 2, 2],
+        residual=True,
+        depthwise=False,
+        attn='ese',
+    ),
+    ese_vovnet57b=dict(
+        stem_chs=[64, 64, 128],
+        stage_conv_chs=[128, 160, 192, 224],
+        stage_out_chs=[256, 512, 768, 1024],
+        layer_per_block=5,
+        block_per_stage=[1, 1, 4, 3],
+        residual=True,
+        depthwise=False,
+        attn='ese',
+    ),
+    ese_vovnet99b=dict(
+        stem_chs=[64, 64, 128],
+        stage_conv_chs=[128, 160, 192, 224],
+        stage_out_chs=[256, 512, 768, 1024],
+        layer_per_block=5,
+        block_per_stage=[1, 3, 9, 3],
+        residual=True,
+        depthwise=False,
+        attn='ese',
+    ),
+    eca_vovnet39b=dict(
+        stem_chs=[64, 64, 128],
+        stage_conv_chs=[128, 160, 192, 224],
+        stage_out_chs=[256, 512, 768, 1024],
+        layer_per_block=5,
+        block_per_stage=[1, 1, 2, 2],
+        residual=True,
+        depthwise=False,
+        attn='eca',
+    ),
+)
+model_cfgs['ese_vovnet39b_evos'] = model_cfgs['ese_vovnet39b']
+
+
+def checkpoint_filter_fn(state_dict, model):
+    from ._torch_convert import convert_torch_state_dict
+    return convert_torch_state_dict(state_dict, model)
+
+
+def _create_vovnet(variant, pretrained=False, **kwargs):
+    return build_model_with_cfg(
+        VovNet, variant, pretrained,
+        model_cfg=model_cfgs[variant],
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=(0, 1, 2, 3)),
+        **kwargs,
+    )
+
+
+def _cfg(url='', **kwargs):
+    return {
+        'url': url, 'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': (7, 7),
+        'crop_pct': 0.875, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'stem.0.conv', 'classifier': 'head.fc',
+        'license': 'apache-2.0',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'vovnet39a.untrained': _cfg(),
+    'vovnet57a.untrained': _cfg(),
+    'ese_vovnet19b_slim_dw.untrained': _cfg(),
+    'ese_vovnet19b_dw.ra_in1k': _cfg(
+        hf_hub_id='timm/', test_input_size=(3, 288, 288), test_crop_pct=0.95),
+    'ese_vovnet19b_slim.untrained': _cfg(),
+    'ese_vovnet19b.untrained': _cfg(),
+    'ese_vovnet39b.ra_in1k': _cfg(
+        hf_hub_id='timm/', test_input_size=(3, 288, 288), test_crop_pct=0.95),
+    'ese_vovnet57b.ra4_e3600_r256_in1k': _cfg(
+        hf_hub_id='timm/', mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5),
+        input_size=(3, 256, 256), crop_pct=0.95, test_input_size=(3, 320, 320), test_crop_pct=1.0),
+    'ese_vovnet99b.untrained': _cfg(),
+    'eca_vovnet39b.untrained': _cfg(),
+    'ese_vovnet39b_evos.untrained': _cfg(),
+})
+
+
+@register_model
+def vovnet39a(pretrained=False, **kwargs) -> VovNet:
+    return _create_vovnet('vovnet39a', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def vovnet57a(pretrained=False, **kwargs) -> VovNet:
+    return _create_vovnet('vovnet57a', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def ese_vovnet19b_slim_dw(pretrained=False, **kwargs) -> VovNet:
+    return _create_vovnet('ese_vovnet19b_slim_dw', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def ese_vovnet19b_dw(pretrained=False, **kwargs) -> VovNet:
+    return _create_vovnet('ese_vovnet19b_dw', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def ese_vovnet19b_slim(pretrained=False, **kwargs) -> VovNet:
+    return _create_vovnet('ese_vovnet19b_slim', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def ese_vovnet19b(pretrained=False, **kwargs) -> VovNet:
+    return _create_vovnet('ese_vovnet19b', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def ese_vovnet39b(pretrained=False, **kwargs) -> VovNet:
+    return _create_vovnet('ese_vovnet39b', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def ese_vovnet57b(pretrained=False, **kwargs) -> VovNet:
+    return _create_vovnet('ese_vovnet57b', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def ese_vovnet99b(pretrained=False, **kwargs) -> VovNet:
+    return _create_vovnet('ese_vovnet99b', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def eca_vovnet39b(pretrained=False, **kwargs) -> VovNet:
+    return _create_vovnet('eca_vovnet39b', pretrained=pretrained, **kwargs)
+
+
+@register_model
+def ese_vovnet39b_evos(pretrained=False, **kwargs) -> VovNet:
+    """V2 w/ EvoNorm (reference vovnet.py:556-559)."""
+    def norm_act_fn(num_features, apply_act=True, act_layer=None, **nkwargs):
+        from ..layers import EvoNorm2dS0
+        return EvoNorm2dS0(num_features, apply_act=apply_act, **nkwargs)
+    return _create_vovnet('ese_vovnet39b_evos', pretrained=pretrained, norm_layer=norm_act_fn, **kwargs)

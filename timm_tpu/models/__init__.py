@@ -62,3 +62,5 @@ from .xception import Xception
 from .pvt_v2 import PyramidVisionTransformerV2
 from .repghost import RepGhostNet
 from .vovnet import VovNet
+from .pit import PoolingVisionTransformer
+from .inception_v4 import InceptionV4

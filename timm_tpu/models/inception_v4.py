@@ -1,0 +1,332 @@
+"""Inception-V4 (reference: timm/models/inception_v4.py:1-445), TPU-native
+NHWC.
+
+Multi-branch inception cells with asymmetric (1x7 / 7x1) convs; all branch
+concats are channel-axis (last) in NHWC, so they are free layout ops.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import BatchNormAct2d, ConvNormAct, Pool2d, SelectAdaptivePool2d, trunc_normal_, zeros_
+from ..layers.drop import Dropout
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+
+__all__ = ['InceptionV4']
+
+
+class Mixed3a(nnx.Module):
+    def __init__(self, conv_block, **kw):
+        self.maxpool = Pool2d('max', 3, 2, padding=0)
+        self.conv = conv_block(64, 96, kernel_size=3, stride=2, **kw)
+
+    def __call__(self, x):
+        return jnp.concatenate([self.maxpool(x), self.conv(x)], axis=-1)
+
+
+class Mixed4a(nnx.Module):
+    def __init__(self, conv_block, **kw):
+        self.branch0 = nnx.List([
+            conv_block(160, 64, kernel_size=1, stride=1, **kw),
+            conv_block(64, 96, kernel_size=3, stride=1, **kw),
+        ])
+        self.branch1 = nnx.List([
+            conv_block(160, 64, kernel_size=1, stride=1, **kw),
+            conv_block(64, 64, kernel_size=(1, 7), stride=1, padding=(0, 3), **kw),
+            conv_block(64, 64, kernel_size=(7, 1), stride=1, padding=(3, 0), **kw),
+            conv_block(64, 96, kernel_size=(3, 3), stride=1, **kw),
+        ])
+
+    def __call__(self, x):
+        x0 = x
+        for m in self.branch0:
+            x0 = m(x0)
+        x1 = x
+        for m in self.branch1:
+            x1 = m(x1)
+        return jnp.concatenate([x0, x1], axis=-1)
+
+
+class Mixed5a(nnx.Module):
+    def __init__(self, conv_block, **kw):
+        self.conv = conv_block(192, 192, kernel_size=3, stride=2, **kw)
+        self.maxpool = Pool2d('max', 3, 2, padding=0)
+
+    def __call__(self, x):
+        return jnp.concatenate([self.conv(x), self.maxpool(x)], axis=-1)
+
+
+def _seq(mods):
+    def run(x):
+        for m in mods:
+            x = m(x)
+        return x
+    return run
+
+
+class InceptionA(nnx.Module):
+    def __init__(self, conv_block, **kw):
+        self.branch0 = conv_block(384, 96, kernel_size=1, stride=1, **kw)
+        self.branch1 = nnx.List([
+            conv_block(384, 64, kernel_size=1, stride=1, **kw),
+            conv_block(64, 96, kernel_size=3, stride=1, padding=1, **kw),
+        ])
+        self.branch2 = nnx.List([
+            conv_block(384, 64, kernel_size=1, stride=1, **kw),
+            conv_block(64, 96, kernel_size=3, stride=1, padding=1, **kw),
+            conv_block(96, 96, kernel_size=3, stride=1, padding=1, **kw),
+        ])
+        # torch Sequential(AvgPool, conv) → conv at index 1
+        self.branch3 = nnx.List([conv_block(384, 96, kernel_size=1, stride=1, **kw)])
+        self._pool = Pool2d('avg', 3, 1, padding=1)
+
+    def __call__(self, x):
+        return jnp.concatenate([
+            self.branch0(x), _seq(self.branch1)(x), _seq(self.branch2)(x),
+            self.branch3[0](self._pool(x)),
+        ], axis=-1)
+
+
+class ReductionA(nnx.Module):
+    def __init__(self, conv_block, **kw):
+        self.branch0 = conv_block(384, 384, kernel_size=3, stride=2, **kw)
+        self.branch1 = nnx.List([
+            conv_block(384, 192, kernel_size=1, stride=1, **kw),
+            conv_block(192, 224, kernel_size=3, stride=1, padding=1, **kw),
+            conv_block(224, 256, kernel_size=3, stride=2, **kw),
+        ])
+        self._pool = Pool2d('max', 3, 2, padding=0)
+
+    def __call__(self, x):
+        return jnp.concatenate([self.branch0(x), _seq(self.branch1)(x), self._pool(x)], axis=-1)
+
+
+class InceptionB(nnx.Module):
+    def __init__(self, conv_block, **kw):
+        self.branch0 = conv_block(1024, 384, kernel_size=1, stride=1, **kw)
+        self.branch1 = nnx.List([
+            conv_block(1024, 192, kernel_size=1, stride=1, **kw),
+            conv_block(192, 224, kernel_size=(1, 7), stride=1, padding=(0, 3), **kw),
+            conv_block(224, 256, kernel_size=(7, 1), stride=1, padding=(3, 0), **kw),
+        ])
+        self.branch2 = nnx.List([
+            conv_block(1024, 192, kernel_size=1, stride=1, **kw),
+            conv_block(192, 192, kernel_size=(7, 1), stride=1, padding=(3, 0), **kw),
+            conv_block(192, 224, kernel_size=(1, 7), stride=1, padding=(0, 3), **kw),
+            conv_block(224, 224, kernel_size=(7, 1), stride=1, padding=(3, 0), **kw),
+            conv_block(224, 256, kernel_size=(1, 7), stride=1, padding=(0, 3), **kw),
+        ])
+        self.branch3 = nnx.List([conv_block(1024, 128, kernel_size=1, stride=1, **kw)])
+        self._pool = Pool2d('avg', 3, 1, padding=1)
+
+    def __call__(self, x):
+        return jnp.concatenate([
+            self.branch0(x), _seq(self.branch1)(x), _seq(self.branch2)(x),
+            self.branch3[0](self._pool(x)),
+        ], axis=-1)
+
+
+class ReductionB(nnx.Module):
+    def __init__(self, conv_block, **kw):
+        self.branch0 = nnx.List([
+            conv_block(1024, 192, kernel_size=1, stride=1, **kw),
+            conv_block(192, 192, kernel_size=3, stride=2, **kw),
+        ])
+        self.branch1 = nnx.List([
+            conv_block(1024, 256, kernel_size=1, stride=1, **kw),
+            conv_block(256, 256, kernel_size=(1, 7), stride=1, padding=(0, 3), **kw),
+            conv_block(256, 320, kernel_size=(7, 1), stride=1, padding=(3, 0), **kw),
+            conv_block(320, 320, kernel_size=3, stride=2, **kw),
+        ])
+        self._pool = Pool2d('max', 3, 2, padding=0)
+
+    def __call__(self, x):
+        return jnp.concatenate([_seq(self.branch0)(x), _seq(self.branch1)(x), self._pool(x)], axis=-1)
+
+
+class InceptionC(nnx.Module):
+    def __init__(self, conv_block, **kw):
+        self.branch0 = conv_block(1536, 256, kernel_size=1, stride=1, **kw)
+        self.branch1_0 = conv_block(1536, 384, kernel_size=1, stride=1, **kw)
+        self.branch1_1a = conv_block(384, 256, kernel_size=(1, 3), stride=1, padding=(0, 1), **kw)
+        self.branch1_1b = conv_block(384, 256, kernel_size=(3, 1), stride=1, padding=(1, 0), **kw)
+        self.branch2_0 = conv_block(1536, 384, kernel_size=1, stride=1, **kw)
+        self.branch2_1 = conv_block(384, 448, kernel_size=(3, 1), stride=1, padding=(1, 0), **kw)
+        self.branch2_2 = conv_block(448, 512, kernel_size=(1, 3), stride=1, padding=(0, 1), **kw)
+        self.branch2_3a = conv_block(512, 256, kernel_size=(1, 3), stride=1, padding=(0, 1), **kw)
+        self.branch2_3b = conv_block(512, 256, kernel_size=(3, 1), stride=1, padding=(1, 0), **kw)
+        self.branch3 = nnx.List([conv_block(1536, 256, kernel_size=1, stride=1, **kw)])
+        self._pool = Pool2d('avg', 3, 1, padding=1)
+
+    def __call__(self, x):
+        x0 = self.branch0(x)
+        x1_0 = self.branch1_0(x)
+        x1 = jnp.concatenate([self.branch1_1a(x1_0), self.branch1_1b(x1_0)], axis=-1)
+        x2 = self.branch2_2(self.branch2_1(self.branch2_0(x)))
+        x2 = jnp.concatenate([self.branch2_3a(x2), self.branch2_3b(x2)], axis=-1)
+        x3 = self.branch3[0](self._pool(x))
+        return jnp.concatenate([x0, x1, x2, x3], axis=-1)
+
+
+class InceptionV4(nnx.Module):
+    """(reference inception_v4.py:220-420)."""
+
+    def __init__(
+            self,
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            output_stride: int = 32,
+            drop_rate: float = 0.0,
+            global_pool: str = 'avg',
+            norm_eps: float = 1e-3,
+            act_layer: str = 'relu',
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert output_stride == 32
+        self.num_classes = num_classes
+        self.drop_rate = drop_rate
+        self.num_features = self.head_hidden_size = 1536
+        conv_block = partial(
+            ConvNormAct, padding=0,
+            norm_layer=partial(BatchNormAct2d, eps=norm_eps),
+            act_layer=act_layer)
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+        features = [
+            conv_block(in_chans, 32, kernel_size=3, stride=2, **kw),
+            conv_block(32, 32, kernel_size=3, stride=1, **kw),
+            conv_block(32, 64, kernel_size=3, stride=1, padding=1, **kw),
+            Mixed3a(conv_block, **kw),
+            Mixed4a(conv_block, **kw),
+            Mixed5a(conv_block, **kw),
+        ]
+        features += [InceptionA(conv_block, **kw) for _ in range(4)]
+        features += [ReductionA(conv_block, **kw)]
+        features += [InceptionB(conv_block, **kw) for _ in range(7)]
+        features += [ReductionB(conv_block, **kw)]
+        features += [InceptionC(conv_block, **kw) for _ in range(3)]
+        self.features = nnx.List(features)
+        self.feature_info = [
+            dict(num_chs=64, reduction=2, module='features.2'),
+            dict(num_chs=160, reduction=4, module='features.3'),
+            dict(num_chs=384, reduction=8, module='features.9'),
+            dict(num_chs=1024, reduction=16, module='features.17'),
+            dict(num_chs=1536, reduction=32, module='features.21'),
+        ]
+        self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=True)
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        self.last_linear = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs) if num_classes > 0 else None
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    def no_weight_decay(self) -> set:
+        return set()
+
+    def group_matcher(self, coarse: bool = False):
+        return dict(stem=r'^features\.[012]\.', blocks=r'^features\.(\d+)')
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        assert not enable, 'gradient checkpointing not supported'
+
+    def get_classifier(self):
+        return self.last_linear
+
+    def reset_classifier(self, num_classes: int, global_pool: str = 'avg', *, rngs=None):
+        self.num_classes = num_classes
+        self.global_pool = SelectAdaptivePool2d(pool_type=global_pool, flatten=True)
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        self.last_linear = nnx.Linear(
+            self.num_features, num_classes, kernel_init=trunc_normal_(std=0.02),
+            dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs) if num_classes > 0 else None
+
+    def forward_features(self, x):
+        for m in self.features:
+            x = m(x)
+        return x
+
+    def forward_head(self, x, pre_logits: bool = False):
+        x = self.global_pool(x)
+        x = self.head_drop(x)
+        if pre_logits or self.last_linear is None:
+            return x
+        return self.last_linear(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        stage_ends = [int(info['module'].split('.')[-1]) for info in self.feature_info]
+        take_indices, max_index = feature_take_indices(len(stage_ends), indices)
+        take_indices = [stage_ends[i] for i in take_indices]
+        max_index = stage_ends[max_index]
+        intermediates = []
+        feats = self.features if not stop_early else list(self.features)[:max_index + 1]
+        for feat_idx, m in enumerate(feats):
+            x = m(x)
+            if feat_idx in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        return x, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        stage_ends = [int(info['module'].split('.')[-1]) for info in self.feature_info]
+        take_indices, max_index = feature_take_indices(len(stage_ends), indices)
+        max_index = stage_ends[max_index]
+        self.features = nnx.List(list(self.features)[:max_index + 1])
+        if prune_head:
+            self.reset_classifier(0, '')
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    """Branch Sequentials containing paramless pools: the conv inside
+    branch3 sits at torch index 1 but our list index 0."""
+    import re
+
+    from ._torch_convert import convert_torch_state_dict
+    out = {}
+    for k, v in state_dict.items():
+        k = re.sub(r'\.branch3\.1\.', '.branch3.0.', k)
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_inception_v4(variant, pretrained=False, **kwargs) -> InceptionV4:
+    return build_model_with_cfg(
+        InceptionV4, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(feature_cls='getter'),
+        **kwargs,
+    )
+
+
+default_cfgs = generate_default_cfgs({
+    'inception_v4.tf_in1k': {
+        'hf_hub_id': 'timm/',
+        'num_classes': 1000, 'input_size': (3, 299, 299), 'pool_size': (8, 8),
+        'crop_pct': 0.875, 'interpolation': 'bicubic',
+        'mean': (0.5, 0.5, 0.5), 'std': (0.5, 0.5, 0.5),
+        'first_conv': 'features.0.conv', 'classifier': 'last_linear',
+    },
+})
+
+
+@register_model
+def inception_v4(pretrained=False, **kwargs):
+    return _create_inception_v4('inception_v4', pretrained, **kwargs)

@@ -1,0 +1,364 @@
+"""Pooling-based Vision Transformer (PiT)
+(reference: timm/models/pit.py:1-555), TPU-native NHWC/NLC.
+
+ViT stages separated by depthwise-conv token pooling; the cls (and optional
+distill) tokens ride along through a parallel linear. Spatial maps stay NHWC;
+transformer blocks reuse the ViT Block on NLC tokens.
+"""
+from __future__ import annotations
+
+import math
+import re
+from functools import partial
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from flax import nnx
+
+from ..layers import LayerNorm, calculate_drop_path_rates, create_conv2d, to_2tuple, trunc_normal_, zeros_
+from ..layers.drop import Dropout
+from ._builder import build_model_with_cfg
+from ._features import feature_take_indices
+from ._registry import generate_default_cfgs, register_model
+from .vision_transformer import Block
+
+__all__ = ['PoolingVisionTransformer']
+
+
+class PitPooling(nnx.Module):
+    """dw conv pool for spatial tokens + fc for cls tokens (reference pit.py:76-100)."""
+
+    def __init__(self, in_feature, out_feature, stride, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.conv = create_conv2d(
+            in_feature, out_feature, stride + 1, stride=stride, padding=stride // 2,
+            groups=in_feature, bias=True, dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.fc = nnx.Linear(
+            in_feature, out_feature, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x, cls_token):
+        return self.conv(x), self.fc(cls_token)
+
+
+class PitTransformer(nnx.Module):
+    """A stage: optional pooling then ViT blocks over [cls; spatial] tokens
+    (reference pit.py:28-74)."""
+
+    def __init__(self, base_dim, depth, heads, mlp_ratio, pool=None,
+                 proj_drop=0.0, attn_drop=0.0, drop_path_prob=None,
+                 *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        embed_dim = base_dim * heads
+        self.pool = pool
+        self.blocks = nnx.List([
+            Block(
+                dim=embed_dim,
+                num_heads=heads,
+                mlp_ratio=mlp_ratio,
+                qkv_bias=True,
+                proj_drop=proj_drop,
+                attn_drop=attn_drop,
+                drop_path=drop_path_prob[i] if drop_path_prob is not None else 0.0,
+                norm_layer=partial(LayerNorm, eps=1e-6),
+                dtype=dtype, param_dtype=param_dtype, rngs=rngs,
+            )
+            for i in range(depth)])
+
+    def __call__(self, x, cls_tokens):
+        token_length = cls_tokens.shape[1]
+        if self.pool is not None:
+            x, cls_tokens = self.pool(x, cls_tokens)
+        B, H, W, C = x.shape
+        tokens = jnp.concatenate([cls_tokens, x.reshape(B, -1, C)], axis=1)
+        for blk in self.blocks:
+            tokens = blk(tokens)
+        cls_tokens = tokens[:, :token_length]
+        x = tokens[:, token_length:].reshape(B, H, W, C)
+        return x, cls_tokens
+
+
+class ConvEmbedding(nnx.Module):
+    """(reference pit.py:102-135)."""
+
+    def __init__(self, in_channels, out_channels, img_size=224, patch_size=16, stride=8,
+                 padding=0, *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
+        self.img_size = to_2tuple(img_size)
+        self.patch_size = to_2tuple(patch_size)
+        self.height = math.floor((self.img_size[0] + 2 * padding - self.patch_size[0]) / stride + 1)
+        self.width = math.floor((self.img_size[1] + 2 * padding - self.patch_size[1]) / stride + 1)
+        self.grid_size = (self.height, self.width)
+        self.conv = create_conv2d(
+            in_channels, out_channels, patch_size, stride=stride, padding=padding, bias=True,
+            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+
+    def __call__(self, x):
+        return self.conv(x)
+
+
+class PoolingVisionTransformer(nnx.Module):
+    """(reference pit.py:137-360)."""
+
+    def __init__(
+            self,
+            img_size: int = 224,
+            patch_size: int = 16,
+            stride: int = 8,
+            stem_type: str = 'overlap',
+            base_dims: Sequence[int] = (48, 48, 48),
+            depth: Sequence[int] = (2, 6, 4),
+            heads: Sequence[int] = (2, 4, 8),
+            mlp_ratio: float = 4,
+            num_classes: int = 1000,
+            in_chans: int = 3,
+            global_pool: str = 'token',
+            distilled: bool = False,
+            drop_rate: float = 0.0,
+            pos_drop_drate: float = 0.0,
+            proj_drop_rate: float = 0.0,
+            attn_drop_rate: float = 0.0,
+            drop_path_rate: float = 0.0,
+            *,
+            dtype=None,
+            param_dtype=jnp.float32,
+            rngs: nnx.Rngs,
+    ):
+        assert global_pool in ('token',)
+        kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
+        self.base_dims = base_dims
+        self.heads = heads
+        embed_dim = base_dims[0] * heads[0]
+        self.num_classes = num_classes
+        self.global_pool = global_pool
+        self.num_tokens = 2 if distilled else 1
+        self.feature_info = []
+
+        self.patch_embed = ConvEmbedding(in_chans, embed_dim, img_size, patch_size, stride, **kw)
+        import jax
+        k1, k2 = jax.random.split(rngs.params())
+        # NHWC pos embed (the reference stores NCHW; the filter transposes)
+        self.pos_embed = nnx.Param(trunc_normal_(std=0.02)(
+            k1, (1, self.patch_embed.height, self.patch_embed.width, embed_dim), param_dtype))
+        self.cls_token = nnx.Param(trunc_normal_(std=0.02)(
+            k2, (1, self.num_tokens, embed_dim), param_dtype))
+        self.pos_drop = Dropout(pos_drop_drate, rngs=rngs)
+
+        transformers = []
+        dpr = calculate_drop_path_rates(drop_path_rate, list(depth), stagewise=True)
+        prev_dim = embed_dim
+        for i in range(len(depth)):
+            pool = None
+            embed_dim = base_dims[i] * heads[i]
+            if i > 0:
+                pool = PitPooling(prev_dim, embed_dim, stride=2, **kw)
+            transformers.append(PitTransformer(
+                base_dims[i], depth[i], heads[i], mlp_ratio, pool=pool,
+                proj_drop=proj_drop_rate, attn_drop=attn_drop_rate, drop_path_prob=dpr[i], **kw))
+            prev_dim = embed_dim
+            self.feature_info += [dict(num_chs=prev_dim, reduction=(stride - 1) * 2 ** i, module=f'transformers.{i}')]
+        self.transformers = nnx.List(transformers)
+
+        self.norm = LayerNorm(base_dims[-1] * heads[-1], eps=1e-6, rngs=rngs)
+        self.num_features = self.head_hidden_size = self.embed_dim = embed_dim
+        self.head_drop = Dropout(drop_rate, rngs=rngs)
+        linear = partial(nnx.Linear, kernel_init=trunc_normal_(std=0.02), bias_init=zeros_, **kw)
+        self.head = linear(self.embed_dim, num_classes) if num_classes > 0 else None
+        self.head_dist = (linear(self.embed_dim, num_classes) if num_classes > 0 else None) if distilled else None
+        self.distilled_training = False
+        self._dtype = dtype
+        self._param_dtype = param_dtype
+
+    # -- contract ------------------------------------------------------------
+    def no_weight_decay(self) -> set:
+        return {'pos_embed', 'cls_token'}
+
+    def set_distilled_training(self, enable: bool = True):
+        self.distilled_training = enable
+
+    def set_grad_checkpointing(self, enable: bool = True):
+        assert not enable, 'gradient checkpointing not supported'
+
+    def get_classifier(self):
+        if self.head_dist is not None:
+            return self.head, self.head_dist
+        return self.head
+
+    def reset_classifier(self, num_classes: int, global_pool: Optional[str] = None, *, rngs=None):
+        self.num_classes = num_classes
+        if global_pool is not None:
+            self.global_pool = global_pool
+        rngs = rngs if rngs is not None else nnx.Rngs(0)
+        linear = partial(nnx.Linear, kernel_init=trunc_normal_(std=0.02),
+                         dtype=self._dtype, param_dtype=self._param_dtype, rngs=rngs)
+        self.head = linear(self.embed_dim, num_classes) if num_classes > 0 else None
+        if self.head_dist is not None:
+            self.head_dist = linear(self.embed_dim, num_classes) if num_classes > 0 else None
+
+    # -- forward -------------------------------------------------------------
+    def forward_features(self, x):
+        x = self.patch_embed(x)
+        x = self.pos_drop(x + self.pos_embed[...].astype(x.dtype))
+        cls_tokens = jnp.broadcast_to(
+            self.cls_token[...].astype(x.dtype), (x.shape[0], self.num_tokens, x.shape[-1]))
+        for stage in self.transformers:
+            x, cls_tokens = stage(x, cls_tokens)
+        return self.norm(cls_tokens)
+
+    def forward_head(self, x, pre_logits: bool = False):
+        if self.head_dist is not None:
+            assert self.global_pool == 'token'
+            x, x_dist = x[:, 0], x[:, 1]
+            x = self.head_drop(x)
+            x_dist = self.head_drop(x_dist)
+            if not pre_logits:
+                x = self.head(x)
+                x_dist = self.head_dist(x_dist)
+            if self.distilled_training and not self.head_drop.deterministic:
+                return x, x_dist
+            return (x + x_dist) / 2
+        if self.global_pool == 'token':
+            x = x[:, 0]
+        x = self.head_drop(x)
+        if pre_logits or self.head is None:
+            return x
+        return self.head(x)
+
+    def __call__(self, x):
+        return self.forward_head(self.forward_features(x))
+
+    def forward_intermediates(
+            self, x, indices=None, norm: bool = False, stop_early: bool = False,
+            output_fmt: str = 'NHWC', intermediates_only: bool = False,
+    ):
+        assert output_fmt == 'NHWC'
+        take_indices, max_index = feature_take_indices(len(self.transformers), indices)
+        x = self.patch_embed(x)
+        x = self.pos_drop(x + self.pos_embed[...].astype(x.dtype))
+        cls_tokens = jnp.broadcast_to(
+            self.cls_token[...].astype(x.dtype), (x.shape[0], self.num_tokens, x.shape[-1]))
+        intermediates = []
+        last_idx = len(self.transformers) - 1
+        stages = self.transformers if not stop_early else list(self.transformers)[:max_index + 1]
+        feat_idx = 0
+        for feat_idx, stage in enumerate(stages):
+            x, cls_tokens = stage(x, cls_tokens)
+            if feat_idx in take_indices:
+                intermediates.append(x)
+        if intermediates_only:
+            return intermediates
+        if feat_idx == last_idx:
+            cls_tokens = self.norm(cls_tokens)
+        return cls_tokens, intermediates
+
+    def prune_intermediate_layers(self, indices=1, prune_norm: bool = False, prune_head: bool = True):
+        take_indices, max_index = feature_take_indices(len(self.transformers), indices)
+        self.transformers = nnx.List(list(self.transformers)[:max_index + 1])
+        if prune_head:
+            self.reset_classifier(0)
+        return take_indices
+
+
+def checkpoint_filter_fn(state_dict, model):
+    """Remap original pools.N → transformers.N+1.pool, transpose the NCHW
+    pos_embed to NHWC (reference pit.py:363-372)."""
+    import numpy as np
+
+    from ._torch_convert import convert_torch_state_dict
+    p_blocks = re.compile(r'pools\.(\d)\.')
+    out = {}
+    for k, v in state_dict.items():
+        k = p_blocks.sub(lambda exp: f'transformers.{int(exp.group(1)) + 1}.pool.', k)
+        if k == 'pos_embed':
+            v = np.asarray(v).transpose(0, 2, 3, 1)  # NCHW → NHWC
+        out[k] = v
+    return convert_torch_state_dict(out, model)
+
+
+def _create_pit(variant, pretrained=False, **kwargs):
+    out_indices = kwargs.pop('out_indices', 3)
+    return build_model_with_cfg(
+        PoolingVisionTransformer, variant, pretrained,
+        pretrained_filter_fn=checkpoint_filter_fn,
+        feature_cfg=dict(out_indices=out_indices),
+        **kwargs,
+    )
+
+
+def _cfg(url='', **kwargs):
+    return {
+        'url': url, 'num_classes': 1000, 'input_size': (3, 224, 224), 'pool_size': None,
+        'crop_pct': 0.9, 'interpolation': 'bicubic',
+        'mean': (0.485, 0.456, 0.406), 'std': (0.229, 0.224, 0.225),
+        'first_conv': 'patch_embed.conv', 'classifier': 'head',
+        **kwargs,
+    }
+
+
+default_cfgs = generate_default_cfgs({
+    'pit_ti_224.in1k': _cfg(hf_hub_id='timm/'),
+    'pit_xs_224.in1k': _cfg(hf_hub_id='timm/'),
+    'pit_s_224.in1k': _cfg(hf_hub_id='timm/'),
+    'pit_b_224.in1k': _cfg(hf_hub_id='timm/'),
+    'pit_ti_distilled_224.in1k': _cfg(hf_hub_id='timm/', classifier=('head', 'head_dist')),
+    'pit_xs_distilled_224.in1k': _cfg(hf_hub_id='timm/', classifier=('head', 'head_dist')),
+    'pit_s_distilled_224.in1k': _cfg(hf_hub_id='timm/', classifier=('head', 'head_dist')),
+    'pit_b_distilled_224.in1k': _cfg(hf_hub_id='timm/', classifier=('head', 'head_dist')),
+})
+
+
+@register_model
+def pit_b_224(pretrained=False, **kwargs) -> PoolingVisionTransformer:
+    model_args = dict(
+        patch_size=14, stride=7, base_dims=[64, 64, 64], depth=[3, 6, 4], heads=[4, 8, 16], mlp_ratio=4)
+    return _create_pit('pit_b_224', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def pit_s_224(pretrained=False, **kwargs) -> PoolingVisionTransformer:
+    model_args = dict(
+        patch_size=16, stride=8, base_dims=[48, 48, 48], depth=[2, 6, 4], heads=[3, 6, 12], mlp_ratio=4)
+    return _create_pit('pit_s_224', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def pit_xs_224(pretrained=False, **kwargs) -> PoolingVisionTransformer:
+    model_args = dict(
+        patch_size=16, stride=8, base_dims=[48, 48, 48], depth=[2, 6, 4], heads=[2, 4, 8], mlp_ratio=4)
+    return _create_pit('pit_xs_224', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def pit_ti_224(pretrained=False, **kwargs) -> PoolingVisionTransformer:
+    model_args = dict(
+        patch_size=16, stride=8, base_dims=[32, 32, 32], depth=[2, 6, 4], heads=[2, 4, 8], mlp_ratio=4)
+    return _create_pit('pit_ti_224', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def pit_b_distilled_224(pretrained=False, **kwargs) -> PoolingVisionTransformer:
+    model_args = dict(
+        patch_size=14, stride=7, base_dims=[64, 64, 64], depth=[3, 6, 4], heads=[4, 8, 16],
+        mlp_ratio=4, distilled=True)
+    return _create_pit('pit_b_distilled_224', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def pit_s_distilled_224(pretrained=False, **kwargs) -> PoolingVisionTransformer:
+    model_args = dict(
+        patch_size=16, stride=8, base_dims=[48, 48, 48], depth=[2, 6, 4], heads=[3, 6, 12],
+        mlp_ratio=4, distilled=True)
+    return _create_pit('pit_s_distilled_224', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def pit_xs_distilled_224(pretrained=False, **kwargs) -> PoolingVisionTransformer:
+    model_args = dict(
+        patch_size=16, stride=8, base_dims=[48, 48, 48], depth=[2, 6, 4], heads=[2, 4, 8],
+        mlp_ratio=4, distilled=True)
+    return _create_pit('pit_xs_distilled_224', pretrained, **dict(model_args, **kwargs))
+
+
+@register_model
+def pit_ti_distilled_224(pretrained=False, **kwargs) -> PoolingVisionTransformer:
+    model_args = dict(
+        patch_size=16, stride=8, base_dims=[32, 32, 32], depth=[2, 6, 4], heads=[2, 4, 8],
+        mlp_ratio=4, distilled=True)
+    return _create_pit('pit_ti_distilled_224', pretrained, **dict(model_args, **kwargs))

@@ -158,3 +158,41 @@ def test_repeat_aug_sampler_semantics(tmp_path):
         FakeDs(), batch_size=4, is_training=True, num_aug_repeats=3,
         process_index=0, process_count=3, seed=0)
     assert list(loader2._shard_indices(shuffled=True)) == per_rank[0]
+
+
+def test_augmix_jsd_splitbn_pipeline(tmp_path):
+    """AugMix aug-splits end-to-end: tuple collate, JSD loss, split BN
+    (reference train.py:886-913 + dataset.py:170)."""
+    import numpy as np
+    from PIL import Image
+
+    from timm_tpu.data import create_dataset, create_loader
+    from timm_tpu.data.dataset import AugMixDataset
+    from timm_tpu.layers import convert_splitbn_model
+    from timm_tpu.loss import JsdCrossEntropy
+    import timm_tpu
+
+    for cls in ('a', 'b'):
+        d = tmp_path / 'train' / cls
+        d.mkdir(parents=True)
+        for i in range(4):
+            Image.fromarray((np.random.rand(64, 64, 3) * 255).astype('uint8')).save(d / f'{i}.jpg')
+
+    ds = create_dataset('', root=str(tmp_path), split='train', is_training=True)
+    ds = AugMixDataset(ds, num_splits=3)
+    loader = create_loader(
+        ds, input_size=(3, 64, 64), batch_size=4, is_training=True,
+        num_aug_splits=3, num_workers=0, auto_augment='augmix-m3-w2')
+    x, t = next(iter(loader))
+    assert x.shape == (12, 64, 64, 3)  # 4 samples x 3 splits, split-major
+    assert t.shape == (12,)
+    assert (t[:4] == t[4:8]).all() and (t[:4] == t[8:]).all()
+
+    import jax.numpy as jnp
+
+    model = timm_tpu.create_model('test_efficientnet', num_classes=5)
+    model = convert_splitbn_model(model, 3)
+    model.train()
+    out = model(jnp.asarray(x, jnp.float32) / 255.0)
+    loss = JsdCrossEntropy(num_splits=3, smoothing=0.1)(out, jnp.asarray(t))
+    assert bool(jnp.isfinite(loss))

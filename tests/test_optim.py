@@ -51,7 +51,7 @@ def test_optimizer_step(opt_name):
         for a, b in zip(jax.tree.leaves(nnx.state(model, nnx.Param)), jax.tree.leaves(params)))
 
 
-@pytest.mark.parametrize('opt_name', ['sgd', 'adamw', 'lamb', 'lion', 'muon', 'nadamw', 'adopt'])
+@pytest.mark.parametrize('opt_name', ['sgd', 'adamw', 'lamb', 'lion', 'muon', 'nadamw', 'adopt', 'madgrad', 'laprop', 'mars'])
 def test_optimizer_converges(opt_name):
     model, x, y = _toy_problem()
     opt = create_optimizer_v2(model, opt=opt_name, lr=5e-2, weight_decay=0.0)

@@ -179,3 +179,40 @@ def test_tar_reader(tmp_path):
     assert len(ds) == 6
     img, target = ds[5]
     assert target == 1
+
+
+def test_reader_hfids_imagefolder(tmp_path):
+    """hfids/ streaming scheme over a local imagefolder builder
+    (reference readers/reader_hfids.py:29)."""
+    import numpy as np
+    from PIL import Image
+
+    from timm_tpu.data import create_dataset
+
+    for cls in ('x', 'y'):
+        d = tmp_path / 'train' / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            Image.fromarray((np.random.rand(32, 32, 3) * 255).astype('uint8')).save(d / f'{i}.jpg')
+
+    ds = create_dataset('hfids/imagefolder', root=str(tmp_path), split='train', is_training=False)
+    samples = list(iter(ds))
+    assert len(samples) == 6
+    img, target = samples[0]
+    assert img.size == (32, 32)
+    assert target in (0, 1)
+
+
+def test_torch_scheme_raises_without_torchvision():
+    from timm_tpu.data import create_dataset
+    try:
+        import torchvision  # noqa: F401
+        has_tv = True
+    except ImportError:
+        has_tv = False
+    if has_tv:
+        import pytest
+        pytest.skip('torchvision installed; scheme exercised elsewhere')
+    import pytest
+    with pytest.raises(ImportError, match='torchvision'):
+        create_dataset('torch/cifar10', root='/tmp/nonexistent')

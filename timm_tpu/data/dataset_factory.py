@@ -104,9 +104,47 @@ def create_dataset(
             dist_rank=jax.process_index(), dist_num_replicas=jax.process_count(),
         )
         return IterableImageDataset(root, reader=reader)
-    if name.startswith(('hfids/', 'torch/')):
-        raise NotImplementedError(
-            f'Dataset scheme {name.split("/")[0]} is not wired up yet; use folder, wds/, tfds/ or hfds/.')
+    if name.startswith('hfids/'):
+        import jax
+
+        from .dataset import IterableImageDataset
+        from .readers_streaming import ReaderHfids
+        reader = ReaderHfids(
+            name=name[6:], root=root, split=split, is_training=is_training,
+            seed=kwargs.get('seed', 42), input_img_mode=input_img_mode,
+            input_key=kwargs.get('input_key', 'image'),
+            target_key=kwargs.get('target_key', 'label'),
+            dist_rank=jax.process_index(), dist_num_replicas=jax.process_count(),
+        )
+        return IterableImageDataset(root, reader=reader)
+    if name.startswith('torch/'):
+        # torchvision dataset schemes (reference dataset_factory.py:63-230);
+        # torchvision is an optional dependency here
+        try:
+            from torchvision import datasets as tv_datasets
+        except ImportError as e:
+            raise ImportError(
+                'torch/ dataset schemes require torchvision, which is not installed') from e
+        name = name[6:].lower()
+        tv_split = 'train' if is_training or split in ('train', 'training') else 'val'
+        _simple = dict(
+            cifar10=tv_datasets.CIFAR10, cifar100=tv_datasets.CIFAR100,
+            mnist=tv_datasets.MNIST, kmnist=tv_datasets.KMNIST,
+            fashion_mnist=tv_datasets.FashionMNIST, qmnist=tv_datasets.QMNIST,
+        )
+        if name in _simple:
+            return _simple[name](root=root, train=tv_split == 'train', download=kwargs.get('download', False))
+        if name == 'image_folder' or name == 'folder':
+            if search_split and root and os.path.isdir(root):
+                root = _search_split(root, split)
+            return tv_datasets.ImageFolder(root)
+        if name == 'places365':
+            return tv_datasets.Places365(
+                root=root, split='train-standard' if tv_split == 'train' else 'val',
+                download=kwargs.get('download', False))
+        if name == 'imagenet':
+            return tv_datasets.ImageNet(root=root, split=tv_split)
+        raise ValueError(f'Unknown torchvision dataset {name}')
     # tar file(s): map-style reader over image members
     if root and (str(root).endswith('.tar') or name == 'tar'):
         from .readers_streaming import ReaderImageInTar

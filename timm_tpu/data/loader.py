@@ -199,11 +199,23 @@ class StreamingLoader:
                 pass
 
     def _collate(self, imgs, targets):
-        x = np.stack(imgs)
-        t = np.asarray(targets)
+        x, t = _collate_arrays(imgs, targets)
         if self.random_erasing is not None:
             x = self.random_erasing(x)
         return x, t
+
+
+
+def _collate_arrays(imgs, targets):
+    """Stack a list of samples; AugMix tuple samples (clean, aug1..augN) are
+    concatenated split-major along batch with targets repeated per split
+    (reference loader.py fast_collate tuple path)."""
+    if isinstance(imgs[0], (tuple, list)):
+        n_splits = len(imgs[0])
+        x = np.concatenate([np.stack([im[j] for im in imgs]) for j in range(n_splits)])
+        t = np.tile(np.asarray(targets), n_splits)
+        return x, t
+    return np.stack(imgs), np.asarray(targets)
 
 
 class ThreadedLoader:
@@ -348,8 +360,7 @@ class ThreadedLoader:
             def emit(force_last: bool):
                 nonlocal batch_imgs, batch_targets
                 if len(batch_imgs) == self.batch_size or (force_last and batch_imgs and not self.drop_last):
-                    x = np.stack(batch_imgs)
-                    t = np.asarray(batch_targets)
+                    x, t = _collate_arrays(batch_imgs, batch_targets)
                     if self.random_erasing is not None:
                         x = self.random_erasing(x)
                     ok = _put(batch_q, (x, t))

@@ -348,3 +348,79 @@ class ReaderTfds:
             if self.input_img_mode and img.mode != self.input_img_mode:
                 img = img.convert(self.input_img_mode)
             yield img, int(ex.get('label', -1))
+
+
+class ReaderHfids:
+    """Hugging Face streaming (IterableDataset) reader
+    (reference readers/reader_hfids.py:29). `name` is a hub dataset or a local
+    builder such as 'imagefolder' (with `root` as its data_dir), loaded with
+    streaming=True; shards are distributed with .shard() and training epochs
+    use the builtin buffered shuffle keyed on (seed, epoch)."""
+
+    def __init__(
+            self,
+            name: str,
+            root: Optional[str] = None,
+            split: str = 'train',
+            is_training: bool = False,
+            seed: int = 42,
+            shuffle_size: int = 2048,
+            input_key: str = 'image',
+            input_img_mode: str = 'RGB',
+            target_key: str = 'label',
+            dist_rank: int = 0,
+            dist_num_replicas: int = 1,
+    ):
+        import datasets as hfds
+        split = {'val': 'validation'}.get(split, split)
+        load_kwargs = {}
+        if name in ('imagefolder',):
+            load_kwargs['data_dir'] = root
+        else:
+            load_kwargs['cache_dir'] = root or None
+        self.ds = hfds.load_dataset(name, split=split, streaming=True, **load_kwargs)
+        self.is_training = is_training
+        self.seed = seed
+        self.shuffle_size = shuffle_size if is_training else 0
+        self.input_key = input_key
+        self.input_img_mode = input_img_mode
+        self.target_key = target_key
+        self.dist_rank = dist_rank
+        self.dist_num_replicas = dist_num_replicas
+        self.num_workers = 1
+        self.worker_id = 0
+        self.epoch = -1
+        self.num_samples = getattr(self.ds.info.splits.get(split), 'num_examples', None) \
+            if getattr(self.ds, 'info', None) and self.ds.info.splits else None
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def set_worker_info(self, worker_id: int, num_workers: int):
+        self.worker_id = worker_id
+        self.num_workers = num_workers
+
+    def __len__(self):
+        if self.num_samples is None:
+            raise TypeError('streaming hfids dataset length unknown')
+        return self.num_samples
+
+    def __iter__(self):
+        ds = self.ds
+        # shuffle FIRST so the stride-split fallback below still sees a
+        # shuffled stream (a raw generator can't be shuffled)
+        if self.is_training and self.shuffle_size:
+            ds = ds.shuffle(seed=self.seed + max(self.epoch, 0), buffer_size=self.shuffle_size)
+        total_shards = self.dist_num_replicas * self.num_workers
+        index = self.dist_rank * self.num_workers + self.worker_id
+        if total_shards > 1:
+            try:
+                ds = ds.shard(num_shards=total_shards, index=index)
+            except Exception:
+                # unshardable stream: fall back to stride-based sample split
+                ds = (s for i, s in enumerate(ds) if i % total_shards == index)
+        for item in ds:
+            img = item[self.input_key]
+            if hasattr(img, 'convert') and self.input_img_mode and img.mode != self.input_img_mode:
+                img = img.convert(self.input_img_mode)
+            yield img, item[self.target_key]

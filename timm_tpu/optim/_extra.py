@@ -1,0 +1,248 @@
+"""Long-tail optimizers: MADGRAD, LaProp, MARS
+(reference: timm/optim/madgrad.py:189, laprop.py:159, mars.py:207),
+as optax gradient transformations.
+
+All are written as pure update rules over pytrees — state lives in the optax
+state tuple, updates are returned as parameter deltas, and everything traces
+cleanly under jit (the step counter is a traced scalar, not python state).
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _resolve_mask(mask, params):
+    """Weight-decay mask → pytree of bools matching params (factory passes a
+    pytree or callable like optax.add_decayed_weights)."""
+    if mask is None:
+        return None
+    return mask(params) if callable(mask) else mask
+
+
+class MadgradState(NamedTuple):
+    step: chex.Array
+    grad_sum_sq: optax.Updates
+    s: optax.Updates
+    x0: optax.Params
+
+
+def madgrad(
+        learning_rate: float = 1e-2,
+        momentum: float = 0.9,
+        weight_decay: float = 0.0,
+        eps: float = 1e-6,
+        decoupled_decay: bool = False,
+        mask=None,
+) -> optax.GradientTransformation:
+    """MADGRAD: momentumized, adaptive dual-averaged gradient
+    (reference madgrad.py:91-189)."""
+    ck = 1 - momentum
+
+    def init_fn(params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return MadgradState(
+            step=jnp.zeros([], jnp.int32),
+            grad_sum_sq=zeros,
+            s=jax.tree.map(jnp.zeros_like, params),
+            x0=jax.tree.map(jnp.asarray, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        assert params is not None, 'madgrad requires params'
+        step = state.step + 1
+        lr = learning_rate + eps
+        lamb = lr * jnp.sqrt(step.astype(jnp.float32))
+        wd_mask = _resolve_mask(mask, params)
+
+        def one(g, p_orig, gss, s, x0, decay_ok):
+            p = p_orig
+            if weight_decay and decay_ok:
+                if decoupled_decay:
+                    p = p * (1.0 - learning_rate * weight_decay)
+                else:
+                    g = g + weight_decay * p
+            gss = gss + lamb * g * g
+            rms = jnp.cbrt(gss) + eps
+            s = s + lamb * g
+            z = x0 - s / rms
+            if momentum == 0:
+                new_p = z
+            else:
+                new_p = (1 - ck) * p + ck * z
+            # delta is applied to the ORIGINAL param by optax.apply_updates
+            return new_p - p_orig, gss, s
+
+        flat_g, treedef = jax.tree.flatten(updates)
+        flat_p = treedef.flatten_up_to(params)
+        flat_gss = treedef.flatten_up_to(state.grad_sum_sq)
+        flat_s = treedef.flatten_up_to(state.s)
+        flat_x0 = treedef.flatten_up_to(state.x0)
+        flat_m = treedef.flatten_up_to(wd_mask) if wd_mask is not None else [True] * len(flat_g)
+        out = [one(g, p, gss, s, x0, m) for g, p, gss, s, x0, m in
+               zip(flat_g, flat_p, flat_gss, flat_s, flat_x0, flat_m)]
+        deltas = treedef.unflatten([o[0] for o in out])
+        new_gss = treedef.unflatten([o[1] for o in out])
+        new_s = treedef.unflatten([o[2] for o in out])
+        return deltas, MadgradState(step=step, grad_sum_sq=new_gss, s=new_s, x0=state.x0)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class LapropState(NamedTuple):
+    step: chex.Array
+    exp_avg: optax.Updates
+    exp_avg_sq: optax.Updates
+    exp_avg_lr_1: chex.Array
+    exp_avg_lr_2: chex.Array
+
+
+def laprop(
+        learning_rate: float = 4e-4,
+        b1: float = 0.9,
+        b2: float = 0.999,
+        eps: float = 1e-15,
+        weight_decay: float = 0.0,
+        mask=None,
+) -> optax.GradientTransformation:
+    """LaProp: decouples momentum from adaptive normalization — the momentum
+    buffer accumulates lr-scaled NORMALIZED gradients (reference laprop.py:80-150)."""
+
+    def init_fn(params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return LapropState(
+            step=jnp.zeros([], jnp.int32),
+            exp_avg=zeros,
+            exp_avg_sq=jax.tree.map(jnp.zeros_like, params),
+            exp_avg_lr_1=jnp.zeros([], jnp.float32),
+            exp_avg_lr_2=jnp.zeros([], jnp.float32),
+        )
+
+    def update_fn(updates, state, params=None):
+        step = state.step + 1
+        lr = learning_rate
+        ealr1 = state.exp_avg_lr_1 * b1 + (1 - b1) * lr
+        ealr2 = state.exp_avg_lr_2 * b2 + (1 - b2)
+        lr_safe = jnp.where(lr != 0.0, lr, 1.0)
+        bias1 = jnp.where(lr != 0.0, ealr1 / lr_safe, 1.0)
+        step_size = 1.0 / bias1
+
+        def moments(g, eas):
+            return b2 * eas + (1 - b2) * g * g
+
+        new_eas = jax.tree.map(moments, updates, state.exp_avg_sq)
+
+        def momentum(g, ea, eas):
+            denom = jnp.sqrt(eas / ealr2) + eps
+            return b1 * ea + lr * (1 - b1) * (g / denom)
+
+        new_ea = jax.tree.map(momentum, updates, state.exp_avg, new_eas)
+
+        if params is not None:
+            wd_mask = _resolve_mask(mask, params)
+
+            def delta(ea, p, decay_ok):
+                d = -step_size * ea
+                if weight_decay and decay_ok:
+                    d = d - lr * weight_decay * p
+                return d
+
+            ones = jax.tree.map(lambda _: True, params) if wd_mask is None else wd_mask
+            deltas = jax.tree.map(delta, new_ea, params, ones)
+        else:
+            deltas = jax.tree.map(lambda ea: -step_size * ea, new_ea)
+        return deltas, LapropState(
+            step=step, exp_avg=new_ea, exp_avg_sq=new_eas,
+            exp_avg_lr_1=ealr1, exp_avg_lr_2=ealr2)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class MarsState(NamedTuple):
+    step: chex.Array
+    exp_avg: optax.Updates
+    exp_avg_sq: optax.Updates
+    last_grad: optax.Updates
+
+
+def mars(
+        learning_rate: float = 3e-3,
+        b1: float = 0.9,
+        b2: float = 0.99,
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        gamma: float = 0.025,
+        mars_type: str = 'adamw',
+        optimize_1d: bool = False,
+        lr_1d_factor: float = 1.0,
+        betas_1d: Optional[Tuple[float, float]] = None,
+        mask=None,
+) -> optax.GradientTransformation:
+    """MARS: variance-reduced adaptive momentum — the momentum input is the
+    gradient plus a clipped scaled gradient difference
+    (reference mars.py:25-105)."""
+    assert mars_type in ('adamw', 'lion')
+    b1_1d, b2_1d = betas_1d or (b1, b2)
+
+    def init_fn(params):
+        return MarsState(
+            step=jnp.zeros([], jnp.int32),
+            exp_avg=jax.tree.map(jnp.zeros_like, params),
+            exp_avg_sq=jax.tree.map(jnp.zeros_like, params),
+            last_grad=jax.tree.map(jnp.zeros_like, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        assert params is not None, 'mars requires params'
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+
+
+        def one(g, p, ea, eas, lg, decay_ok):
+            wd = weight_decay if decay_ok else 0.0
+            if optimize_1d or g.ndim >= 2:
+                c_t_raw = g + gamma * (b1 / (1 - b1)) * (g - lg)
+                norm = jnp.linalg.norm(c_t_raw)
+                c_t_clipped = jnp.where(norm > 1.0, c_t_raw / jnp.maximum(norm, 1e-12), c_t_raw)
+                # first step uses the raw gradient (timm consistency tweak)
+                c_t = jnp.where(step == 1, g, c_t_clipped)
+                new_ea = b1 * ea + (1 - b1) * c_t
+                if mars_type == 'adamw':
+                    new_eas = b2 * eas + (1 - b2) * c_t * c_t
+                    bc1 = 1.0 - b1 ** stepf
+                    bc2 = 1.0 - b2 ** stepf
+                    denom = jnp.sqrt(new_eas) / jnp.sqrt(bc2) + eps
+                    update = p * wd + (new_ea / bc1) / denom
+                else:  # lion
+                    new_eas = eas
+                    update = p * wd + jnp.sign(new_ea)
+                return -learning_rate * update, new_ea, new_eas
+            # 1-D params fall back to AdamW
+            new_ea = b1_1d * ea + (1 - b1_1d) * g
+            new_eas = b2_1d * eas + (1 - b2_1d) * g * g
+            bc1 = 1.0 - b1_1d ** stepf
+            bc2 = 1.0 - b2_1d ** stepf
+            denom = jnp.sqrt(new_eas) / jnp.sqrt(bc2) + eps
+            update = p * wd + (new_ea / bc1) / denom
+            return -(learning_rate * lr_1d_factor) * update, new_ea, new_eas
+
+        flat_g, treedef = jax.tree.flatten(updates)
+        flat_p = treedef.flatten_up_to(params)
+        flat_ea = treedef.flatten_up_to(state.exp_avg)
+        flat_eas = treedef.flatten_up_to(state.exp_avg_sq)
+        flat_lg = treedef.flatten_up_to(state.last_grad)
+        wd_mask = _resolve_mask(mask, params)
+        flat_m = treedef.flatten_up_to(wd_mask) if wd_mask is not None else [True] * len(flat_g)
+        out = [one(g, p, ea, eas, lg, m) for g, p, ea, eas, lg, m in
+               zip(flat_g, flat_p, flat_ea, flat_eas, flat_lg, flat_m)]
+        deltas = treedef.unflatten([o[0] for o in out])
+        new_ea = treedef.unflatten([o[1] for o in out])
+        new_eas = treedef.unflatten([o[2] for o in out])
+        return deltas, MarsState(step=step, exp_avg=new_ea, exp_avg_sq=new_eas, last_grad=updates)
+
+    return optax.GradientTransformation(init_fn, update_fn)

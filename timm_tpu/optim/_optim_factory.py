@@ -291,6 +291,12 @@ def _default_registry() -> OptimizerRegistry:
     r.register(OptimInfo('rmsproptf', _rmsprop_tf, 'TF1-behaviour RMSprop', has_momentum=True))
     r.register(OptimInfo('yogi', optax.yogi, 'Yogi', has_betas=True))
     r.register(OptimInfo('sm3', optax.sm3, 'SM3 (memory-efficient)', has_eps=False))
+    from ._extra import laprop, madgrad, mars
+    r.register(OptimInfo('madgrad', madgrad, 'MADGRAD (momentumized dual averaging)', has_momentum=True))
+    r.register(OptimInfo('madgradw', partial(madgrad, decoupled_decay=True),
+                         'MADGRAD w/ decoupled weight decay', has_momentum=True))
+    r.register(OptimInfo('laprop', laprop, 'LaProp (decoupled momentum/adaptivity)', has_betas=True))
+    r.register(OptimInfo('mars', mars, 'MARS (variance-reduced adaptive momentum)', has_betas=True))
     return r
 
 

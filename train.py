@@ -128,6 +128,10 @@ def make_parser():
     group.add_argument('--bce-sum', action='store_true', default=False)
     group.add_argument('--bce-target-thresh', type=float, default=None)
     group.add_argument('--jsd-loss', action='store_true', default=False)
+    group.add_argument('--aug-splits', type=int, default=0,
+                       help='Number of augmentation splits (AugMix/JSD; 0 or >=2)')
+    group.add_argument('--split-bn', action='store_true',
+                       help='Use separate BN statistics per augmentation split')
     # ema
     group = parser.add_argument_group('Model EMA parameters')
     group.add_argument('--model-ema', action='store_true', default=False)
@@ -261,6 +265,17 @@ def main():
     if args.grad_checkpointing:
         model.set_grad_checkpointing(True)
 
+    # AugMix aug-splits (reference train.py:886-913): wrap BNs with per-split
+    # statistics before the optimizer captures the param tree
+    num_aug_splits = 0
+    if args.aug_splits > 0:
+        assert args.aug_splits > 1, 'a split of 1 makes no sense'
+        num_aug_splits = args.aug_splits
+    if args.split_bn:
+        assert num_aug_splits > 1
+        from timm_tpu.layers import convert_splitbn_model
+        model = convert_splitbn_model(model, max(num_aug_splits, 2))
+
     from timm_tpu.data import resolve_data_config
     data_config = resolve_data_config(vars(args), model=model, verbose=rank == 0)
     img_size = data_config['input_size'][-1]
@@ -306,8 +321,9 @@ def main():
 
     # loss selection (ref train.py:886-913)
     if args.jsd_loss:
-        raise NotImplementedError(
-            '--jsd-loss requires the AugMix aug-splits pipeline, which is not wired up yet')
+        assert num_aug_splits > 1, '--jsd-loss requires --aug-splits > 1'
+        from timm_tpu.loss import JsdCrossEntropy
+        train_loss = JsdCrossEntropy(num_splits=num_aug_splits, smoothing=args.smoothing)
     elif args.mixup > 0 or args.cutmix > 0:
         train_loss = BinaryCrossEntropy(
             smoothing=0.0, target_threshold=args.bce_target_thresh, sum_classes=args.bce_sum,
@@ -369,6 +385,13 @@ def main():
         dataset_eval = create_dataset(
             args.dataset, root=args.data_dir, split=args.val_split, is_training=False,
             class_map=args.class_map, num_classes=args.num_classes)
+        if num_aug_splits > 1:
+            if not hasattr(dataset_train, '__getitem__'):
+                raise ValueError(
+                    '--aug-splits requires a map-style dataset (folder/tar/hfds); '
+                    'streaming schemes (wds/tfds/hfids) are not supported')
+            from timm_tpu.data.dataset import AugMixDataset
+            dataset_train = AugMixDataset(dataset_train, num_splits=num_aug_splits)
         loader_train = create_loader(
             dataset_train,
             input_size=data_config['input_size'],
@@ -384,6 +407,7 @@ def main():
             re_prob=args.reprob,
             re_mode=args.remode,
             re_count=args.recount,
+            num_aug_splits=num_aug_splits,
             interpolation=args.train_interpolation,
             mean=data_config['mean'],
             std=data_config['std'],

@@ -58,6 +58,9 @@ SELF_RESULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'BEN
 
 TOTAL_BUDGET = int(os.environ.get('BENCH_TOTAL_BUDGET', '420'))
 
+# minimum seconds between "measuring" heartbeat status lines
+HEARTBEAT_S = 60
+
 _START = time.time()
 _WATCHDOG = None
 
@@ -151,6 +154,15 @@ def _run_child(args, timeout_s: int) -> dict | None:
            '--watchdog-s', str(timeout_s + 30)]
     if args.batch_size:
         cmd += ['--batch-size', str(args.batch_size)]
+    # precision/alignment A/B levers must reach the measurement process
+    if args.pad_tokens:
+        cmd += ['--pad-tokens', str(args.pad_tokens)]
+    if args.softmax_dtype:
+        cmd += ['--softmax-dtype', args.softmax_dtype]
+    if args.norm_dtype:
+        cmd += ['--norm-dtype', args.norm_dtype]
+    if args.mu_dtype:
+        cmd += ['--mu-dtype', args.mu_dtype]
     t0 = time.time()
     out_f = tempfile.NamedTemporaryFile('w+', suffix='.out', delete=False)
     err_f = tempfile.NamedTemporaryFile('w+', suffix='.err', delete=False)
@@ -161,6 +173,7 @@ def _run_child(args, timeout_s: int) -> dict | None:
             print(f'bench child failed to launch: {e!r}', file=sys.stderr, flush=True)
             return None
         last_beat = time.time()
+        beats = 0
         while proc.poll() is None:
             if time.time() - t0 > timeout_s:
                 proc.kill()
@@ -168,10 +181,17 @@ def _run_child(args, timeout_s: int) -> dict | None:
                 print(f'bench child timed out after {timeout_s}s', file=sys.stderr, flush=True)
                 _status('measurement child timed out; killed')
                 return None
-            if time.time() - last_beat > 25:
+            # Heartbeat is rate-limited to one line per ≥60s: BENCH_r05.json
+            # recorded dozens of identical 25s "measuring" lines, which only
+            # bloat the driver log — the line exists so a killed parent's tail
+            # parses, not as a progress bar.
+            if time.time() - last_beat >= HEARTBEAT_S:
                 _status(f'measuring ({args.model} {args.bench}, child alive {time.time() - t0:.0f}s)')
                 last_beat = time.time()
+                beats += 1
             time.sleep(1)
+        _status(f'measurement child finished (rc={proc.returncode}, {time.time() - t0:.0f}s, '
+                f'{beats} heartbeat(s) suppressed to ≥{HEARTBEAT_S}s cadence)')
         out_f.seek(0)
         stdout = out_f.read()
         err_f.seek(0)
@@ -206,6 +226,23 @@ def main():
     parser.add_argument('--steps', type=int, default=10)
     parser.add_argument('--fast', action='store_true', help='small model / few steps smoke mode')
     parser.add_argument('--no-probe', action='store_true')
+    # --- TPU alignment / precision A/B levers (PERF.md checklist items 3-4).
+    # All default OFF = exact pre-PR numerics; each is independent.
+    parser.add_argument('--pad-tokens', default='',
+                        help="tile-align the ViT token count: 'auto' (next sublane "
+                             "multiple, 197→200), an int (e.g. 256), or '' = off")
+    parser.add_argument('--softmax-dtype', default='',
+                        help="attention softmax internals: 'bfloat16' = fp32 max-"
+                             "subtraction + bf16 exp/normalize, '' = legacy fp32")
+    parser.add_argument('--norm-dtype', default='',
+                        help="LayerNorm/RmsNorm statistics dtype: 'bfloat16' or '' = fp32")
+    parser.add_argument('--mu-dtype', default='',
+                        help="optimizer first-moment dtype: 'bfloat16' halves m HBM "
+                             "traffic (v stays fp32), '' = fp32")
+    parser.add_argument('--dry-run', action='store_true',
+                        help='in-process CPU smoke: build the model + one tiny train/infer '
+                             'step with the requested levers, print a result line, exit. '
+                             'No probe, no child, no TPU.')
     parser.add_argument('--child', action='store_true',
                         help='internal: run the measurement in this process')
     parser.add_argument('--watchdog-s', type=int, default=None,
@@ -216,6 +253,9 @@ def main():
     if args.fast:
         args.model = 'vit_tiny_patch16_224'
         args.steps = 5
+
+    if args.dry_run:
+        raise SystemExit(_dry_run(args))
 
     if args.child:
         raise SystemExit(_measure(args))
@@ -270,6 +310,73 @@ def main():
     raise SystemExit(2)
 
 
+def _apply_precision_knobs(args):
+    """Activate the requested alignment/precision levers process-wide and
+    return (model_kwargs, opt_kwargs, tag) for the run. Every lever defaults
+    off → this is a no-op returning empty kwargs and '' tag."""
+    from timm_tpu.layers import set_norm_internal_dtype, set_softmax_dtype
+    model_kwargs, opt_kwargs, tags = {}, {}, []
+    if args.pad_tokens:
+        pad = args.pad_tokens if args.pad_tokens == 'auto' else int(args.pad_tokens)
+        model_kwargs['pad_tokens_to'] = pad
+        tags.append(f'pad_tokens={args.pad_tokens}')
+    if args.softmax_dtype:
+        set_softmax_dtype(args.softmax_dtype)
+        tags.append(f'softmax={args.softmax_dtype}')
+    if args.norm_dtype:
+        set_norm_internal_dtype(args.norm_dtype)
+        tags.append(f'norm={args.norm_dtype}')
+    if args.mu_dtype:
+        opt_kwargs['mu_dtype'] = args.mu_dtype
+        tags.append(f'mu={args.mu_dtype}')
+    return model_kwargs, opt_kwargs, (' [' + ', '.join(tags) + ']' if tags else '')
+
+
+def _dry_run(args) -> int:
+    """CPU smoke path for the A/B levers: builds the model with the requested
+    knobs and runs one tiny train + infer step in-process. Exists so every
+    flag combination has a fast correctness gate that needs no TPU
+    (tests/test_precision_policy.py sweeps it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import nnx
+
+    import timm_tpu
+    from timm_tpu.loss import cross_entropy
+    from timm_tpu.optim import create_optimizer_v2
+
+    model_kwargs, opt_kwargs, tag = _apply_precision_knobs(args)
+    img = min(args.img_size, 64)  # tiny input: the gate is "traces + runs", not perf
+    model = timm_tpu.create_model(args.model, img_size=img, **model_kwargs)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(2, img, img, 3), jnp.float32)
+    t = jnp.asarray(rng.randint(0, model.num_classes, 2))
+
+    model.train()
+    opt = create_optimizer_v2(model, opt='adamw', lr=1e-3, weight_decay=0.05, **opt_kwargs)
+    graphdef, params, rest = nnx.split(model, nnx.Param, ...)
+    opt_state = opt.init(params)
+
+    def loss_fn(p):
+        m = nnx.merge(graphdef, p, rest)
+        return cross_entropy(m(x), t)
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+    updates, opt_state = opt.update(grads, opt_state, params, lr=1e-3)
+    params = optax.apply_updates(params, updates)
+    model = nnx.merge(graphdef, params, rest)
+    model.eval()
+    logits = model(x)
+    ok = bool(jnp.isfinite(loss)) and bool(jnp.isfinite(logits).all())
+    print(json.dumps({
+        'metric': f'dry-run {args.model}{tag}: 1 train step + 1 infer step on '
+                  f'{jax.default_backend()}, loss finite={ok}',
+        'value': 1.0 if ok else 0.0, 'unit': 'ok', 'vs_baseline': None}), flush=True)
+    return 0 if ok else 2
+
+
 def _measure(args) -> int:
     """The actual device measurement (runs in the child process)."""
     # The parent enforces the real budget; this is a backstop so a wedged
@@ -296,7 +403,8 @@ def _measure(args) -> int:
     batch_size = args.batch_size or ((128 if args.bench == 'train' else 256) * n_chips)
     K = args.steps
 
-    kwargs = {}
+    model_kwargs, opt_kwargs, knob_tag = _apply_precision_knobs(args)
+    kwargs = dict(model_kwargs)
     if args.img_size != 224:
         kwargs['img_size'] = args.img_size
     model = timm_tpu.create_model(args.model, dtype=jnp.bfloat16, **kwargs)
@@ -310,7 +418,7 @@ def _measure(args) -> int:
 
     if args.bench == 'train':
         model.train()
-        opt = create_optimizer_v2(model, opt='adamw', lr=1e-3, weight_decay=0.05)
+        opt = create_optimizer_v2(model, opt='adamw', lr=1e-3, weight_decay=0.05, **opt_kwargs)
         graphdef, params, rest = nnx.split(model, nnx.Param, ...)
         opt_state = opt.init(params)
 
@@ -378,7 +486,7 @@ def _measure(args) -> int:
     if _WATCHDOG is not None:
         _WATCHDOG.cancel()  # measurement done; disarm watchdog
     baseline = BASELINES.get((args.model, args.bench))
-    metric = f'{args.model} {args.bench} img/s/chip (bf16, bs{batch_size}, {n_chips} chip)'
+    metric = f'{args.model} {args.bench} img/s/chip (bf16, bs{batch_size}, {n_chips} chip){knob_tag}'
     if mfu is not None:
         metric += f', MFU={mfu:.2f}'
     print(json.dumps({

@@ -53,6 +53,9 @@ def test_optimizer_step(opt_name):
 
 @pytest.mark.parametrize('opt_name', ['sgd', 'adamw', 'lamb', 'lion', 'muon', 'nadamw', 'adopt', 'madgrad', 'laprop', 'mars'])
 def test_optimizer_converges(opt_name):
+    from timm_tpu.optim import list_optimizers
+    if opt_name not in list_optimizers():
+        pytest.skip(f'{opt_name} not available in this optax version (registry gates on hasattr)')
     model, x, y = _toy_problem()
     opt = create_optimizer_v2(model, opt=opt_name, lr=5e-2, weight_decay=0.0)
     params = nnx.state(model, nnx.Param)
@@ -150,6 +153,9 @@ def test_coupled_l2_for_wd_less_factories():
 
 def test_adan_three_betas():
     """--opt-betas with 3 values must reach optax.adan's b3 (ADVICE r1 low)."""
+    from timm_tpu.optim import list_optimizers
+    if 'adan' not in list_optimizers():
+        pytest.skip('adan not available in this optax version (registry gates on hasattr)')
     model, x, y = _toy_problem()
     opt = create_optimizer_v2(model, opt='adan', lr=1e-3, betas=(0.9, 0.95, 0.99))
     assert opt.defaults['b3'] == pytest.approx(0.99)

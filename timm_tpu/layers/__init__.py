@@ -5,6 +5,8 @@ from .classifier import ClNormMlpClassifierHead, ClassifierHead, NormMlpClassifi
 from .config import (
     is_exportable, is_scriptable, set_exportable, set_scriptable,
     set_fused_attn, use_fused_attn,
+    norm_internal_dtype, resolve_dtype_arg, set_norm_internal_dtype,
+    set_softmax_dtype, softmax_dtype, softmax_with_policy,
 )
 from .blur_pool import AvgPool2dAA, BlurPool2d, get_aa_layer
 from .cbam import CbamModule, LightCbamModule

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from flax import nnx
 
-from .config import use_fused_attn
+from .config import softmax_with_policy, use_fused_attn
 from .drop import Dropout, dropout_rng_key
 from .weight_init import trunc_normal_, zeros_
 
@@ -53,13 +53,19 @@ def apply_rot_embed_cat(x, emb, half: bool = False):
     return x * cos_emb + rot * sin_emb
 
 
-def _sdpa(q, k, v, attn_mask=None, dropout_p: float = 0.0, key=None, scale: Optional[float] = None):
-    """Scaled dot-product attention on (B, H, N, D) tensors."""
+def _sdpa(q, k, v, attn_mask=None, dropout_p: float = 0.0, key=None, scale: Optional[float] = None,
+          softmax_dtype=None):
+    """Scaled dot-product attention on (B, H, N, D) tensors.
+
+    Softmax internals follow the compute-precision policy (config.py):
+    default is the historical fp32 upcast, bit-identical to the pre-policy
+    code; `softmax_dtype` overrides per call.
+    """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     q = q * scale
     attn = jnp.einsum('bhqd,bhkd->bhqk', q, k)
     attn = maybe_add_mask(attn, attn_mask)
-    attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(q.dtype)
+    attn = softmax_with_policy(attn, axis=-1, dtype=softmax_dtype).astype(q.dtype)
     if dropout_p > 0.0 and key is not None:
         keep = jax.random.bernoulli(key, 1.0 - dropout_p, attn.shape)
         attn = jnp.where(keep, attn / (1.0 - dropout_p), 0.0)
@@ -73,8 +79,10 @@ def scaled_dot_product_attention(
         dropout_key=None,
         scale: Optional[float] = None,
         fused: Optional[bool] = None,
+        softmax_dtype=None,
 ):
-    """Dispatcher over (B, H, N, D) q/k/v. `fused=None` → config default."""
+    """Dispatcher over (B, H, N, D) q/k/v. `fused=None` → config default;
+    `softmax_dtype=None` → config policy (fp32 upcast by default)."""
     fused = use_fused_attn() if fused is None else fused
     if fused and dropout_p == 0.0:
         from ..kernels import flash_attention_supported, flash_attention
@@ -85,17 +93,17 @@ def scaled_dot_product_attention(
         # 867 vs 786 img/s/chip) — the N^2 score matrix is small enough that
         # XLA's fusion of it wins over the generic attention lowering.
         if q.shape[-2] <= 1024:
-            return _sdpa(q, k, v, attn_mask, 0.0, None, scale)
+            return _sdpa(q, k, v, attn_mask, 0.0, None, scale, softmax_dtype)
         # XLA's fused path: expects (B, N, H, D)
         mask = attn_mask
         if mask is not None and mask.dtype != jnp.bool_:
-            return _sdpa(q, k, v, attn_mask, 0.0, None, scale)
+            return _sdpa(q, k, v, attn_mask, 0.0, None, scale, softmax_dtype)
         out = jax.nn.dot_product_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
             mask=mask, scale=scale,
         )
         return out.transpose(0, 2, 1, 3)
-    return _sdpa(q, k, v, attn_mask, dropout_p, dropout_key, scale)
+    return _sdpa(q, k, v, attn_mask, dropout_p, dropout_key, scale, softmax_dtype)
 
 
 class Attention(nnx.Module):
@@ -112,6 +120,7 @@ class Attention(nnx.Module):
             proj_drop: float = 0.0,
             norm_layer: Optional[Callable] = None,
             scale_norm: bool = False,
+            softmax_dtype=None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -124,6 +133,7 @@ class Attention(nnx.Module):
         self.head_dim = dim // num_heads
         self.scale = self.head_dim ** -0.5
         self.attn_drop_rate = attn_drop
+        self.softmax_dtype = softmax_dtype  # per-instance policy override
 
         linear = partial(
             nnx.Linear, dtype=dtype, param_dtype=param_dtype,
@@ -155,6 +165,7 @@ class Attention(nnx.Module):
         dropout_key = dropout_rng_key(self.attn_drop) if dropout_p > 0.0 else None
         x = scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale,
+            softmax_dtype=self.softmax_dtype,
         )
         x = x.transpose(0, 2, 1, 3).reshape(B, N, C)
         if self.norm is not None:
@@ -185,6 +196,7 @@ class AttentionRope(nnx.Module):
             scale_norm: bool = False,
             proj_bias: bool = True,
             rotate_half: bool = False,
+            softmax_dtype=None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -204,6 +216,7 @@ class AttentionRope(nnx.Module):
         self.num_prefix_tokens = num_prefix_tokens
         self.rotate_half = rotate_half
         self.attn_drop_rate = attn_drop
+        self.softmax_dtype = softmax_dtype  # per-instance policy override
 
         linear = partial(
             nnx.Linear, dtype=dtype, param_dtype=param_dtype,
@@ -255,6 +268,7 @@ class AttentionRope(nnx.Module):
         dropout_key = dropout_rng_key(self.attn_drop) if dropout_p > 0.0 else None
         x = scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale,
+            softmax_dtype=self.softmax_dtype,
         )
         x = x.transpose(0, 2, 1, 3).reshape(B, N, self.attn_dim)
         if self.norm is not None:

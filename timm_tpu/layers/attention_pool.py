@@ -83,7 +83,10 @@ class AttentionPoolLatent(nnx.Module):
         self.mlp = Mlp(out_features, int(out_features * mlp_ratio), act_layer=act_layer,
                        dtype=dtype, param_dtype=param_dtype, rngs=rngs)
 
-    def __call__(self, x):
+    def __call__(self, x, attn_mask=None):
+        """`attn_mask` is an optional key-padding mask over the N input tokens
+        (bool, True = valid; (B, N) or (B, 1, 1, N)) so the latent query can
+        pool a tile-padded sequence without attending to pad tokens."""
         B, N, C = x.shape
         if self.pos_embed is not None:
             x = x + self.pos_embed[...].astype(x.dtype)[None]
@@ -96,7 +99,9 @@ class AttentionPoolLatent(nnx.Module):
             q = self.q_norm(q)
         if self.k_norm is not None:
             k = self.k_norm(k)
-        x = scaled_dot_product_attention(q, k, v, scale=self.scale)
+        if attn_mask is not None and attn_mask.ndim == 2:
+            attn_mask = attn_mask[:, None, None, :]  # (B, N) → (B, 1, 1, N)
+        x = scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, scale=self.scale)
         x = x.transpose(0, 2, 1, 3).reshape(B, self.latent_len, -1)
         x = self.proj(x)
         x = self.proj_drop(x)

@@ -22,7 +22,8 @@ class BlurPool2d(nnx.Module):
         blur_1d = np.asarray(coeffs.coeffs, np.float32)
         blur_2d = blur_1d[:, None] * blur_1d[None, :]
         # HWIO depthwise kernel: (H, W, 1, C) with feature_group_count=C
-        self._kernel = jnp.asarray(np.tile(blur_2d[:, :, None, None], (1, 1, 1, channels)))
+        # nnx.Variable: raw array attrs break nnx graph traversal on older flax
+        self._kernel = nnx.Variable(jnp.asarray(np.tile(blur_2d[:, :, None, None], (1, 1, 1, channels))))
         self.filt_size = filt_size
 
     def __call__(self, x):
@@ -30,7 +31,7 @@ class BlurPool2d(nnx.Module):
         pad_cfg = [(0, 0), (pad, self.filt_size - 1 - pad), (pad, self.filt_size - 1 - pad), (0, 0)]
         x = jnp.pad(x, pad_cfg, mode=self.pad_mode)
         return jax.lax.conv_general_dilated(
-            x, self._kernel.astype(x.dtype),
+            x, self._kernel[...].astype(x.dtype),
             window_strides=(self.stride, self.stride),
             padding='VALID',
             dimension_numbers=('NHWC', 'HWIO', 'NHWC'),

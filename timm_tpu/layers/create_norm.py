@@ -1,4 +1,11 @@
-"""Norm factory (reference: timm/layers/create_norm.py)."""
+"""Norm factory (reference: timm/layers/create_norm.py).
+
+All LayerNorm/RmsNorm/SimpleNorm variants created here honour the
+compute-precision policy (`config.norm_internal_dtype()` / the
+`TIMM_TPU_NORM_DTYPE` env var) for their statistics dtype; pass
+`internal_dtype=` through `create_norm_layer` to pin one instance
+(LayerNormFp32 is permanently pinned to fp32).
+"""
 from __future__ import annotations
 
 import functools

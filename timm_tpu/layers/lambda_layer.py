@@ -79,7 +79,8 @@ class LambdaLayer(nnx.Module):
             self.pos_emb = nnx.Param(
                 jax.random.truncated_normal(
                     rngs.params(), -2, 2, (rel_size[0], rel_size[1], self.dim_qk), param_dtype) * 0.02)
-            self._rel_pos_indices = jnp.asarray(_rel_pos_indices(feat_size))
+            # nnx.Variable: raw array attrs break nnx graph traversal on older flax
+            self._rel_pos_indices = nnx.Variable(jnp.asarray(_rel_pos_indices(feat_size)))
 
     def __call__(self, x):
         B, H, W, C = x.shape
@@ -100,7 +101,8 @@ class LambdaLayer(nnx.Module):
             pl = self.conv_lambda(vs)  # (B*V, H, W, K)
             position_lam = pl.reshape(B, self.dim_v, M, self.dim_qk).transpose(0, 2, 3, 1)  # B, M, K, V
         else:
-            pos = self.pos_emb[...][self._rel_pos_indices[0], self._rel_pos_indices[1]]  # (M, M, K)
+            idx = self._rel_pos_indices[...]
+            pos = self.pos_emb[...][idx[0], idx[1]]  # (M, M, K)
             position_lam = jnp.einsum('mnk,bnv->bmkv', pos.astype(v.dtype), v)
         position_out = jnp.einsum('bhmk,bmkv->bhmv', q, position_lam)
 

@@ -5,6 +5,13 @@ last axis and every '2d' variant is the same computation as its 1d cousin —
 no permutes, no special cases. XLA fuses these for free, which subsumes the
 reference's fast_norm/APEX machinery.
 
+Compute-precision policy: LayerNorm / RmsNorm / SimpleNorm consult
+`config.norm_internal_dtype()` (or a per-instance `internal_dtype` override).
+When unset (the default) the framework path runs untouched — bit-identical to
+the pre-policy code. When set (e.g. bf16), statistics are computed in that
+dtype, removing the fp32 upcast of ~25 LayerNorms on the ViT hot path
+(PERF.md §2 item 2); the output dtype is unchanged either way.
+
 Frameworks note: these subclass flax.nnx norm modules but expose the
 reference's constructor conventions (`eps`, `affine`, positional num_channels).
 """
@@ -14,20 +21,69 @@ import jax
 import jax.numpy as jnp
 from flax import nnx
 
+from .config import norm_internal_dtype, resolve_dtype_arg
+
 __all__ = [
     'LayerNorm', 'LayerNorm2d', 'LayerNormFp32', 'RmsNorm', 'RmsNorm2d',
     'SimpleNorm', 'SimpleNorm2d', 'GroupNorm', 'GroupNorm1', 'BatchNorm2d',
 ]
 
 
+def _param_value(p):
+    # affine=False is Param(None) on older flax, plain None on newer
+    if p is None or p.value is None:
+        return None
+    return p[...]
+
+
+def _resolve_internal(instance_dtype):
+    """Per-instance override wins; else the process policy. fp32 (or None)
+    means 'take the framework path' — flax already computes stats in fp32,
+    so only a reduced dtype needs the custom trace."""
+    dt = instance_dtype if instance_dtype is not None else norm_internal_dtype()
+    if dt is None or dt == jnp.float32:
+        return None
+    return dt
+
+
+def _layernorm_fast(x, scale, bias, eps, dt):
+    """LayerNorm with stats in `dt` (flax fast-variance semantics:
+    var = E[x²] − E[x]², clamped at 0). Output keeps x.dtype."""
+    xf = x.astype(dt)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.maximum(jnp.mean(xf * xf, axis=-1, keepdims=True) - mean * mean, 0.0)
+    y = (xf - mean) * jax.lax.rsqrt(var + jnp.asarray(eps, dt))
+    if scale is not None:
+        y = y * scale.astype(dt)
+    if bias is not None:
+        y = y + bias.astype(dt)
+    return y.astype(x.dtype)
+
+
+def _rmsnorm_fast(x, scale, eps, dt):
+    """RMSNorm with the mean-square reduction in `dt`."""
+    xf = x.astype(dt)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(ms + jnp.asarray(eps, dt))
+    if scale is not None:
+        y = y * scale.astype(dt)
+    return y.astype(x.dtype)
+
+
 class LayerNorm(nnx.LayerNorm):
-    """LayerNorm over the channel (last) axis."""
+    """LayerNorm over the channel (last) axis.
+
+    `internal_dtype` pins this instance's statistics dtype regardless of the
+    process policy ('float32' = always the framework fp32 path); None defers
+    to `config.norm_internal_dtype()`.
+    """
 
     def __init__(
             self,
             num_channels: int,
             eps: float = 1e-6,
             affine: bool = True,
+            internal_dtype=None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -42,6 +98,13 @@ class LayerNorm(nnx.LayerNorm):
             param_dtype=param_dtype,
             rngs=rngs,
         )
+        self.internal_dtype = resolve_dtype_arg(internal_dtype)
+
+    def __call__(self, x):
+        dt = _resolve_internal(getattr(self, 'internal_dtype', None))
+        if dt is None:
+            return super().__call__(x)
+        return _layernorm_fast(x, _param_value(self.scale), _param_value(self.bias), self.epsilon, dt)
 
 
 # NHWC: channels are already last, identical computation.
@@ -49,18 +112,24 @@ LayerNorm2d = LayerNorm
 
 
 class LayerNormFp32(LayerNorm):
-    """LayerNorm forced to fp32 statistics (reference norm.py LayerNormFp32)."""
+    """LayerNorm forced to fp32 statistics (reference norm.py LayerNormFp32).
+    Pinned: the precision policy never downgrades this variant."""
 
     def __init__(self, num_channels, eps: float = 1e-6, affine: bool = True, *, rngs: nnx.Rngs, **kw):
-        super().__init__(num_channels, eps=eps, affine=affine, dtype=jnp.float32, rngs=rngs)
+        super().__init__(
+            num_channels, eps=eps, affine=affine, internal_dtype=jnp.float32,
+            dtype=jnp.float32, rngs=rngs)
 
 
 class RmsNorm(nnx.RMSNorm):
+    """RMSNorm over the channel axis; `internal_dtype` as in LayerNorm."""
+
     def __init__(
             self,
             num_channels: int,
             eps: float = 1e-6,
             affine: bool = True,
+            internal_dtype=None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -74,6 +143,13 @@ class RmsNorm(nnx.RMSNorm):
             param_dtype=param_dtype,
             rngs=rngs,
         )
+        self.internal_dtype = resolve_dtype_arg(internal_dtype)
+
+    def __call__(self, x):
+        dt = _resolve_internal(getattr(self, 'internal_dtype', None))
+        if dt is None:
+            return super().__call__(x)
+        return _rmsnorm_fast(x, _param_value(self.scale), self.epsilon, dt)
 
 
 RmsNorm2d = RmsNorm
@@ -90,6 +166,7 @@ class SimpleNorm(nnx.Module):
             num_channels: int,
             eps: float = 1e-6,
             affine: bool = True,
+            internal_dtype=None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -97,14 +174,16 @@ class SimpleNorm(nnx.Module):
     ):
         self.eps = eps
         self.scale = nnx.Param(jnp.ones((num_channels,), param_dtype)) if affine else None
+        self.internal_dtype = resolve_dtype_arg(internal_dtype)
 
     def __call__(self, x):
         dtype = x.dtype
-        xf = x.astype(jnp.float32)
+        dt = _resolve_internal(getattr(self, 'internal_dtype', None)) or jnp.float32
+        xf = x.astype(dt)
         v = jnp.var(xf, axis=-1, keepdims=True, ddof=1)
-        xf = xf * jax.lax.rsqrt(v + self.eps)
+        xf = xf * jax.lax.rsqrt(v + jnp.asarray(self.eps, dt))
         if self.scale is not None:
-            xf = xf * self.scale[...].astype(jnp.float32)
+            xf = xf * self.scale[...].astype(dt)
         return xf.astype(dtype)
 
 

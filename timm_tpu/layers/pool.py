@@ -73,20 +73,49 @@ def global_pool_nlc(
         pool_type: str = 'token',
         num_prefix_tokens: int = 1,
         reduce_include_prefix: bool = False,
+        mask=None,
 ):
-    """Pool (B, N, C) tokens → (B, C). Mirrors reference pool1d.py:global_pool_nlc."""
+    """Pool (B, N, C) tokens → (B, C). Mirrors reference pool1d.py:global_pool_nlc.
+
+    `mask` is an optional key-padding mask, True = valid token, broadcastable
+    to (B, N) (e.g. (N,), (B, N) or (B, 1, 1, N)): reductions then ignore
+    padded tokens (masked mean divides by the valid count; masked max fills
+    pads with -inf). Used by the tile-aligned token-padding path when pooling
+    runs on a still-padded sequence; `mask=None` is the exact legacy path.
+    """
     if not pool_type:
         return x
     if pool_type == 'token':
         return x[:, 0]
+    if mask is not None:
+        mask = jnp.reshape(mask, (mask.shape[0] if mask.ndim > 1 else 1, -1))  # (B|1, N)
     if not reduce_include_prefix:
         x = x[:, num_prefix_tokens:]
+        if mask is not None:
+            mask = mask[:, num_prefix_tokens:]
+    if mask is None:
+        if pool_type == 'avg':
+            return x.mean(axis=1)
+        if pool_type == 'max':
+            return x.max(axis=1)
+        if pool_type == 'avgmax':
+            return 0.5 * (x.max(axis=1) + x.mean(axis=1))
+        raise ValueError(f'Unknown pool type {pool_type}')
+    m = mask[..., None]  # (B|1, N, 1)
+    count = jnp.maximum(m.sum(axis=1), 1).astype(x.dtype)
+
+    def _masked_avg():
+        return jnp.where(m, x, 0).sum(axis=1) / count
+
+    def _masked_max():
+        return jnp.where(m, x, jnp.asarray(-jnp.inf, x.dtype)).max(axis=1)
+
     if pool_type == 'avg':
-        return x.mean(axis=1)
+        return _masked_avg()
     if pool_type == 'max':
-        return x.max(axis=1)
+        return _masked_max()
     if pool_type == 'avgmax':
-        return 0.5 * (x.max(axis=1) + x.mean(axis=1))
+        return 0.5 * (_masked_max() + _masked_avg())
     raise ValueError(f'Unknown pool type {pool_type}')
 
 

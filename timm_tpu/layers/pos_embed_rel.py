@@ -93,11 +93,12 @@ class RelPosBias(nnx.Module):
         num_rel_dist = (2 * window_size[0] - 1) * (2 * window_size[1] - 1) + 3 * prefix_tokens
         self.relative_position_bias_table = nnx.Param(
             trunc_normal_(std=0.02)(rngs.params(), (num_rel_dist, num_heads), param_dtype))
-        self._index = jnp.asarray(gen_relative_position_index(
-            window_size, class_token=prefix_tokens > 0).reshape(-1))
+        # nnx.Variable: raw array attrs break nnx graph traversal on older flax
+        self._index = nnx.Variable(jnp.asarray(gen_relative_position_index(
+            window_size, class_token=prefix_tokens > 0).reshape(-1)))
 
     def get_bias(self) -> jax.Array:
-        bias = self.relative_position_bias_table[...][self._index]
+        bias = self.relative_position_bias_table[...][self._index[...]]
         bias = bias.reshape(self.bias_shape).transpose(2, 0, 1)  # (H, N, N)
         return bias[None]
 
@@ -131,14 +132,14 @@ class RelPosBiasTf(nnx.Module):
             jax.random.normal(rngs.params(), self.bias_shape, param_dtype) * 0.02)
         idx_h = np.arange(h)[:, None] - np.arange(h)[None, :] + (h - 1)  # (qh, kh)
         idx_w = np.arange(w)[:, None] - np.arange(w)[None, :] + (w - 1)  # (qw, kw)
-        self._idx_h = jnp.asarray(idx_h)
-        self._idx_w = jnp.asarray(idx_w)
+        self._idx_h = nnx.Variable(jnp.asarray(idx_h))
+        self._idx_w = nnx.Variable(jnp.asarray(idx_w))
 
     def get_bias(self) -> jax.Array:
         h, w = self.window_size
         table = self.relative_position_bias_table[...]
-        bias = table[:, self._idx_h]            # (nh, qh, kh, 2w-1)
-        bias = bias[..., self._idx_w]           # (nh, qh, kh, qw, kw)
+        bias = table[:, self._idx_h[...]]            # (nh, qh, kh, 2w-1)
+        bias = bias[..., self._idx_w[...]]           # (nh, qh, kh, qw, kw)
         bias = bias.transpose(0, 1, 3, 2, 4)    # (nh, qh, qw, kh, kw)
         bias = bias.reshape(self.num_heads, self.window_area, self.window_area)
         return bias[None]
@@ -206,13 +207,14 @@ class RelPosMlp(nnx.Module):
         self.mlp = Mlp(
             2, hidden_features=hidden_dim, out_features=num_heads, act_layer='relu',
             bias=mlp_bias, drop=(0.125, 0.0), dtype=dtype, param_dtype=param_dtype, rngs=rngs)
-        self._index = jnp.asarray(gen_relative_position_index(window_size).reshape(-1))
-        self._log_coords = jnp.asarray(gen_relative_log_coords(
-            window_size, pretrained_window_size, mode=mode))
+        # nnx.Variable: raw array attrs break nnx graph traversal on older flax
+        self._index = nnx.Variable(jnp.asarray(gen_relative_position_index(window_size).reshape(-1)))
+        self._log_coords = nnx.Variable(jnp.asarray(gen_relative_log_coords(
+            window_size, pretrained_window_size, mode=mode)))
 
     def get_bias(self) -> jax.Array:
-        bias = self.mlp(self._log_coords)  # (2h-1, 2w-1, heads)
-        bias = bias.reshape(-1, self.num_heads)[self._index]
+        bias = self.mlp(self._log_coords[...])  # (2h-1, 2w-1, heads)
+        bias = bias.reshape(-1, self.num_heads)[self._index[...]]
         bias = bias.reshape(self.bias_shape).transpose(2, 0, 1)
         if self.bias_act == 'sigmoid':
             bias = jax.nn.sigmoid(bias)

@@ -25,6 +25,13 @@ from .weight_init import variance_scaling_, zeros_
 __all__ = ['StdConv2d', 'ScaledStdConv2d', 'ScaledStdConv2dSame']
 
 
+def _bias_value(bias):
+    # use_bias=False is Param(None) on older flax, plain None on newer
+    if bias is None or bias.value is None:
+        return None
+    return bias[...]
+
+
 def _conv_nhwc(x, kernel, bias, strides, padding, dilation, groups):
     out = jax.lax.conv_general_dilated(
         x, kernel.astype(x.dtype),
@@ -62,7 +69,7 @@ class StdConv2d(nnx.Conv):
 
     def __call__(self, x):
         return _conv_nhwc(
-            x, self._std_kernel(), self.bias[...] if self.bias is not None else None,
+            x, self._std_kernel(), _bias_value(self.bias),
             self.strides, self.padding, self.kernel_dilation, self.feature_group_count)
 
 
@@ -92,7 +99,7 @@ class ScaledStdConv2d(nnx.Conv):
         var = w.var(axis=axes, keepdims=True)
         w_std = (self.scale * self.gain[...]).astype(w.dtype) * (w - mean) / jnp.sqrt(var + self.eps)
         return _conv_nhwc(
-            x, w_std, self.bias[...] if self.bias is not None else None,
+            x, w_std, _bias_value(self.bias),
             self.strides, self.padding, self.kernel_dilation, self.feature_group_count)
 
 

@@ -69,7 +69,7 @@ class VisionTransformerDistilled(VisionTransformer):
     def set_distilled_training(self, enable: bool = True):
         self.distilled_training = enable
 
-    def _pos_embed(self, x, grid_size=None):
+    def _pos_embed(self, x, grid_size=None, pad_tokens_to=None):
         B = x.shape[0]
         pos_embed = self.pos_embed[...].astype(x.dtype) if self.pos_embed is not None else None
         to_cat = [
@@ -84,7 +84,7 @@ class VisionTransformerDistilled(VisionTransformer):
             x = jnp.concatenate(to_cat + [x], axis=1)
             if pos_embed is not None:
                 x = x + pos_embed
-        return self.pos_drop(x)
+        return self._pad_token_seq(self.pos_drop(x), pad_tokens_to)
 
     def forward_head(self, x, pre_logits: bool = False):
         x_cls, x_dist = x[:, 0], x[:, 1]

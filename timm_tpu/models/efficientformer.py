@@ -65,13 +65,14 @@ class EfficientFormerAttention(nnx.Module):
         resolution = to_2tuple(resolution)
         self.attention_biases = nnx.Param(
             jnp.zeros((num_heads, resolution[0] * resolution[1]), param_dtype))
-        self._bias_idxs = jnp.asarray(_attention_bias_idxs(resolution))
+        # nnx.Variable: raw array attrs break nnx graph traversal on older flax
+        self._bias_idxs = nnx.Variable(jnp.asarray(_attention_bias_idxs(resolution)))
 
     def __call__(self, x):
         B, N, C = x.shape
         qkv = self.qkv(x).reshape(B, N, self.num_heads, -1).transpose(0, 2, 1, 3)
         q, k, v = jnp.split(qkv, [self.key_dim, 2 * self.key_dim], axis=3)
-        bias = self.attention_biases[...][:, self._bias_idxs].astype(q.dtype)  # (H, N, N)
+        bias = self.attention_biases[...][:, self._bias_idxs[...]].astype(q.dtype)  # (H, N, N)
         attn = (q @ k.transpose(0, 1, 3, 2)) * self.scale + bias
         attn = jax.nn.softmax(attn, axis=-1)
         x = (attn @ v).transpose(0, 2, 1, 3).reshape(B, N, self.val_attn_dim)

@@ -133,7 +133,8 @@ class Attention2d(nnx.Module):
         self.proj = ConvNorm(self.dh, dim, 1, **kw)
 
         self.attention_biases = nnx.Param(jnp.zeros((num_heads, self.N), param_dtype))
-        self._bias_idxs = jnp.asarray(_attention_bias_idxs(resolution))
+        # nnx.Variable: raw array attrs break nnx graph traversal on older flax
+        self._bias_idxs = nnx.Variable(jnp.asarray(_attention_bias_idxs(resolution)))
 
     def __call__(self, x):
         B, H0, W0, C = x.shape
@@ -149,7 +150,7 @@ class Attention2d(nnx.Module):
         v = v_map.reshape(B, N, self.num_heads, self.d)
 
         attn = jnp.einsum('bnhd,bmhd->bnmh', q, k) * self.scale
-        bias = self.attention_biases[...][:, self._bias_idxs].transpose(1, 2, 0)  # (N, N, H)
+        bias = self.attention_biases[...][:, self._bias_idxs[...]].transpose(1, 2, 0)  # (N, N, H)
         attn = attn + bias.astype(attn.dtype)
         attn = self.talking_head1(attn)
         attn = jax.nn.softmax(attn, axis=2)
@@ -211,7 +212,7 @@ class Attention2dDownsample(nnx.Module):
         self.proj = ConvNorm(self.dh, self.out_dim, 1, **kw)
 
         self.attention_biases = nnx.Param(jnp.zeros((num_heads, self.N), param_dtype))
-        self._bias_idxs = jnp.asarray(_attention_bias_idxs(self.resolution, stride=2))  # (N2, N)
+        self._bias_idxs = nnx.Variable(jnp.asarray(_attention_bias_idxs(self.resolution, stride=2)))  # (N2, N)
 
     def __call__(self, x):
         B, H, W, C = x.shape
@@ -222,7 +223,7 @@ class Attention2dDownsample(nnx.Module):
         v = v_map.reshape(B, self.N, self.num_heads, self.d)
 
         attn = jnp.einsum('bnhd,bmhd->bhnm', q, k) * self.scale
-        bias = self.attention_biases[...][:, self._bias_idxs]  # (H, N2, N)
+        bias = self.attention_biases[...][:, self._bias_idxs[...]]  # (H, N2, N)
         attn = jax.nn.softmax(attn + bias.astype(attn.dtype), axis=-1)
 
         x = jnp.einsum('bhnm,bmhd->bnhd', attn, v).reshape(

@@ -145,10 +145,11 @@ class LevitAttention(nnx.Module):
 
         N = resolution[0] * resolution[1]
         self.attention_biases = nnx.Param(jnp.zeros((num_heads, N), param_dtype))
-        self._bias_idxs = jnp.asarray(_attention_bias_idxs(resolution))
+        # nnx.Variable: raw array attrs break nnx graph traversal on older flax
+        self._bias_idxs = nnx.Variable(jnp.asarray(_attention_bias_idxs(resolution)))
 
     def _bias(self):
-        return self.attention_biases[...][:, self._bias_idxs]  # (H, N, N)
+        return self.attention_biases[...][:, self._bias_idxs[...]]  # (H, N, N)
 
     def __call__(self, x):
         B, N, C = x.shape
@@ -187,10 +188,10 @@ class LevitAttentionDownsample(nnx.Module):
 
         N_k = resolution[0] * resolution[1]
         self.attention_biases = nnx.Param(jnp.zeros((num_heads, N_k), param_dtype))
-        self._bias_idxs = jnp.asarray(_attention_bias_idxs(resolution, stride=stride))
+        self._bias_idxs = nnx.Variable(jnp.asarray(_attention_bias_idxs(resolution, stride=stride)))
 
     def _bias(self):
-        return self.attention_biases[...][:, self._bias_idxs]  # (H, N_q, N_k)
+        return self.attention_biases[...][:, self._bias_idxs[...]]  # (H, N_q, N_k)
 
     def __call__(self, x):
         B, N, C = x.shape

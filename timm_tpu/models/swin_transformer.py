@@ -106,7 +106,10 @@ class WindowAttention(nnx.Module):
         self.relative_position_bias_table = nnx.Param(
             trunc_normal_(std=0.02)(
                 rngs.params(), ((2 * win_h - 1) * (2 * win_w - 1), num_heads), param_dtype))
-        self._rel_index = jnp.asarray(_relative_position_index(win_h, win_w))
+        # nnx.Variable: a raw array attribute breaks nnx graph traversal on
+        # older flax (split/state reject array leaves); a Variable is
+        # traversal-safe on every version and stays out of the Param state
+        self._rel_index = nnx.Variable(jnp.asarray(_relative_position_index(win_h, win_w)))
 
         linear = partial(
             nnx.Linear, dtype=dtype, param_dtype=param_dtype,
@@ -118,7 +121,7 @@ class WindowAttention(nnx.Module):
 
     def _bias(self, dtype):
         table = self.relative_position_bias_table[...]
-        bias = table[self._rel_index.reshape(-1)]
+        bias = table[self._rel_index[...].reshape(-1)]
         bias = bias.reshape(self.window_area, self.window_area, -1).transpose(2, 0, 1)
         return bias[None].astype(dtype)  # (1, H, N, N)
 
@@ -188,7 +191,7 @@ class SwinTransformerBlock(nnx.Module):
 
         if any(self.shift_size):
             H, W = input_resolution
-            self._attn_mask = jnp.asarray(_shift_attn_mask(H, W, ws, ss))
+            self._attn_mask = nnx.Variable(jnp.asarray(_shift_attn_mask(H, W, ws, ss)))
         else:
             self._attn_mask = None
 
@@ -214,7 +217,7 @@ class SwinTransformerBlock(nnx.Module):
         if sh or sw:
             x = jnp.roll(x, shift=(-sh, -sw), axis=(1, 2))
         xw = window_partition(x, self.window_size)
-        xw = self.attn(xw, mask=self._attn_mask)
+        xw = self.attn(xw, mask=None if self._attn_mask is None else self._attn_mask[...])
         x = window_reverse(xw, self.window_size, H, W)
         if sh or sw:
             x = jnp.roll(x, shift=(sh, sw), axis=(1, 2))

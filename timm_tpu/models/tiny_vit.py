@@ -145,11 +145,12 @@ class TinyVitAttention(nnx.Module):
 
         num_offsets = self.resolution[0] * self.resolution[1]
         self.attention_biases = nnx.Param(jnp.zeros((num_heads, num_offsets), param_dtype))
-        self._bias_idxs = jnp.asarray(_attention_bias_idxs(self.resolution))
+        # nnx.Variable: raw array attrs break nnx graph traversal on older flax
+        self._bias_idxs = nnx.Variable(jnp.asarray(_attention_bias_idxs(self.resolution)))
 
     def __call__(self, x):
         B, N, _ = x.shape
-        bias = self.attention_biases[...][:, self._bias_idxs]  # (H, N, N)
+        bias = self.attention_biases[...][:, self._bias_idxs[...]]  # (H, N, N)
         x = self.norm(x)
         qkv = self.qkv(x).reshape(B, N, self.num_heads, -1)
         q, k, v = jnp.split(qkv, [self.key_dim, 2 * self.key_dim], axis=3)

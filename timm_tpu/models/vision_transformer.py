@@ -510,6 +510,7 @@ class VisionTransformer(nnx.Module):
             block_fn: Callable = Block,
             mlp_layer: Callable = Mlp,
             attn_layer: Optional[Union[str, Callable]] = None,
+            pad_tokens_to: Optional[Union[int, str]] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -520,6 +521,25 @@ class VisionTransformer(nnx.Module):
         assert pos_embed in ('', 'none', 'learn')
         norm_layer = get_norm_layer(norm_layer) or LayerNorm
         act_layer = act_layer or 'gelu'
+
+        # TPU tile alignment: pad the token sequence once at embed time so the
+        # (B·H, N, N) attention matmuls and softmax land on lane/sublane tile
+        # boundaries (PERF.md §2 item 1: N=197 wastes up to ~23% of MXU issue
+        # on ~28% of ViT FLOPs). 'auto' rounds up to the next sublane multiple
+        # (197 → 200); an int pads to exactly that count (e.g. 256 for a full
+        # lane tile). Pad keys are excluded via a key-padding mask threaded
+        # through every block, and the pad is stripped again before
+        # forward_head, so outputs match the unpadded model to fp precision.
+        # None (default) traces the exact pre-padding graph.
+        if pad_tokens_to is not None and pad_tokens_to != 'auto':
+            pad_tokens_to = int(pad_tokens_to)
+            if pad_tokens_to == 0:
+                pad_tokens_to = None
+        if pad_tokens_to is not None and patch_drop_rate > 0:
+            raise ValueError(
+                'pad_tokens_to is incompatible with patch_drop_rate > 0: '
+                'PatchDropout re-indexes the token sequence, invalidating the pad mask')
+        self.pad_tokens_to = pad_tokens_to
 
         self.num_classes = num_classes
         self.global_pool = global_pool
@@ -703,7 +723,25 @@ class VisionTransformer(nnx.Module):
             ))
 
     # ---- forward ----------------------------------------------------------
-    def _pos_embed(self, x, grid_size: Optional[Tuple[int, int]] = None):
+    def _resolve_pad_len(self, n: int, pad_tokens_to=None) -> int:
+        """Padded sequence length for an n-token sequence (== n when the
+        padding knob is off or n is already aligned)."""
+        pad = pad_tokens_to if pad_tokens_to is not None else self.pad_tokens_to
+        if not pad:
+            return n
+        if pad == 'auto':
+            return -(-n // 8) * 8  # next sublane multiple: 197 → 200
+        target = int(pad)
+        if target < n:
+            raise ValueError(f'pad_tokens_to={target} is smaller than the token count {n}')
+        return target
+
+    def _pos_embed(self, x, grid_size: Optional[Tuple[int, int]] = None, pad_tokens_to=None):
+        """Prefix-token concat + position embedding, then (optionally) the
+        tile-alignment pad. `pad_tokens_to` overrides the constructor knob for
+        this call (0 disables). Returns (tokens, key_padding_mask, orig_len);
+        the mask is None and orig_len == tokens.shape[1] when no pad was added.
+        """
         B = x.shape[0]
         if self.pos_embed is None:
             pos_embed = None
@@ -733,14 +771,33 @@ class VisionTransformer(nnx.Module):
                 x = jnp.concatenate(to_cat + [x], axis=1)
             if pos_embed is not None:
                 x = x + pos_embed
-        return self.pos_drop(x)
+        x = self.pos_drop(x)
+        return self._pad_token_seq(x, pad_tokens_to)
+
+    def _pad_token_seq(self, x, pad_tokens_to=None):
+        """Apply the tile-alignment pad to (B, N, C) tokens.
+        Returns (tokens, key_padding_mask, orig_len); mask is None when no
+        pad was added."""
+        B, n = x.shape[0], x.shape[1]
+        n_pad = self._resolve_pad_len(n, pad_tokens_to)
+        if n_pad == n:
+            return x, None, n
+        x = jnp.pad(x, ((0, 0), (0, n_pad - n), (0, 0)))
+        # key-padding mask, True = real token, broadcast over heads/queries
+        mask = jnp.broadcast_to((jnp.arange(n_pad) < n)[None, None, None, :], (B, 1, 1, n_pad))
+        return x, mask, n
 
     def forward_features(self, x, attn_mask=None):
         grid_size = None
         if self.dynamic_img_size:
             grid_size = self.patch_embed.dynamic_feat_size(x.shape[1:3])
         x = self.patch_embed(x)
-        x = self._pos_embed(x, grid_size=grid_size)
+        # an externally supplied attn_mask is sized for the UNPADDED sequence,
+        # so the alignment pad is skipped for that call
+        x, pad_mask, orig_len = self._pos_embed(
+            x, grid_size=grid_size, pad_tokens_to=0 if attn_mask is not None else None)
+        if pad_mask is not None:
+            attn_mask = pad_mask
         if self.patch_drop is not None:
             x = self.patch_drop(x)
         if self.norm_pre is not None:
@@ -752,13 +809,18 @@ class VisionTransformer(nnx.Module):
                 x = blk(x, attn_mask=attn_mask)
         if self.norm is not None:
             x = self.norm(x)
+        if x.shape[1] != orig_len:
+            x = x[:, :orig_len]  # strip the alignment pad before the head
         return x
 
-    def pool(self, x, pool_type: Optional[str] = None):
+    def pool(self, x, pool_type: Optional[str] = None, mask=None):
+        """`mask` (optional key-padding mask, True = valid) supports pooling a
+        still-padded token sequence; the standard forward path strips the
+        alignment pad before the head, so it passes None."""
         if self.attn_pool is not None:
-            return self.attn_pool(x)
+            return self.attn_pool(x, attn_mask=mask)
         pool_type = self.global_pool if pool_type is None else pool_type
-        return global_pool_nlc(x, pool_type=pool_type, num_prefix_tokens=self.num_prefix_tokens)
+        return global_pool_nlc(x, pool_type=pool_type, num_prefix_tokens=self.num_prefix_tokens, mask=mask)
 
     def forward_head(self, x, pre_logits: bool = False):
         x = self.pool(x)
@@ -795,7 +857,8 @@ class VisionTransformer(nnx.Module):
         grid_size = self.patch_embed.dynamic_feat_size((H, W)) if self.dynamic_img_size \
             else self.patch_embed.grid_size
         x = self.patch_embed(x)
-        x = self._pos_embed(x, grid_size=grid_size if self.dynamic_img_size else None)
+        # no alignment pad here: intermediates are reshaped to spatial grids
+        x, _, _ = self._pos_embed(x, grid_size=grid_size if self.dynamic_img_size else None, pad_tokens_to=0)
         if self.patch_drop is not None:
             x = self.patch_drop(x)
         if self.norm_pre is not None:

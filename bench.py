@@ -243,6 +243,11 @@ def main():
                         help='in-process CPU smoke: build the model + one tiny train/infer '
                              'step with the requested levers, print a result line, exit. '
                              'No probe, no child, no TPU.')
+    parser.add_argument('--fault-inject', default='', metavar='SPEC',
+                        help='(with --dry-run) also run the resilience fault-injection '
+                             'selftest: truncated-checkpoint fallback, reader retry/backoff, '
+                             'poison-skip budget, @-step faults. SPEC is parse-checked; the '
+                             'canonical drill set always runs (tier-1 smoke, no TPU).')
     parser.add_argument('--child', action='store_true',
                         help='internal: run the measurement in this process')
     parser.add_argument('--watchdog-s', type=int, default=None,
@@ -370,9 +375,19 @@ def _dry_run(args) -> int:
     model.eval()
     logits = model(x)
     ok = bool(jnp.isfinite(loss)) and bool(jnp.isfinite(logits).all())
+    fault_note = ''
+    if getattr(args, 'fault_inject', ''):
+        # exercise the injection hooks + their recovery paths without a slow
+        # run: truncate→fallback, io_error→retry, poison budget, @-faults
+        from timm_tpu.resilience import fault_selftest
+        drill = fault_selftest(getattr(args, 'fault_inject', ''))
+        ok = ok and drill['ok']
+        failed = [k for k, v in drill['checks'].items() if not v]
+        fault_note = (f', fault-inject drills {"all passed" if drill["ok"] else f"FAILED: {failed}"}'
+                      f' ({len(drill["checks"])} checks)')
     print(json.dumps({
         'metric': f'dry-run {args.model}{tag}: 1 train step + 1 infer step on '
-                  f'{jax.default_backend()}, loss finite={ok}',
+                  f'{jax.default_backend()}, loss finite={ok}{fault_note}',
         'value': 1.0 if ok else 0.0, 'unit': 'ok', 'vs_baseline': None}), flush=True)
     return 0 if ok else 2
 

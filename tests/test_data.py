@@ -196,3 +196,21 @@ def test_augmix_jsd_splitbn_pipeline(tmp_path):
     out = model(jnp.asarray(x, jnp.float32) / 255.0)
     loss = JsdCrossEntropy(num_splits=3, smoothing=0.1)(out, jnp.asarray(t))
     assert bool(jnp.isfinite(loss))
+
+
+def test_no_silent_exception_swallows_in_reader_paths():
+    """Lint: no data-pipeline file may silently swallow exceptions with a bare
+    `except Exception: pass` — transient I/O must go through the resilience
+    retry policy (backoff) and permanent faults through the poison-skip budget
+    (both log), never vanish."""
+    import pathlib
+    import re
+
+    import timm_tpu.data
+    data_dir = pathlib.Path(timm_tpu.data.__file__).parent
+    pattern = re.compile(r'except\s+(Exception|BaseException)?\s*(as\s+\w+)?\s*:\s*\n\s*pass\b')
+    offenders = {
+        p.name: pattern.findall(p.read_text())
+        for p in sorted(data_dir.glob('*.py')) if pattern.search(p.read_text())
+    }
+    assert not offenders, f'silent exception swallows in reader paths: {offenders}'

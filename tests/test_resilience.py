@@ -1,0 +1,364 @@
+"""Fault-tolerance subsystem tests (timm_tpu/resilience): durable checkpoint
+verification + fallback, recovery ordering, non-finite sentinel, reader
+retry/skip policy, fault injection, and the SIGTERM→`--resume auto` parity
+drill on a tiny CPU model."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from timm_tpu.resilience import (
+    CorruptCheckpointError, FaultInjector, NonFiniteError, SkipBudget,
+    TooManyBadSamples, atomic_write_npz, backoff_delays, capture_host_rng,
+    fault_selftest, find_checkpoints, load_with_fallback, resolve_auto_resume,
+    restore_host_rng, retry_io, verify_checkpoint,
+)
+
+pytestmark = pytest.mark.resilience
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- durable checkpoints -----------------------------------------------------
+
+def test_atomic_write_verify_roundtrip(tmp_path):
+    path = str(tmp_path / 'last.npz')
+    arrays = {'state_dict.w': np.arange(16.0).reshape(4, 4), 'epoch': np.asarray(3)}
+    atomic_write_npz(path, arrays, meta={'epoch': 3})
+    ok, reason = verify_checkpoint(path)
+    assert ok, reason
+    state, meta, used = load_with_fallback(path)
+    assert used == path and meta['epoch'] == 3
+    np.testing.assert_array_equal(state['state_dict.w'], arrays['state_dict.w'])
+    # no temp litter from the atomic write
+    assert not [n for n in os.listdir(tmp_path) if n.endswith('.tmp')]
+
+
+def test_manifest_detects_bit_corruption(tmp_path):
+    """A flipped byte INSIDE a structurally-valid zip only the manifest catches."""
+    path = str(tmp_path / 'last.npz')
+    atomic_write_npz(path, {'w': np.zeros(64, np.float32)}, meta={})
+    data = bytearray(open(path, 'rb').read())
+    # flip a byte in the middle of the (uncompressed) array payload
+    data[len(data) // 2] ^= 0xFF
+    open(path, 'wb').write(bytes(data))
+    ok, reason = verify_checkpoint(path)
+    assert not ok and ('sha256' in reason or 'unreadable' in reason)
+
+
+def test_truncated_checkpoint_falls_back_to_newest_valid(tmp_path):
+    older = str(tmp_path / 'checkpoint-0.npz')
+    newest = str(tmp_path / 'checkpoint-1.npz')
+    atomic_write_npz(older, {'w': np.ones(8)}, meta={'epoch': 0})
+    atomic_write_npz(newest, {'w': np.full(8, 2.0)}, meta={'epoch': 1})
+    with open(newest, 'r+b') as f:
+        f.truncate(os.path.getsize(newest) // 2)
+    ok, _ = verify_checkpoint(newest)
+    assert not ok
+    state, _meta, used = load_with_fallback(newest, search_dir=str(tmp_path))
+    assert used == older
+    np.testing.assert_array_equal(state['w'], np.ones(8))
+    with pytest.raises(CorruptCheckpointError):
+        with open(older, 'r+b') as f:
+            f.truncate(8)
+        load_with_fallback(newest, search_dir=str(tmp_path))
+
+
+def test_checkpoint_ordering_numeric_not_lexicographic(tmp_path):
+    # the seed bug: sorted() ranked recovery-1-999 above recovery-1-1000
+    for epoch, batch in [(1, 999), (1, 1000), (0, 5)]:
+        atomic_write_npz(str(tmp_path / f'recovery-{epoch}-{batch}.npz'),
+                         {'w': np.asarray(float(batch))}, meta={'epoch': epoch})
+    names = [os.path.basename(p) for p in find_checkpoints(str(tmp_path))]
+    assert names[0] == 'recovery-1-1000.npz'
+    assert names.index('recovery-1-1000.npz') < names.index('recovery-1-999.npz')
+    # a completed epoch 1 outranks any mid-epoch-1 recovery
+    atomic_write_npz(str(tmp_path / 'last.npz'),
+                     {'w': np.asarray(0.0), 'epoch': np.asarray(1)}, meta={'epoch': 1})
+    assert os.path.basename(find_checkpoints(str(tmp_path))[0]) == 'last.npz'
+    assert resolve_auto_resume(str(tmp_path)).endswith('last.npz')
+
+
+def test_saver_find_recovery_and_startup_cleanup(tmp_path):
+    from timm_tpu.utils import CheckpointSaver
+    d = str(tmp_path)
+    atomic_write_npz(os.path.join(d, 'recovery-1-999.npz'), {'w': np.asarray(1.0)})
+    atomic_write_npz(os.path.join(d, 'recovery-1-1000.npz'), {'w': np.asarray(2.0)})
+    # orphaned tmp artifacts + a corrupt recovery file from a "crash"
+    open(os.path.join(d, 'tmp.npz'), 'wb').write(b'partial')
+    open(os.path.join(d, '.last.npz.123.tmp'), 'wb').write(b'partial')
+    open(os.path.join(d, 'recovery-1-2000.npz'), 'wb').write(b'torn write')
+    saver = CheckpointSaver(task=None, checkpoint_dir=d, recovery_dir=d)
+    names = set(os.listdir(d))
+    assert 'tmp.npz' not in names and '.last.npz.123.tmp' not in names
+    assert 'recovery-1-2000.npz' not in names  # corrupt → swept
+    assert saver.find_recovery().endswith('recovery-1-1000.npz')
+
+
+# -- non-finite sentinel -----------------------------------------------------
+
+@pytest.fixture(scope='module')
+def tiny_task(mesh8):
+    import timm_tpu
+    from timm_tpu.loss import LabelSmoothingCrossEntropy
+    from timm_tpu.optim import create_optimizer_v2
+    from timm_tpu.task import ClassificationTask
+    model = timm_tpu.create_model('test_vit', num_classes=10, img_size=32)
+    opt = create_optimizer_v2(model, opt='adamw', lr=1e-3)
+    return ClassificationTask(
+        model, optimizer=opt, mesh=mesh8,
+        train_loss_fn=LabelSmoothingCrossEntropy(0.1), nonfinite_tolerance=3)
+
+
+def _batch(mesh, nan=False, seed=0):
+    import jax.numpy as jnp
+    from timm_tpu.parallel import shard_batch
+    rng = np.random.RandomState(seed)
+    x = rng.rand(8, 32, 32, 3).astype(np.float32)
+    if nan:
+        x = x * np.nan
+    return shard_batch({'input': jnp.asarray(x), 'target': jnp.asarray(rng.randint(0, 10, 8))},
+                       mesh)
+
+
+def test_nonfinite_step_commits_nothing(mesh8, tiny_task):
+    import jax
+    from flax import nnx
+    tiny_task.reset_nonfinite()
+    tiny_task.train_step(_batch(mesh8), lr=1e-3, step=0)
+    before = [np.asarray(p) for p in jax.tree.leaves(nnx.state(tiny_task.model, nnx.Param))]
+    opt_before = [np.asarray(l) for l in jax.tree.leaves(tiny_task.opt_state)]
+    metrics = tiny_task.train_step(_batch(mesh8, nan=True), lr=1e-3, step=1)
+    assert int(metrics['nonfinite_count']) == 1 and int(metrics['nonfinite_total']) == 1
+    after = [np.asarray(p) for p in jax.tree.leaves(nnx.state(tiny_task.model, nnx.Param))]
+    opt_after = [np.asarray(l) for l in jax.tree.leaves(tiny_task.opt_state)]
+    assert all(np.array_equal(a, b) for a, b in zip(before, after))
+    assert all(np.array_equal(a, b) for a, b in zip(opt_before, opt_after))
+    # a good step resets the consecutive counter (total stays)
+    metrics = tiny_task.train_step(_batch(mesh8), lr=1e-3, step=2)
+    assert int(metrics['nonfinite_count']) == 0 and int(metrics['nonfinite_total']) == 1
+
+
+def test_nonfinite_tolerance_aborts(mesh8, tiny_task):
+    tiny_task.reset_nonfinite()
+    with pytest.raises(NonFiniteError) as ei:
+        for step in range(5):
+            tiny_task.train_step(_batch(mesh8, nan=True), lr=1e-3, step=step)
+    assert ei.value.consecutive == 3  # tolerance from the fixture
+    tiny_task.reset_nonfinite()
+
+
+# -- retry / skip policy -----------------------------------------------------
+
+def test_retry_io_backoff_then_success():
+    sleeps = []
+    calls = {'n': 0}
+
+    def flaky():
+        calls['n'] += 1
+        if calls['n'] < 3:
+            raise IOError('transient')
+        return 'ok'
+
+    assert retry_io(flaky, retries=3, base_delay=0.1, jitter=0.5,
+                    sleep=sleeps.append) == 'ok'
+    assert calls['n'] == 3 and len(sleeps) == 2
+    # jittered exponential: each delay within ±50% of base*2^i, capped
+    assert 0.05 <= sleeps[0] <= 0.15 and 0.1 <= sleeps[1] <= 0.3
+
+
+def test_retry_io_exhaustion_and_poison_passthrough():
+    with pytest.raises(IOError):
+        retry_io(lambda: (_ for _ in ()).throw(IOError('down')),
+                 retries=2, base_delay=0.0, sleep=lambda s: None)
+    calls = {'n': 0}
+
+    def poison():
+        calls['n'] += 1
+        raise ValueError('bad record')
+
+    with pytest.raises(ValueError):
+        retry_io(poison, retries=3, base_delay=0.0, sleep=lambda s: None)
+    assert calls['n'] == 1  # non-transient: no retries
+
+
+def test_backoff_delays_bounded():
+    ds = list(backoff_delays(6, base_delay=0.1, max_delay=1.0, jitter=0.0))
+    assert ds == [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+
+
+def test_skip_budget():
+    b = SkipBudget(budget=2)
+    b.record(ValueError('x'), 'a')
+    b.record(ValueError('x'), 'b')
+    with pytest.raises(TooManyBadSamples):
+        b.record(ValueError('x'), 'c')
+
+
+class _FlakyDataset:
+    """Map-style dataset where some indices are poison (undecodable)."""
+
+    def __init__(self, n=12, bad=()):
+        self.n, self.bad = n, set(bad)
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx):
+        if idx in self.bad:
+            raise ValueError(f'undecodable sample {idx}')
+        return np.full((4, 4, 3), idx, np.float32), idx
+
+
+def test_loader_skips_poison_within_budget(monkeypatch):
+    from timm_tpu.data.loader import ThreadedLoader
+    monkeypatch.setenv('TIMM_TPU_POISON_BUDGET', '4')
+    loader = ThreadedLoader(_FlakyDataset(12, bad={3, 7}), batch_size=4,
+                            is_training=False, num_workers=2)
+    batches = list(loader)
+    got = sorted(int(t) for _x, ts in batches for t in ts)
+    assert got == [i for i in range(12) if i not in (3, 7)]  # order kept, poison dropped
+
+
+def test_loader_budget_exhaustion_fails_loudly(monkeypatch):
+    from timm_tpu.data.loader import ThreadedLoader
+    monkeypatch.setenv('TIMM_TPU_POISON_BUDGET', '1')
+    loader = ThreadedLoader(_FlakyDataset(12, bad={1, 2, 5}), batch_size=4,
+                            is_training=False, num_workers=2)
+    with pytest.raises(TooManyBadSamples):
+        list(loader)
+
+
+# -- fault injection ----------------------------------------------------------
+
+def test_fault_injector_spec_parse():
+    fi = FaultInjector('truncate_ckpt, nan_grads@4:2, sigterm@9, io_error%3')
+    assert fi.take('truncate_ckpt') and not fi.take('truncate_ckpt')
+    assert not fi.nan_at(3) and fi.nan_at(4) and fi.nan_at(5) and not fi.nan_at(6)
+    assert fi.sigterm_at(9) and not fi.sigterm_at(9)
+    assert [fi.io_error_tick() for _ in range(6)] == [False, False, True, False, False, True]
+    assert not FaultInjector('')
+    with pytest.raises(ValueError):
+        FaultInjector('explode@3')
+
+
+def test_fault_selftest_all_checks_pass(tmp_path):
+    result = fault_selftest('truncate_ckpt,nan_grads@1,io_error%2',
+                            tmp_dir=str(tmp_path))
+    assert result['ok'], result
+
+
+def test_bench_dry_run_fault_inject_smoke():
+    """`bench.py --dry-run --fault-inject` exercises the injection hooks in
+    tier-1 without a slow run (in-process, same idiom as
+    test_precision_policy's dry-run sweep)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'bench_resilience', os.path.join(REPO_ROOT, 'bench.py'))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    class Args:
+        model = 'test_vit'
+        img_size = 32
+        pad_tokens = ''
+        softmax_dtype = ''
+        norm_dtype = ''
+        mu_dtype = ''
+        fault_inject = 'truncate_ckpt,io_error%2,nan_grads@1:2,sigterm@3'
+
+    assert bench._dry_run(Args()) == 0
+
+
+# -- host RNG capture ---------------------------------------------------------
+
+def test_host_rng_capture_restore_bit_identical():
+    np.random.seed(123)
+    import random as pyrandom
+    pyrandom.seed(321)
+    np.random.rand(7)  # advance the streams off the seed point
+    pyrandom.random()
+    snap = capture_host_rng()
+    expect_np = np.random.rand(16)
+    expect_py = [pyrandom.random() for _ in range(4)]
+    np.random.rand(99)  # diverge
+    pyrandom.random()
+    assert restore_host_rng(snap)
+    np.testing.assert_array_equal(np.random.rand(16), expect_np)
+    assert [pyrandom.random() for _ in range(4)] == expect_py
+
+
+def test_load_state_dict_rejects_corrupt_npz(tmp_path):
+    from timm_tpu.models import load_checkpoint
+    import timm_tpu
+    path = str(tmp_path / 'weights.npz')
+    atomic_write_npz(path, {'w': np.ones(4)})
+    with open(path, 'r+b') as f:
+        f.truncate(16)
+    model = timm_tpu.create_model('test_vit', num_classes=5)
+    with pytest.raises(CorruptCheckpointError):
+        load_checkpoint(model, path)
+
+
+# -- end-to-end CPU drills (subprocess train.py) ------------------------------
+
+def _train_cmd(out_dir, experiment, *extra):
+    return [
+        sys.executable, os.path.join(REPO_ROOT, 'train.py'),
+        '--synthetic-data', '--model', 'test_vit', '--img-size', '32', '-b', '8',
+        '--synthetic-len', '64', '--epochs', '1', '--opt', 'sgd', '--lr', '0.05',
+        '--sched', 'cosine', '--warmup-epochs', '0', '--workers', '1',
+        '--log-interval', '50', '--output', str(out_dir), '--experiment', experiment,
+        *extra,
+    ]
+
+
+def _run(cmd):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    return subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=REPO_ROOT, timeout=240)
+
+
+def _params(path):
+    with np.load(path, allow_pickle=False) as d:
+        return {k: d[k] for k in d.files if k.startswith(('state_dict.', 'optimizer.'))}
+
+
+def test_sigterm_resume_parity(tmp_path):
+    """Acceptance drill (b): a run killed by SIGTERM mid-epoch and restarted
+    with `--resume auto` ends bit-identical to an uninterrupted run."""
+    r = _run(_train_cmd(tmp_path, 'base'))
+    assert r.returncode == 0, r.stderr[-2000:]
+    # interrupted run: injected SIGTERM after update 3 → recovery + exit 0
+    r = _run(_train_cmd(tmp_path, 'pre', '--fault-inject', 'sigterm@3'))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert 'recovery-0-3.npz' in os.listdir(tmp_path / 'pre'), r.stderr[-2000:]
+    r = _run(_train_cmd(tmp_path, 'pre', '--resume', 'auto'))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert 'Resumed mid-epoch' in r.stderr
+
+    base = _params(tmp_path / 'base' / 'last.npz')
+    resumed = _params(tmp_path / 'pre' / 'last.npz')
+    assert set(base) == set(resumed)
+    mismatched = [k for k in base if not np.array_equal(base[k], resumed[k])]
+    assert not mismatched, f'{len(mismatched)} tensors differ after resume: {mismatched[:5]}'
+    # end-of-epoch checkpoint supersedes the mid-epoch recovery file
+    assert not [n for n in os.listdir(tmp_path / 'pre') if n.startswith('recovery-')]
+
+
+def test_nan_abort_exit_code_and_intact_checkpoint(tmp_path):
+    """Acceptance drill (c): K consecutive injected NaN steps abort with a
+    non-zero exit while the committed checkpoints stay valid."""
+    r = _run(_train_cmd(tmp_path, 'nanabort',
+                        '--fault-inject', 'nan_grads@2:3', '--nonfinite-tolerance', '3'))
+    assert r.returncode == 3, (r.returncode, r.stderr[-2000:])
+    assert 'consecutive non-finite' in r.stderr
+    # no checkpoint was committed this epoch — but nothing half-written either
+    litter = [n for n in os.listdir(tmp_path / 'nanabort') if n.endswith('.tmp')]
+    assert not litter
+    for name in os.listdir(tmp_path / 'nanabort'):
+        if name.endswith('.npz'):
+            ok, reason = verify_checkpoint(str(tmp_path / 'nanabort' / name))
+            assert ok, (name, reason)

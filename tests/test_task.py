@@ -97,8 +97,9 @@ def test_checkpoint_saver(tmp_path, mesh8):
     assert best == 30.0 and best_ep == 1
     files = {f.name for f in tmp_path.iterdir()}
     assert 'last.npz' in files and 'model_best.npz' in files
-    # retention: only 2 epoch checkpoints kept
-    assert len([f for f in files if f.startswith('checkpoint-')]) == 2
+    # retention: only 2 epoch checkpoints kept (each with a manifest sidecar)
+    assert len([f for f in files if f.startswith('checkpoint-') and f.endswith('.npz')]) == 2
+    assert len([f for f in files if f.startswith('checkpoint-') and f.endswith('.manifest.json')]) == 2
     # recovery
     saver.save_recovery(2, batch_idx=5)
     assert saver.find_recovery()

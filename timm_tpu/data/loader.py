@@ -20,11 +20,16 @@ from typing import Callable, Optional, Tuple
 
 import numpy as np
 
+from ..resilience import SkipBudget, TooManyBadSamples, get_fault_injector, retry_io
 from .constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
 from .random_erasing import RandomErasing
 from .transforms_factory import create_transform
 
 __all__ = ['create_loader', 'StreamingLoader', 'ThreadedLoader']
+
+# marker a worker emits for a sample dropped against the poison budget, so the
+# collator keeps its consumed-count bookkeeping without padding the batch
+_SKIPPED = object()
 
 
 class StreamingLoader:
@@ -325,14 +330,29 @@ class ThreadedLoader:
                     continue
             return False
 
+        skip_budget = SkipBudget()
+
+        def _read(idx):
+            injector = get_fault_injector()
+            if injector is not None and injector.io_error_tick():
+                raise IOError(f'[fault-inject] sample read {idx}')
+            return self.dataset[int(idx)]
+
         def worker(worker_indices):
             for idx in worker_indices:
                 if stop.is_set():
                     return
                 try:
-                    sample = self.dataset[int(idx)]
+                    # transient I/O faults (OSError) ride through jittered
+                    # exponential backoff; anything still failing is poison
+                    sample = retry_io(lambda: _read(idx), retries=3, base_delay=0.05,
+                                      desc=f'sample {int(idx)}')
                 except Exception as e:
-                    sample = e
+                    try:
+                        skip_budget.record(e, f'sample index {int(idx)}')
+                        sample = _SKIPPED
+                    except TooManyBadSamples as fatal:
+                        sample = fatal  # budget exhausted: fail the epoch loudly
                 if not _put(sample_q, (int(idx), sample)):
                     return
 
@@ -380,16 +400,19 @@ class ThreadedLoader:
                     if ordered:
                         pending[idx] = sample
                         while pos < len(order) and int(order[pos]) in pending:
-                            img, target = pending.pop(int(order[pos]))
+                            s = pending.pop(int(order[pos]))
                             pos += 1
-                            batch_imgs.append(img)
-                            batch_targets.append(target)
+                            if s is not _SKIPPED:
+                                img, target = s
+                                batch_imgs.append(img)
+                                batch_targets.append(target)
                             if not emit(force_last=pos == len(order)):
                                 return
                     else:
-                        img, target = sample
-                        batch_imgs.append(img)
-                        batch_targets.append(target)
+                        if sample is not _SKIPPED:
+                            img, target = sample
+                            batch_imgs.append(img)
+                            batch_targets.append(target)
                         if not emit(force_last=consumed == len(order)):
                             return
             except Exception as e:

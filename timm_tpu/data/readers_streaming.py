@@ -24,10 +24,15 @@ from __future__ import annotations
 import glob
 import io
 import json
+import logging
 import os
 import random
 import tarfile
 from typing import Callable, List, Optional, Tuple
+
+from ..resilience import SkipBudget, get_fault_injector, retry_io
+
+_logger = logging.getLogger(__name__)
 
 __all__ = ['ReaderImageInTar', 'ReaderWds', 'ReaderTfds', 'assign_shards', 'expand_shard_pattern']
 
@@ -179,8 +184,10 @@ class ReaderWds:
             try:
                 with open(info_path) as f:
                     self.num_samples = int(json.load(f).get('num_samples'))
-            except Exception:
-                pass
+            except (OSError, ValueError, TypeError) as e:
+                _logger.warning(
+                    f'Ignoring unreadable shard sidecar {info_path} ({e!r}); '
+                    f'the loader length will be unknown — pass --epoch-size')
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -195,9 +202,12 @@ class ReaderWds:
         return self.num_samples
 
     def _iter_shard(self, path):
-        """Yield (key, {ext: bytes}) groups from one shard, in tar order."""
+        """Yield (key, {ext: bytes}) groups from one shard, in tar order.
+        Shard open rides the transient-I/O retry policy (network filesystems
+        drop tar opens far more often than member reads)."""
         cur_key, cur = None, {}
-        with tarfile.open(path) as tf:
+        with retry_io(lambda: tarfile.open(path), retries=3, base_delay=0.1,
+                      retry_on=(OSError, tarfile.ReadError), desc=f'open shard {path}') as tf:
             for m in tf:
                 if not m.isfile():
                     continue
@@ -255,12 +265,25 @@ class ReaderWds:
 
         buf = []
         i = -1
+        skip_budget = SkipBudget()
+        injector = get_fault_injector()
         for shard in my_shards:
             for key, sample in self._iter_shard(shard):
                 i += 1
                 if subshard and i % stride != offset:
                     continue
-                decoded = self._decode(sample)
+                if injector is not None and injector.io_error_tick():
+                    # injected read fault counts against the poison budget so
+                    # the skip accounting itself is exercised by drills
+                    skip_budget.record(IOError('[fault-inject] sample read'), f'{shard}:{key}')
+                    continue
+                try:
+                    decoded = self._decode(sample)
+                except Exception as e:
+                    # undecodable member = poison, not transient: skip within
+                    # budget instead of killing the epoch (or hiding it)
+                    skip_budget.record(e, f'{shard}:{key}')
+                    continue
                 if decoded is None:
                     continue
                 if self.shuffle_size:
@@ -306,7 +329,9 @@ class ReaderTfds:
         try:
             # sliced splits ('train[:10%]') report their sliced count
             self.num_samples = self.builder.info.splits[split].num_examples
-        except Exception:
+        except (KeyError, ValueError) as e:
+            _logger.debug(f'No sliced count for tfds split {split!r} ({e!r}); '
+                          f'using the full-split count')
             self.num_samples = self.split_info.num_examples
 
     def set_epoch(self, epoch: int):
@@ -416,8 +441,10 @@ class ReaderHfids:
         if total_shards > 1:
             try:
                 ds = ds.shard(num_shards=total_shards, index=index)
-            except Exception:
+            except Exception as e:
                 # unshardable stream: fall back to stride-based sample split
+                _logger.warning(f'hfids stream is not shardable ({e!r}); falling back '
+                                f'to stride-{total_shards} sample interleave')
                 ds = (s for i, s in enumerate(ds) if i % total_shards == index)
         for item in ds:
             img = item[self.input_key]

@@ -109,7 +109,10 @@ def save_state_dict(state_dict: Dict[str, np.ndarray], path: str):
         from safetensors.numpy import save_file
         save_file({k: np.ascontiguousarray(v) for k, v in state_dict.items()}, path)
     else:
-        np.savez(path, **state_dict)
+        # durable write: tmp+fsync+replace with a hash manifest, same contract
+        # as training checkpoints (resilience/durable.py)
+        from ..resilience import atomic_write_npz
+        atomic_write_npz(path, state_dict)
 
 
 def load_state_dict(checkpoint_path: str, use_ema: bool = True) -> Dict[str, np.ndarray]:
@@ -120,6 +123,13 @@ def load_state_dict(checkpoint_path: str, use_ema: bool = True) -> Dict[str, np.
         from safetensors.numpy import load_file
         sd = load_file(checkpoint_path)
     elif checkpoint_path.endswith(('.npz', '.npy')):
+        # integrity gate (resilience/durable.py): hash-verified when a sidecar
+        # manifest exists, zip-parse check otherwise — a truncated checkpoint
+        # fails HERE with the reason instead of deep in np.load
+        from ..resilience import CorruptCheckpointError, verify_checkpoint
+        ok, reason = verify_checkpoint(checkpoint_path)
+        if not ok:
+            raise CorruptCheckpointError(f'{checkpoint_path}: {reason}')
         with np.load(checkpoint_path, allow_pickle=False) as data:
             sd = {k: data[k] for k in data.files}
     elif checkpoint_path.endswith(('.pth', '.pt', '.bin')):

@@ -2,5 +2,6 @@ from .mesh import (
     create_mesh, data_sharding, get_global_mesh, replicate_sharding, set_global_mesh, shard_batch,
 )
 from .distributed import (
-    init_distributed_device, is_distributed_env, is_primary, reduce_tensor, world_info,
+    all_hosts_flag, init_distributed_device, is_distributed_env, is_primary, reduce_tensor,
+    world_info,
 )

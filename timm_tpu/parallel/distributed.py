@@ -18,7 +18,8 @@ import jax.numpy as jnp
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ['is_distributed_env', 'init_distributed_device', 'world_info', 'is_primary', 'reduce_tensor']
+__all__ = ['is_distributed_env', 'init_distributed_device', 'world_info', 'is_primary',
+           'reduce_tensor', 'all_hosts_flag']
 
 _INITIALIZED = False
 
@@ -72,6 +73,21 @@ def world_info() -> Tuple[int, int]:
 
 def is_primary(args=None) -> bool:
     return jax.process_index() == 0
+
+
+def all_hosts_flag(local_flag: bool, mode: str = 'any') -> bool:
+    """Cross-host boolean consensus for HOST-LOCAL signals (a SIGTERM may be
+    delivered to only some hosts of a pod, but every host must act on the
+    same step or the next collective deadlocks). Single-process: identity.
+    Multi-host: a tiny allgather; every host must call this at the same point
+    in its step sequence (it is a collective). `mode` is 'any' or 'all'."""
+    if jax.process_count() <= 1:
+        return bool(local_flag)
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(jnp.asarray([1 if local_flag else 0], jnp.int32))
+    import numpy as np
+    flags = np.asarray(flags)
+    return bool(flags.any()) if mode == 'any' else bool(flags.all())
 
 
 def reduce_tensor(tensor, n: Optional[int] = None):

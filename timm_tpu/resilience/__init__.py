@@ -1,0 +1,24 @@
+"""Fault-tolerance subsystem: durable checkpoints, non-finite step sentinel,
+preemption-aware shutdown, reader retry policy, and a fault-injection harness.
+
+See README "Fault tolerance" for the knobs:
+  TIMM_TPU_NONFINITE_TOLERANCE / _GUARD / _CHECK_EVERY, TIMM_TPU_POISON_BUDGET,
+  TIMM_TPU_PREEMPTION_POLL, TIMM_TPU_FAULT_INJECT, train.py --resume auto /
+  --fault-inject / --nonfinite-rollback.
+"""
+from .durable import (
+    SCHEMA_VERSION, CorruptCheckpointError, atomic_copy, atomic_write_bytes,
+    atomic_write_json, atomic_write_npz, checkpoint_progress_key, find_checkpoints,
+    load_verified, load_with_fallback, manifest_path, read_manifest,
+    resolve_auto_resume, verify_checkpoint,
+)
+from .faultinject import FaultInjector, fault_selftest, get_fault_injector, set_fault_injector
+from .hoststate import capture_host_rng, restore_host_rng
+from .preemption import GracefulShutdown, TrainingPreempted
+from .retry import (
+    DEFAULT_POISON_BUDGET, SkipBudget, TooManyBadSamples, backoff_delays, retry_io,
+)
+from .sentinel import (
+    NonFiniteError, NonFiniteSentinel, guard_enabled, new_sentinel_state,
+    tree_all_finite, update_sentinel_state,
+)

@@ -1,0 +1,289 @@
+"""Durable (atomic + checksummed) checkpoint I/O.
+
+Every checkpoint write goes tmp-file → flush → fsync → `os.replace`, then a
+sidecar manifest (`<name>.manifest.json`) records a SHA-256 per array plus
+schema version and step metadata. The manifest is the COMMIT RECORD: it is
+written after the data file, so a crash mid-write leaves either the previous
+(file, manifest) pair intact or a data file without a matching manifest —
+both detectable. Verification recomputes the per-array hashes; loading falls
+back to the newest *valid* checkpoint in the directory when the requested one
+is truncated or corrupt (the Orbax-style durability contract, owned here
+because TPU-pod runs on preemptible slices cannot lean on torch.save +
+host-side retries the way the reference does).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+__all__ = [
+    'SCHEMA_VERSION', 'CorruptCheckpointError',
+    'atomic_write_bytes', 'atomic_write_json', 'atomic_write_npz', 'atomic_copy',
+    'manifest_path', 'read_manifest', 'verify_checkpoint', 'load_verified',
+    'find_checkpoints', 'load_with_fallback', 'resolve_auto_resume',
+    'checkpoint_progress_key',
+]
+
+SCHEMA_VERSION = 1
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint failed integrity verification (truncated zip, manifest
+    hash mismatch, missing arrays, or unreadable file)."""
+
+
+def _fsync_dir(path: str):
+    """fsync the containing directory so the rename itself is durable."""
+    try:
+        fd = os.open(path or '.', os.O_RDONLY)
+    except OSError:
+        return  # e.g. platforms without O_RDONLY dirs; rename is still atomic
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes):
+    """tmp → fsync → os.replace; the final path is never partially written."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix='.' + os.path.basename(path) + '.', suffix='.tmp', dir=d)
+    try:
+        with os.fdopen(fd, 'wb') as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(path: str, obj):
+    atomic_write_bytes(path, json.dumps(obj, indent=1, default=str).encode())
+
+
+def manifest_path(path: str) -> str:
+    base, _ = os.path.splitext(path)
+    return base + '.manifest.json'
+
+
+def _array_digest(arr: np.ndarray) -> str:
+    arr = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(str(arr.shape).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def atomic_write_npz(path: str, arrays: Dict[str, np.ndarray], meta: Optional[dict] = None) -> str:
+    """Durably write `arrays` as an .npz at `path` with a sidecar manifest.
+
+    Write order: data file committed first (tmp+fsync+replace), manifest
+    second — the manifest's presence with matching hashes is what marks the
+    checkpoint complete. Returns the manifest path.
+    """
+    from .faultinject import get_fault_injector
+
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix='.' + os.path.basename(path) + '.', suffix='.tmp', dir=d)
+    try:
+        with os.fdopen(fd, 'wb') as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        injector = get_fault_injector()
+        if injector is not None and injector.take('truncate_ckpt'):
+            # simulate a torn write: chop the committed bytes in half so the
+            # verification/fallback path is exercised end-to-end
+            size = os.path.getsize(tmp)
+            with open(tmp, 'r+b') as f:
+                f.truncate(max(size // 2, 1))
+            _logger.warning(f'[fault-inject] truncated checkpoint write: {path}')
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+    manifest = {
+        'schema_version': SCHEMA_VERSION,
+        'file': os.path.basename(path),
+        'arrays': {k: {'sha256': _array_digest(v), 'shape': list(v.shape), 'dtype': str(v.dtype)}
+                   for k, v in arrays.items()},
+        'meta': dict(meta or {}),
+    }
+    mpath = manifest_path(path)
+    atomic_write_json(mpath, manifest)
+    return mpath
+
+
+def atomic_copy(src: str, dst: str, with_sidecars: bool = True):
+    """Copy a committed checkpoint (and its manifest / args sidecars) so the
+    destination also appears atomically."""
+    with open(src, 'rb') as f:
+        atomic_write_bytes(dst, f.read())
+    if not with_sidecars:
+        return
+    for side_src, side_dst in (
+            (manifest_path(src), manifest_path(dst)),
+            (os.path.splitext(src)[0] + '.json', os.path.splitext(dst)[0] + '.json'),
+    ):
+        if os.path.exists(side_src):
+            with open(side_src, 'rb') as f:
+                atomic_write_bytes(side_dst, f.read())
+
+
+def read_manifest(path: str) -> Optional[dict]:
+    mpath = manifest_path(path)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        _logger.warning(f'Unreadable checkpoint manifest {mpath}: {e}')
+        return None
+
+
+def verify_checkpoint(path: str) -> Tuple[bool, str]:
+    """Return (ok, reason). With a manifest: schema + per-array SHA-256 check.
+    Without one (legacy/foreign checkpoint): accept iff the npz itself loads."""
+    if not os.path.exists(path):
+        return False, 'missing'
+    manifest = read_manifest(path)
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            if manifest is None:
+                _ = data.files  # zip directory parse is the only check we have
+                return True, 'no-manifest (legacy checkpoint; hashes not verified)'
+            if int(manifest.get('schema_version', 0)) > SCHEMA_VERSION:
+                return False, f'schema_version {manifest.get("schema_version")} > {SCHEMA_VERSION}'
+            declared = manifest.get('arrays', {})
+            missing = [k for k in declared if k not in data.files]
+            if missing:
+                return False, f'arrays missing from file: {missing[:4]}'
+            for k, info in declared.items():
+                if _array_digest(data[k]) != info['sha256']:
+                    return False, f'sha256 mismatch for array {k!r}'
+    except Exception as e:
+        # a torn write surfaces as BadZipFile / zlib.error / EOFError /
+        # OSError depending on where the bytes were cut — any read failure
+        # means the checkpoint is not loadable, which is what we're deciding
+        return False, f'unreadable: {e!r}'
+    return True, 'ok'
+
+
+def load_verified(path: str) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Load a checkpoint after integrity verification; raises
+    CorruptCheckpointError with the reason on failure. Returns (state, meta)."""
+    ok, reason = verify_checkpoint(path)
+    if not ok:
+        raise CorruptCheckpointError(f'{path}: {reason}')
+    with np.load(path, allow_pickle=False) as data:
+        state = {k: data[k] for k in data.files}
+    manifest = read_manifest(path)
+    return state, (manifest or {}).get('meta', {})
+
+
+_RECOVERY_RE = re.compile(r'recovery-(\d+)-(\d+)\.npz$')
+_CHECKPOINT_RE = re.compile(r'checkpoint-(\d+)\.npz$')
+
+
+def checkpoint_progress_key(path: str) -> Tuple[float, int, float]:
+    """Training-progress ordering key for a checkpoint file (higher = newer).
+
+    A completed-epoch checkpoint (last/checkpoint-E/model_best, epoch E) ranks
+    as (E+1, 0); a mid-epoch recovery-E-B ranks as (E, B+1) — so end-of-epoch
+    state supersedes any recovery from the same epoch, and recovery-1-1000
+    correctly beats recovery-1-999 (ints, not lexicographic). mtime breaks
+    ties."""
+    name = os.path.basename(path)
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = 0.0
+    m = _RECOVERY_RE.search(name)
+    if m:
+        return float(m.group(1)), int(m.group(2)) + 1, mtime
+    m = _CHECKPOINT_RE.search(name)
+    if m:
+        return float(m.group(1)) + 1.0, 0, mtime
+    # last.npz / model_best.npz / foreign name: epoch from manifest meta or
+    # the stored epoch array
+    manifest = read_manifest(path)
+    epoch = None
+    if manifest is not None:
+        epoch = manifest.get('meta', {}).get('epoch')
+    if epoch is None:
+        try:
+            with np.load(path, allow_pickle=False) as data:
+                if 'epoch' in data.files:
+                    epoch = int(data['epoch'])
+        except Exception:
+            epoch = None  # unreadable file ranks last; verification rejects it
+    return (float(epoch) + 1.0 if epoch is not None else -1.0), 0, mtime
+
+
+def find_checkpoints(directory: str) -> List[str]:
+    """All checkpoint files in `directory`, newest-first by training progress."""
+    if not directory or not os.path.isdir(directory):
+        return []
+    names = [n for n in os.listdir(directory)
+             if n.endswith('.npz') and not n.startswith('.') and n != 'tmp.npz']
+    paths = [os.path.join(directory, n) for n in names]
+    return sorted(paths, key=checkpoint_progress_key, reverse=True)
+
+
+def load_with_fallback(
+        path: str,
+        search_dir: Optional[str] = None,
+) -> Tuple[Dict[str, np.ndarray], dict, str]:
+    """Load `path`, falling back to the newest valid checkpoint in
+    `search_dir` (default: path's directory) when it is corrupt. Returns
+    (state, meta, used_path); raises CorruptCheckpointError only when no
+    valid candidate exists."""
+    search_dir = search_dir or os.path.dirname(os.path.abspath(path))
+    tried = []
+    candidates = [path] + [c for c in find_checkpoints(search_dir)
+                           if os.path.abspath(c) != os.path.abspath(path)]
+    for cand in candidates:
+        ok, reason = verify_checkpoint(cand)
+        if ok:
+            if tried:
+                _logger.warning(
+                    f'Checkpoint fallback: {", ".join(tried)} — using {cand} instead')
+            state, meta = load_verified(cand)
+            return state, meta, cand
+        tried.append(f'{cand} ({reason})')
+        _logger.warning(f'Checkpoint failed verification: {cand}: {reason}')
+    raise CorruptCheckpointError(
+        f'No valid checkpoint found (tried: {"; ".join(tried) or path})')
+
+
+def resolve_auto_resume(directory: str) -> Optional[str]:
+    """`--resume auto`: newest valid checkpoint in `directory`, or None."""
+    for cand in find_checkpoints(directory):
+        ok, reason = verify_checkpoint(cand)
+        if ok:
+            return cand
+        _logger.warning(f'auto-resume skipping invalid checkpoint {cand}: {reason}')
+    return None

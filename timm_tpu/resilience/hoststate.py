@@ -1,0 +1,62 @@
+"""Host RNG stream capture/restore for step-granular (mid-epoch) resume.
+
+Host-side randomness (numpy's global MT19937 used by mixup/random-erasing,
+python's `random` used by augmentation policies) must continue from the exact
+preemption point for `--resume auto` to be bit-identical to an uninterrupted
+run. Device RNG streams (nnx dropout counters) are keyed per-step and need no
+capture. All values serialize as plain arrays so they ride inside the same
+.npz recovery checkpoint under the `_resume.` prefix.
+"""
+from __future__ import annotations
+
+import logging
+import random as _pyrandom
+from typing import Dict
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ['capture_host_rng', 'restore_host_rng']
+
+
+def capture_host_rng() -> Dict[str, np.ndarray]:
+    name, keys, pos, has_gauss, cached = np.random.get_state()
+    out = {
+        '_resume.np_rng_keys': np.asarray(keys, np.uint32),
+        '_resume.np_rng_meta': np.asarray([pos, has_gauss], np.int64),
+        '_resume.np_rng_gauss': np.asarray(cached, np.float64),
+    }
+    version, internal, gauss_next = _pyrandom.getstate()
+    if version == 3:
+        out['_resume.py_rng_state'] = np.asarray(internal, np.uint64)
+        out['_resume.py_rng_gauss'] = np.asarray(
+            [1.0, gauss_next] if gauss_next is not None else [0.0, 0.0], np.float64)
+    return out
+
+
+def restore_host_rng(state: Dict[str, np.ndarray]) -> bool:
+    """Restore streams captured by `capture_host_rng` from a checkpoint state
+    dict; returns True if anything was restored. Missing keys (end-of-epoch
+    checkpoints don't carry them) are a silent no-op."""
+    restored = False
+    if '_resume.np_rng_keys' in state:
+        meta = np.asarray(state['_resume.np_rng_meta'])
+        np.random.set_state((
+            'MT19937',
+            np.asarray(state['_resume.np_rng_keys'], np.uint32),
+            int(meta[0]), int(meta[1]),
+            float(np.asarray(state['_resume.np_rng_gauss'])),
+        ))
+        restored = True
+    if '_resume.py_rng_state' in state:
+        gauss = np.asarray(state['_resume.py_rng_gauss'])
+        _pyrandom.setstate((
+            3,
+            tuple(int(x) for x in np.asarray(state['_resume.py_rng_state'])),
+            float(gauss[1]) if gauss[0] else None,
+        ))
+        restored = True
+    if restored:
+        _logger.info('Restored host RNG streams from recovery checkpoint')
+    return restored

@@ -1,0 +1,101 @@
+"""Preemption-aware shutdown (Borg/Pathways-style SIGTERM handling).
+
+TPU-pod maintenance events and preemptible-slice reclaims deliver SIGTERM
+with a grace window. `GracefulShutdown` converts the signal into a flag the
+train loop polls between updates; the loop then writes a step-granular
+recovery checkpoint (data-loader position, host RNG state, update counter)
+and exits 0 so the scheduler restarts the job, which resumes mid-epoch via
+`--resume auto`.
+
+Multi-host: the signal may reach only some hosts, but every host must stop
+at the SAME update or the next collective deadlocks. `should_stop` therefore
+reaches cross-host consensus via `parallel.all_hosts_flag` at a fixed update
+cadence (TIMM_TPU_PREEMPTION_POLL, default 16) — all hosts evaluate the same
+updates, so they agree on the stop step by construction.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+from typing import Optional
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ['GracefulShutdown', 'TrainingPreempted']
+
+DEFAULT_CONSENSUS_EVERY = 16
+
+
+class TrainingPreempted(Exception):
+    """Raised by the train loop after the recovery checkpoint is written; the
+    top level logs and exits 0 (preemption is a normal, rescheduable exit)."""
+
+    def __init__(self, recovery_path: str = ''):
+        self.recovery_path = recovery_path
+        super().__init__(f'preempted; recovery checkpoint: {recovery_path or "n/a"}')
+
+
+class GracefulShutdown:
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT), consensus_every: Optional[int] = None):
+        self.signals = tuple(signals)
+        if consensus_every is None:
+            consensus_every = int(os.environ.get('TIMM_TPU_PREEMPTION_POLL', DEFAULT_CONSENSUS_EVERY))
+        self.consensus_every = max(1, consensus_every)
+        self._flag = threading.Event()
+        self._signum: Optional[int] = None
+        self._prev_handlers = {}
+        self._installed = False
+
+    def install(self) -> 'GracefulShutdown':
+        """Install handlers (main thread only; no-op elsewhere so library use
+        inside workers stays safe)."""
+        if threading.current_thread() is not threading.main_thread():
+            _logger.warning('GracefulShutdown.install() skipped: not on the main thread')
+            return self
+        for sig in self.signals:
+            self._prev_handlers[sig] = signal.signal(sig, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers.clear()
+        self._installed = False
+
+    def _handle(self, signum, frame):
+        if self._flag.is_set() and signum == signal.SIGINT:
+            # second ctrl-c: the user really means it
+            raise KeyboardInterrupt
+        self._signum = signum
+        self._flag.set()
+        _logger.warning(
+            f'Received {signal.Signals(signum).name}: finishing the current update, '
+            f'then writing a recovery checkpoint and exiting cleanly')
+
+    @property
+    def requested(self) -> bool:
+        return self._flag.is_set()
+
+    @property
+    def signum(self) -> Optional[int]:
+        return self._signum
+
+    def request(self):
+        """Programmatic trigger (tests / fault injection without a real signal)."""
+        self._signum = signal.SIGTERM
+        self._flag.set()
+
+    def should_stop(self, update_idx: int) -> bool:
+        """Poll between updates. Single-process: the local flag. Multi-host:
+        cross-host ANY-consensus at a fixed update cadence so every host stops
+        at the same step."""
+        import jax
+        if jax.process_count() <= 1:
+            return self.requested
+        if (update_idx + 1) % self.consensus_every != 0:
+            return False
+        from ..parallel import all_hosts_flag
+        return all_hosts_flag(self.requested, mode='any')

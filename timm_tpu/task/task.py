@@ -28,6 +28,10 @@ from flax import nnx
 
 from ..optim import Optimizer
 from ..parallel import get_global_mesh, replicate_sharding
+from ..resilience import (
+    NonFiniteSentinel, guard_enabled, new_sentinel_state, tree_all_finite,
+    update_sentinel_state,
+)
 from ..utils.clip_grad import dispatch_clip_grad, global_grad_norm
 from ..utils.model_ema import ModelEmaV3, ema_update
 from ..utils.serialization import flatten_pytree, unflatten_into
@@ -48,6 +52,8 @@ class TrainingTask:
             clip_mode: str = 'norm',
             mean=None,
             std=None,
+            nonfinite_guard: Optional[bool] = None,
+            nonfinite_tolerance: Optional[int] = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -55,6 +61,13 @@ class TrainingTask:
         self.grad_accum_steps = max(1, grad_accum_steps)
         self.clip_grad = clip_grad
         self.clip_mode = clip_mode
+        # non-finite sentinel (resilience/sentinel.py): an all-finite reduction
+        # over loss+grads fused into the jitted step; bad steps commit nothing
+        # and K consecutive bad steps abort via NonFiniteError. Default on
+        # (disable with nonfinite_guard=False or TIMM_TPU_NONFINITE_GUARD=0).
+        self._nonfinite_guard = guard_enabled(nonfinite_guard)
+        self.sentinel = NonFiniteSentinel(nonfinite_tolerance) if self._nonfinite_guard else None
+        self._sentinel_state = new_sentinel_state() if self._nonfinite_guard else None
         # on-device input normalization, fused into the jitted step (the
         # reference normalizes on-GPU in PrefetchLoader, loader.py:124-159)
         if mean is not None:
@@ -113,12 +126,13 @@ class TrainingTask:
         accum = self.grad_accum_steps
         clip_grad, clip_mode = self.clip_grad, self.clip_mode
         has_ema = self.ema_params is not None
+        guard = self._nonfinite_guard
         loss_forward = self.loss_forward
 
         normalize_input = self.normalize_input
 
         @nnx.jit
-        def train_step(model, opt_state, ema_params, batch, lr, ema_decay):
+        def train_step(model, opt_state, ema_params, sentinel_state, batch, lr, ema_decay):
             batch = normalize_input(batch)
 
             def loss_fn(model, mb):
@@ -150,17 +164,31 @@ class TrainingTask:
                 params_for_clip = nnx.state(model, nnx.Param) if clip_mode == 'agc' else None
                 grads, _ = dispatch_clip_grad(grads, clip_grad, mode=clip_mode, params=params_for_clip)
 
-            params = nnx.state(model, nnx.Param)
-            updates, opt_state = optimizer.update(grads, opt_state, params, lr=lr)
-            params = optax.apply_updates(params, updates)
+            old_params = nnx.state(model, nnx.Param)
+            updates, new_opt_state = optimizer.update(grads, opt_state, old_params, lr=lr)
+            params = optax.apply_updates(old_params, updates)
+            if guard:
+                # all-finite reduction over loss + raw grads; a bad step keeps
+                # params/opt_state/EMA bit-identical to the previous step
+                ok = tree_all_finite(loss, grads)
+                select = lambda new, old: jnp.where(ok, new, old)  # noqa: E731
+                params = jax.tree.map(select, params, old_params)
+                new_opt_state = jax.tree.map(select, new_opt_state, opt_state)
+                sentinel_state = update_sentinel_state(sentinel_state, ok)
+            opt_state = new_opt_state
             nnx.update(model, params)
 
             if has_ema:
                 # decay==0 naturally syncs EMA to model (reference ModelEmaV3
                 # lerp weight 1.0 during the update_after_step window).
-                ema_params = ema_update(ema_params, params, ema_decay)
+                new_ema = ema_update(ema_params, params, ema_decay)
+                if guard:
+                    new_ema = jax.tree.map(select, new_ema, ema_params)
+                ema_params = new_ema
             metrics = {'loss': loss, 'grad_norm': grad_norm}
-            return opt_state, ema_params, metrics
+            if guard:
+                metrics['nonfinite'] = sentinel_state[0] > 0
+            return opt_state, ema_params, sentinel_state, metrics
 
         return train_step
 
@@ -183,12 +211,28 @@ class TrainingTask:
         self.model.train()
         ema_decay = self.ema.get_decay(step) if self.ema is not None else 0.0
         ema_in = self.ema_params if self.ema_params is not None else ()
-        self.opt_state, ema_out, metrics = self._train_step(
-            self.model, self.opt_state, ema_in, batch,
+        sent_in = self._sentinel_state if self._sentinel_state is not None else ()
+        self.opt_state, ema_out, sent_out, metrics = self._train_step(
+            self.model, self.opt_state, ema_in, sent_in, batch,
             jnp.asarray(lr, jnp.float32), jnp.asarray(ema_decay, jnp.float32))
         if self.ema_params is not None:
             self.ema_params = ema_out
+        if self._sentinel_state is not None:
+            self._sentinel_state = sent_out
+            metrics['nonfinite_count'] = sent_out[0]
+            metrics['nonfinite_total'] = sent_out[1]
+            if self.sentinel is not None:
+                # polls the device counters (every TIMM_TPU_NONFINITE_CHECK_EVERY
+                # steps) and raises NonFiniteError after K consecutive bad steps
+                self.sentinel.observe(sent_out, step=step)
         return metrics
+
+    def reset_nonfinite(self):
+        """Clear the consecutive-bad-step counters (after a rollback)."""
+        if self._sentinel_state is not None:
+            self._sentinel_state = new_sentinel_state()
+        if self.sentinel is not None:
+            self.sentinel.reset()
 
     def update_ema(self, step: int):
         pass  # fused into train_step; parity no-op (task.py update_ema)

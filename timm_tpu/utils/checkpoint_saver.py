@@ -4,22 +4,34 @@ Checkpoint = one `.npz` file holding the flattened task state (model params,
 EMA, optimizer state, epoch metadata) — same single-file UX as the reference's
 torch.save dict, schema keys mirrored from checkpoint_saver.py:89-110.
 Retention: `last` always, top-k by metric, `model_best` copied.
+
+Durability (resilience subsystem): every write goes tmp → fsync →
+`os.replace` with a SHA-256 sidecar manifest (resilience/durable.py), so a
+preemption or crash mid-write can never leave a torn `last.npz` as the only
+resume candidate. Startup sweeps orphaned tmp files and corrupt recovery
+files; `find_recovery` orders `(epoch, batch_idx)` numerically and returns
+the newest file that passes verification.
 """
 from __future__ import annotations
 
 import glob
-import json
 import logging
 import operator
 import os
-import shutil
-from typing import Callable, Dict, List, Optional, Tuple
+import re
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..resilience import (
+    atomic_copy, atomic_write_json, atomic_write_npz, manifest_path, verify_checkpoint,
+)
 
 _logger = logging.getLogger(__name__)
 
 __all__ = ['CheckpointSaver']
+
+_RECOVERY_RE = re.compile(r'-(\d+)-(\d+)\.npz$')
 
 
 class CheckpointSaver:
@@ -51,30 +63,58 @@ class CheckpointSaver:
         self.cmp = operator.lt if decreasing else operator.gt
         self.max_history = max_history
         assert self.max_history >= 1
+        self._cleanup_startup()
 
-    def _save(self, save_path: str, epoch: int, metric: Optional[float] = None):
+    def _cleanup_startup(self):
+        """Sweep artifacts of a previous crash: orphaned tmp files from
+        interrupted atomic writes, the legacy non-atomic `tmp.npz`, and
+        recovery files that fail integrity verification."""
+        for d in {self.checkpoint_dir, self.recovery_dir}:
+            if not d or not os.path.isdir(d):
+                continue
+            for name in os.listdir(d):
+                path = os.path.join(d, name)
+                if name.endswith('.tmp') or name in ('tmp.npz', 'tmp.json'):
+                    _logger.info(f'Removing orphaned checkpoint temp file: {path}')
+                    self._unlink(path)
+                elif name.startswith(self.recovery_prefix) and name.endswith(self.extension):
+                    ok, reason = verify_checkpoint(path)
+                    if not ok:
+                        _logger.warning(f'Removing corrupt recovery file {path}: {reason}')
+                        self._unlink(path)
+                        self._unlink(manifest_path(path))
+
+    @staticmethod
+    def _unlink(path: str):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def _save(self, save_path: str, epoch: int, metric: Optional[float] = None,
+              extra_state: Optional[Dict[str, np.ndarray]] = None):
         state = self.task.get_checkpoint_state()
         state['epoch'] = np.asarray(epoch)
         if metric is not None:
             state['metric'] = np.asarray(metric)
-        np.savez(save_path, **state)
+        if extra_state:
+            state.update({k: np.asarray(v) for k, v in extra_state.items()})
+        meta = {'epoch': epoch, 'metric': metric}
+        if extra_state and '_resume.num_updates' in extra_state:
+            meta['num_updates'] = int(np.asarray(extra_state['_resume.num_updates']))
+        atomic_write_npz(save_path, state, meta=meta)
         if self.args is not None:
-            meta_path = save_path.replace(self.extension, '.json')
-            with open(meta_path, 'w') as f:
-                json.dump({'epoch': epoch, 'metric': metric, 'arch': getattr(self.args, 'model', None),
-                           'args': {k: str(v) for k, v in vars(self.args).items()}}, f, indent=2, default=str)
+            atomic_write_json(save_path.replace(self.extension, '.json'), {
+                'epoch': epoch, 'metric': metric, 'arch': getattr(self.args, 'model', None),
+                'args': {k: str(v) for k, v in vars(self.args).items()}})
 
     def save_checkpoint(self, epoch: int, metric: Optional[float] = None):
         assert epoch >= 0
-        tmp_save_path = os.path.join(self.checkpoint_dir, 'tmp' + self.extension)
         last_save_path = os.path.join(self.checkpoint_dir, 'last' + self.extension)
-        self._save(tmp_save_path, epoch, metric)
-        if os.path.exists(last_save_path):
-            os.unlink(last_save_path)
-        os.rename(tmp_save_path, last_save_path)
-        tmp_meta = tmp_save_path.replace(self.extension, '.json')
-        if os.path.exists(tmp_meta):
-            os.replace(tmp_meta, last_save_path.replace(self.extension, '.json'))
+        self._save(last_save_path, epoch, metric)
+        # an end-of-epoch checkpoint supersedes any mid-epoch recovery of this
+        # or an earlier epoch — drop them so `--resume auto` can't step back
+        self._prune_stale_recovery(epoch)
 
         worst_file = self.checkpoint_files[-1] if self.checkpoint_files else None
         if len(self.checkpoint_files) < self.max_history or metric is None or self.cmp(metric, worst_file[1]):
@@ -82,10 +122,7 @@ class CheckpointSaver:
                 self._cleanup_checkpoints(1)
             filename = '-'.join([self.save_prefix, str(epoch)]) + self.extension
             save_path = os.path.join(self.checkpoint_dir, filename)
-            shutil.copy2(last_save_path, save_path)
-            if self.args is not None and os.path.exists(last_save_path.replace(self.extension, '.json')):
-                shutil.copy2(last_save_path.replace(self.extension, '.json'),
-                             save_path.replace(self.extension, '.json'))
+            atomic_copy(last_save_path, save_path)
             self.checkpoint_files.append((save_path, metric))
             self.checkpoint_files = sorted(
                 self.checkpoint_files, key=lambda x: x[1] if x[1] is not None else -float('inf'),
@@ -100,7 +137,7 @@ class CheckpointSaver:
                 self.best_epoch = epoch
                 self.best_metric = metric
                 best_save_path = os.path.join(self.checkpoint_dir, 'model_best' + self.extension)
-                shutil.copy2(last_save_path, best_save_path)
+                atomic_copy(last_save_path, best_save_path)
 
         return (None, None) if self.best_metric is None else (self.best_metric, self.best_epoch)
 
@@ -114,27 +151,58 @@ class CheckpointSaver:
             try:
                 _logger.debug(f'Cleaning checkpoint: {d}')
                 os.remove(d[0])
-                meta = d[0].replace(self.extension, '.json')
-                if os.path.exists(meta):
-                    os.remove(meta)
+                for side in (d[0].replace(self.extension, '.json'), manifest_path(d[0])):
+                    if os.path.exists(side):
+                        os.remove(side)
             except OSError:
                 _logger.error(f'Exception removing checkpoint {d}')
         self.checkpoint_files = self.checkpoint_files[:delete_index]
 
-    def save_recovery(self, epoch: int, batch_idx: int = 0):
+    def save_recovery(self, epoch: int, batch_idx: int = 0,
+                      extra_state: Optional[Dict[str, np.ndarray]] = None) -> str:
         filename = '-'.join([self.recovery_prefix, str(epoch), str(batch_idx)]) + self.extension
         save_path = os.path.join(self.recovery_dir, filename)
-        self._save(save_path, epoch)
+        self._save(save_path, epoch, extra_state=extra_state)
         if os.path.exists(self.prev_recovery_file):
             try:
                 os.remove(self.prev_recovery_file)
+                self._unlink(manifest_path(self.prev_recovery_file))
+                self._unlink(self.prev_recovery_file.replace(self.extension, '.json'))
             except OSError:
                 _logger.error(f'Exception removing {self.prev_recovery_file}')
         self.prev_recovery_file = self.curr_recovery_file
         self.curr_recovery_file = save_path
+        return save_path
 
-    def find_recovery(self) -> str:
+    def _recovery_files(self) -> List[str]:
+        """Recovery files newest-first by numeric (epoch, batch_idx) — the
+        seed's lexicographic sort ranked recovery-1-999 above recovery-1-1000."""
         recovery_path = os.path.join(self.recovery_dir, self.recovery_prefix)
         files = glob.glob(recovery_path + '*' + self.extension)
-        files = sorted(files)
-        return files[0] if files else ''
+
+        def key(f):
+            m = _RECOVERY_RE.search(f)
+            return (int(m.group(1)), int(m.group(2))) if m else (-1, -1)
+
+        return sorted(files, key=key, reverse=True)
+
+    def _prune_stale_recovery(self, completed_epoch: int):
+        for f in self._recovery_files():
+            m = _RECOVERY_RE.search(f)
+            if m and int(m.group(1)) <= completed_epoch:
+                self._unlink(f)
+                self._unlink(manifest_path(f))
+                self._unlink(f.replace(self.extension, '.json'))
+                if f == self.curr_recovery_file:
+                    self.curr_recovery_file = ''
+                if f == self.prev_recovery_file:
+                    self.prev_recovery_file = ''
+
+    def find_recovery(self) -> str:
+        """Newest recovery checkpoint that passes integrity verification."""
+        for f in self._recovery_files():
+            ok, reason = verify_checkpoint(f)
+            if ok:
+                return f
+            _logger.warning(f'Skipping invalid recovery checkpoint {f}: {reason}')
+        return ''

@@ -41,7 +41,9 @@ def make_parser():
     group.add_argument('--model', default='vit_tiny_patch16_224', type=str, metavar='MODEL')
     group.add_argument('--pretrained', action='store_true', default=False)
     group.add_argument('--initial-checkpoint', default='', type=str, metavar='PATH')
-    group.add_argument('--resume', default='', type=str, metavar='PATH')
+    group.add_argument('--resume', default='', type=str, metavar='PATH',
+                       help="checkpoint to resume from, or 'auto' to pick the newest valid "
+                            "checkpoint/recovery file in the experiment dir (use with --experiment)")
     group.add_argument('--no-resume-opt', action='store_true', default=False)
     group.add_argument('--img-size', type=int, default=None, metavar='N')
     group.add_argument('--in-chans', type=int, default=None, metavar='N')
@@ -151,6 +153,22 @@ def make_parser():
     group.add_argument('--log-wandb', action='store_true', default=False)
     group.add_argument('--synthetic-len', type=int, default=1024,
                        help='samples per epoch for --synthetic-data')
+    # fault tolerance (timm_tpu/resilience; README "Fault tolerance")
+    group = parser.add_argument_group('Fault tolerance parameters')
+    group.add_argument('--fault-inject', default='', type=str, metavar='SPEC',
+                       help="arm the fault-injection harness for drills, e.g. "
+                            "'truncate_ckpt,nan_grads@12,sigterm@7,io_error%%50' "
+                            "(timm_tpu/resilience/faultinject.py)")
+    group.add_argument('--nonfinite-tolerance', type=int, default=None, metavar='K',
+                       help='abort after K consecutive non-finite (NaN/Inf) train steps '
+                            '(default: env TIMM_TPU_NONFINITE_TOLERANCE or 3); skipped '
+                            'steps commit nothing and are counted in metrics')
+    group.add_argument('--no-nonfinite-guard', action='store_true', default=False,
+                       help='disable the in-step all-finite check entirely')
+    group.add_argument('--nonfinite-rollback', action='store_true', default=False,
+                       help='when the non-finite tolerance trips, reload the newest valid '
+                            'checkpoint and continue instead of aborting (budget: '
+                            'TIMM_TPU_ROLLBACK_BUDGET, default 1)')
     # NaFlex variable-resolution training (reference train.py --naflex-loader)
     group = parser.add_argument_group('NaFlex parameters')
     group.add_argument('--naflex-loader', action='store_true', help='token-budget variable-res training')
@@ -220,8 +238,15 @@ def main():
         setup_default_logging, update_summary,
     )
 
+    from timm_tpu.resilience import (
+        GracefulShutdown, NonFiniteError, TrainingPreempted,
+        load_with_fallback, resolve_auto_resume, restore_host_rng, set_fault_injector,
+    )
+
     setup_default_logging()
     args, args_text = _parse_args()
+    if args.fault_inject:
+        set_fault_injector(args.fault_inject)
     if args.device:
         # must land before the first device op; env JAX_PLATFORMS loses to the
         # axon plugin's sitecustomize registration, jax.config wins
@@ -316,6 +341,8 @@ def main():
         clip_mode=args.clip_mode,
         mean=norm_mean,
         std=norm_std,
+        nonfinite_guard=False if args.no_nonfinite_guard else None,
+        nonfinite_tolerance=args.nonfinite_tolerance,
         **task_kwargs,
     )
 
@@ -463,46 +490,110 @@ def main():
     if args.start_epoch is not None:
         start_epoch = args.start_epoch
 
-    # resume
-    if args.resume:
-        ck = np.load(args.resume, allow_pickle=False)
-        state = {k: ck[k] for k in ck.files}
-        task.load_checkpoint_state(state, strict=True, load_opt=not args.no_resume_opt)
-        if 'epoch' in state and args.start_epoch is None:
-            start_epoch = int(state['epoch']) + 1
-        _logger.info(f'Resumed from {args.resume} at epoch {start_epoch}')
-
-    # output / saver
+    # output / saver — created BEFORE resume so `--resume auto` can scan the
+    # experiment dir (pass --experiment for a stable dir across restarts);
+    # CheckpointSaver's constructor also sweeps orphaned tmp / corrupt
+    # recovery files left by a crash
     saver = None
     output_dir = None
+    exp_name = args.experiment or '-'.join([
+        datetime.now().strftime('%Y%m%d-%H%M%S'), args.model, str(img_size)])
     if rank == 0:
-        exp_name = args.experiment or '-'.join([
-            datetime.now().strftime('%Y%m%d-%H%M%S'), args.model, str(img_size)])
         output_dir = get_outdir(args.output if args.output else './output/train', exp_name)
         saver = CheckpointSaver(
             task, args=args, checkpoint_dir=output_dir, recovery_dir=output_dir,
             decreasing=args.eval_metric == 'loss', max_history=args.checkpoint_hist)
         with open(os.path.join(output_dir, 'args.yaml'), 'w') as f:
             f.write(args_text)
+    elif args.experiment:
+        # non-primary hosts resolve the same (shared-FS) dir for auto-resume
+        output_dir = os.path.join(args.output if args.output else './output/train', exp_name)
+
+    # resume: integrity-verified load with fallback to the newest valid
+    # checkpoint; 'auto' resolves recovery/last/checkpoint-* newest-first
+    start_batch_idx = 0
+    resume_num_updates = None
+    resume_path = ''
+    if args.resume == 'auto':
+        resume_path = resolve_auto_resume(output_dir) if output_dir else None
+        if not resume_path:
+            _logger.info(f'auto-resume: no valid checkpoint under {output_dir}; starting fresh')
+    elif args.resume:
+        resume_path = args.resume
+    if resume_path:
+        state, _ck_meta, used_path = load_with_fallback(
+            resume_path, search_dir=output_dir or os.path.dirname(os.path.abspath(resume_path)))
+        # one-line diff of state keys instead of a strict=True stack trace
+        template = set(task.get_checkpoint_state())
+        loaded = {k for k in state if not k.startswith('_resume.') and k not in ('epoch', 'metric')}
+        missing, unexpected = sorted(template - loaded), sorted(loaded - template)
+        if missing or unexpected:
+            _logger.warning(
+                f'Resume state diff: {len(missing)} missing '
+                f'{missing[:5] + (["..."] if len(missing) > 5 else [])}, '
+                f'{len(unexpected)} unexpected '
+                f'{unexpected[:5] + (["..."] if len(unexpected) > 5 else [])}')
+        task.load_checkpoint_state(state, strict=False, load_opt=not args.no_resume_opt)
+        restore_host_rng(state)
+        ck_epoch = int(state['epoch']) if 'epoch' in state else 0
+        if state.get('_resume.mid_epoch') is not None and int(state['_resume.mid_epoch']):
+            # step-granular recovery: re-enter the SAME epoch, skip the
+            # already-consumed loader batches, continue the update counter
+            start_epoch = ck_epoch
+            start_batch_idx = int(state['_resume.batches_consumed'])
+            resume_num_updates = int(state['_resume.num_updates'])
+            _logger.info(
+                f'Resumed mid-epoch from {used_path}: epoch {start_epoch}, '
+                f'batch {start_batch_idx}, update {resume_num_updates}')
+        else:
+            if args.start_epoch is None:
+                start_epoch = ck_epoch + 1
+            _logger.info(f'Resumed from {used_path} at epoch {start_epoch}')
 
     # prime the scheduler so epoch 0 (or the resume epoch) starts at warmup LR
     if lr_scheduler is not None:
         if args.sched_on_updates:
-            lr_scheduler.step_update(start_epoch * updates_per_epoch)
+            lr_scheduler.step_update(resume_num_updates if resume_num_updates is not None
+                                     else start_epoch * updates_per_epoch)
         else:
             lr_scheduler.step(start_epoch)
+            if resume_num_updates is not None:
+                lr_scheduler.step_update(resume_num_updates)
+
+    # preemption-aware shutdown: SIGTERM/SIGINT set a flag the train loop
+    # polls; on preemption a step-granular recovery checkpoint is written and
+    # the process exits 0 (resume with `--resume auto`)
+    shutdown = GracefulShutdown().install()
+    rollback_budget = [int(os.environ.get('TIMM_TPU_ROLLBACK_BUDGET', '1'))
+                       if args.nonfinite_rollback else 0]
 
     best_metric = None
     best_epoch = None
     eval_metrics = {}
     for epoch in range(start_epoch, num_epochs):
+        if shutdown.requested:
+            # preempted at an epoch boundary: last.npz already covers resume
+            _logger.warning(f'Shutdown requested; stopping before epoch {epoch} '
+                            f'(resume with --resume auto)')
+            raise SystemExit(0)
         if hasattr(loader_train, 'set_epoch'):
             loader_train.set_epoch(epoch)  # fresh shuffle/schedule (ref train.py:478)
         if args.mixup_off_epoch and epoch >= args.mixup_off_epoch and mixup_fn is not None:
             mixup_fn.mixup_enabled = False  # ref train.py disable-mixup schedule
-        train_metrics = train_one_epoch(
-            epoch, task, loader_train, args, lr_scheduler, mesh, shard_batch,
-            updates_per_epoch, saver=saver, mixup_fn=mixup_fn)
+        try:
+            train_metrics = train_one_epoch(
+                epoch, task, loader_train, args, lr_scheduler, mesh, shard_batch,
+                updates_per_epoch, saver=saver, mixup_fn=mixup_fn, shutdown=shutdown,
+                skip_batches=start_batch_idx if epoch == start_epoch else 0,
+                start_updates=resume_num_updates if epoch == start_epoch else None,
+                rollback_budget=rollback_budget)
+        except TrainingPreempted as e:
+            _logger.warning(f'Preempted during epoch {epoch}; recovery checkpoint: '
+                            f'{e.recovery_path or "(non-primary host)"}. Exiting 0 for reschedule.')
+            raise SystemExit(0)
+        except NonFiniteError as e:
+            _logger.error(f'Aborting training: {e}')
+            raise SystemExit(3)
 
         eval_metrics = validate(task, loader_eval, args, mesh, shard_batch)
         if task.ema_params is not None:
@@ -526,34 +617,97 @@ def main():
     return eval_metrics
 
 
+def _recovery_extras(batches_consumed, num_updates):
+    """Step-granular resume state stored alongside the task state in a
+    recovery checkpoint: loader position, update counter, host RNG streams."""
+    from timm_tpu.resilience import capture_host_rng
+    extras = {
+        '_resume.mid_epoch': np.asarray(1),
+        '_resume.batches_consumed': np.asarray(batches_consumed),
+        '_resume.num_updates': np.asarray(num_updates),
+    }
+    extras.update(capture_host_rng())
+    return extras
+
+
+def _resilient_train_step(task, batch, lr, step, args, saver, rollback_budget):
+    """task.train_step with optional rollback-to-last-checkpoint when the
+    non-finite tolerance trips. Returns metrics, or None when the step was
+    dropped by a rollback (caller skips the batch and continues)."""
+    from timm_tpu.resilience import NonFiniteError, load_with_fallback, resolve_auto_resume
+    try:
+        return task.train_step(batch, lr=lr, step=step)
+    except NonFiniteError:
+        if not rollback_budget or rollback_budget[0] <= 0 or saver is None:
+            raise
+        rb = resolve_auto_resume(saver.checkpoint_dir)
+        if rb is None:
+            raise
+        state, _meta, used = load_with_fallback(rb, search_dir=saver.checkpoint_dir)
+        task.load_checkpoint_state(state, strict=False)
+        task.reset_nonfinite()
+        rollback_budget[0] -= 1
+        _logger.warning(
+            f'Non-finite tolerance hit at update {step}: rolled back to {used} '
+            f'({rollback_budget[0]} rollback(s) left); continuing')
+        return None
+
+
 def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
-                    updates_per_epoch, saver=None, mixup_fn=None):
+                    updates_per_epoch, saver=None, mixup_fn=None, shutdown=None,
+                    skip_batches=0, start_updates=None, rollback_budget=None):
+    from timm_tpu.resilience import TrainingPreempted, get_fault_injector
     from timm_tpu.utils import AverageMeter
     loss_m = AverageMeter()
     accum = args.grad_accum_steps
-    num_updates = epoch * updates_per_epoch
+    num_updates = start_updates if start_updates is not None else epoch * updates_per_epoch
     lr = lr_scheduler.get_last_lr()[0] if lr_scheduler else args.lr
+    injector = get_fault_injector()
+
+    def poll_faults_and_shutdown(batch_idx, update_idx):
+        """After each committed update: deliver injected SIGTERM, then write a
+        step-granular recovery checkpoint and stop if shutdown was requested."""
+        if injector is not None and injector.sigterm_at(num_updates - 1):
+            _logger.warning(f'[fault-inject] SIGTERM at update {num_updates - 1}')
+            os.kill(os.getpid(), __import__('signal').SIGTERM)
+        if shutdown is not None and shutdown.should_stop(update_idx):
+            path = ''
+            if saver is not None:
+                path = saver.save_recovery(
+                    epoch, update_idx,
+                    extra_state=_recovery_extras(batch_idx + 1, num_updates))
+            raise TrainingPreempted(path)
 
     metrics = {}
     micro_inputs, micro_targets = [], []
-    update_idx = 0
+    update_idx = skip_batches // accum  # display/recovery cadence continuity on resume
     samples_since_log = 0
     log_t0 = time.time()
     for batch_idx, batch_data in enumerate(loader):
+        if batch_idx < skip_batches:
+            continue  # mid-epoch resume: already consumed before preemption
         if isinstance(batch_data, dict):
             # NaFlex dict batch; scalar metadata (seq_len/patch_size) stays on
             # host — the model derives the patch size from the patch dim shape
             n = batch_data['patches'].shape[0]
+            if injector is not None and injector.nan_at(num_updates):
+                _logger.warning(f'[fault-inject] NaN batch at update {num_updates}')
+                batch_data = dict(batch_data, patches=np.asarray(batch_data['patches']) * np.nan)
             batch = shard_batch(
                 {k: jnp.asarray(v) for k, v in batch_data.items()
                  if k not in ('seq_len', 'patch_size')}, mesh)
-            metrics = task.train_step(batch, lr=lr, step=num_updates)
+            metrics = _resilient_train_step(task, batch, lr, num_updates, args, saver, rollback_budget)
+            if metrics is None:
+                update_idx += 1
+                continue
             num_updates += 1
             samples_since_log += n
             if lr_scheduler is not None:
                 lr = lr_scheduler.step_update(num_updates)[0]
             if update_idx % args.log_interval == 0:
-                loss_m.update(float(metrics['loss']), n=n)
+                loss_val = float(metrics['loss'])
+                if np.isfinite(loss_val):
+                    loss_m.update(loss_val, n=n)
                 elapsed = time.time() - log_t0
                 _logger.info(
                     f'Train: {epoch} [{update_idx:>4d}/{updates_per_epoch}] '
@@ -562,7 +716,9 @@ def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
                 samples_since_log = 0
                 log_t0 = time.time()
             if saver is not None and args.recovery_interval and (update_idx + 1) % args.recovery_interval == 0:
-                saver.save_recovery(epoch, update_idx)
+                saver.save_recovery(epoch, update_idx,
+                                    extra_state=_recovery_extras(batch_idx + 1, num_updates))
+            poll_faults_and_shutdown(batch_idx, update_idx)
             update_idx += 1
             continue
         input_np, target_np = batch_data
@@ -578,25 +734,35 @@ def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
         else:
             input_all, target_all = micro_inputs[0], micro_targets[0]
         micro_inputs, micro_targets = [], []
+        if injector is not None and injector.nan_at(num_updates):
+            _logger.warning(f'[fault-inject] NaN batch at update {num_updates}')
+            input_all = np.asarray(input_all) * np.nan
         batch = shard_batch({'input': jnp.asarray(input_all), 'target': jnp.asarray(target_all)}, mesh)
-        metrics = task.train_step(batch, lr=lr, step=num_updates)
+        metrics = _resilient_train_step(task, batch, lr, num_updates, args, saver, rollback_budget)
+        if metrics is None:
+            update_idx += 1
+            continue
         num_updates += 1
         samples_since_log += input_all.shape[0]
         if lr_scheduler is not None:
             lr = lr_scheduler.step_update(num_updates)[0]
         if update_idx % args.log_interval == 0:
             loss_val = float(metrics['loss'])  # sync point
-            loss_m.update(loss_val, n=input_all.shape[0])
+            if np.isfinite(loss_val):  # a skipped non-finite step must not poison the meter
+                loss_m.update(loss_val, n=input_all.shape[0])
             elapsed = time.time() - log_t0
             ips = samples_since_log / max(elapsed, 1e-9)
             samples_since_log = 0
             log_t0 = time.time()
+            nf = int(metrics['nonfinite_total']) if 'nonfinite_total' in metrics else 0
             _logger.info(
                 f'Train: {epoch} [{update_idx:>4d}/{updates_per_epoch}] '
                 f'Loss: {loss_m.val:#.3g} ({loss_m.avg:#.3g}) LR: {lr:.3e} '
-                f'{ips:.1f} img/s')
+                f'{ips:.1f} img/s' + (f' NaN-skipped: {nf}' if nf else ''))
         if saver is not None and args.recovery_interval and (update_idx + 1) % args.recovery_interval == 0:
-            saver.save_recovery(epoch, update_idx)
+            saver.save_recovery(epoch, update_idx,
+                                extra_state=_recovery_extras(batch_idx + 1, num_updates))
+        poll_faults_and_shutdown(batch_idx, update_idx)
         update_idx += 1
     if micro_inputs:
         # flush trailing partial accumulation group: pad by wrapping samples so
@@ -609,11 +775,15 @@ def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
             input_all = np.concatenate([input_all] + [input_all] * reps, axis=0)[:accum * micro_inputs[0].shape[0]]
             target_all = np.concatenate([target_all] + [target_all] * reps, axis=0)[:accum * micro_inputs[0].shape[0]]
         batch = shard_batch({'input': jnp.asarray(input_all), 'target': jnp.asarray(target_all)}, mesh)
-        metrics = task.train_step(batch, lr=lr, step=num_updates)
-        num_updates += 1
-        if lr_scheduler is not None:
-            lr = lr_scheduler.step_update(num_updates)[0]
-    return OrderedDict([('loss', loss_m.avg if loss_m.count else float(metrics.get('loss', 0.0))), ('lr', lr)])
+        metrics = _resilient_train_step(task, batch, lr, num_updates, args, saver, rollback_budget)
+        if metrics is not None:
+            num_updates += 1
+            if lr_scheduler is not None:
+                lr = lr_scheduler.step_update(num_updates)[0]
+    out = OrderedDict([('loss', loss_m.avg if loss_m.count else float((metrics or {}).get('loss', 0.0))), ('lr', lr)])
+    if metrics and 'nonfinite_total' in metrics:
+        out['nonfinite_steps'] = int(metrics['nonfinite_total'])
+    return out
 
 
 def validate(task, loader, args, mesh, shard_batch, use_ema=False):

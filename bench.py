@@ -58,8 +58,20 @@ SELF_RESULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'BEN
 
 TOTAL_BUDGET = int(os.environ.get('BENCH_TOTAL_BUDGET', '420'))
 
+# fast-fail knob for a downed TPU relay: the probe gets this long, and when it
+# FAILS the single fresh-process retry is capped to the same window instead of
+# the full remaining budget (the old behavior burned ~400s of child hangs
+# before aborting)
+PROBE_TIMEOUT = int(os.environ.get('TIMM_TPU_BENCH_PROBE_TIMEOUT', '60'))
+
 # minimum seconds between "measuring" heartbeat status lines
 HEARTBEAT_S = 60
+
+
+def _max_attempts(probed_ok: bool) -> int:
+    """Bench-child retry budget: a live probe earns real retries; a failed
+    probe gets exactly one fresh-process attempt before the abort line."""
+    return 3 if probed_ok else 1
 
 _START = time.time()
 _WATCHDOG = None
@@ -155,6 +167,8 @@ def _run_child(args, timeout_s: int) -> dict | None:
     if args.batch_size:
         cmd += ['--batch-size', str(args.batch_size)]
     # precision/alignment A/B levers must reach the measurement process
+    if args.block_scan:
+        cmd += ['--block-scan']
     if args.pad_tokens:
         cmd += ['--pad-tokens', str(args.pad_tokens)]
     if args.softmax_dtype:
@@ -239,6 +253,15 @@ def main():
     parser.add_argument('--mu-dtype', default='',
                         help="optimizer first-moment dtype: 'bfloat16' halves m HBM "
                              "traffic (v stays fp32), '' = fp32")
+    parser.add_argument('--block-scan', action='store_true', default=False,
+                        help='scan-over-layers block execution: one lax.scan over '
+                             'stacked per-layer params (O(1)-in-depth trace/compile)')
+    parser.add_argument('--compile-report', action='store_true', default=False,
+                        help='CPU compile-cost report: cold trace ms / cold compile ms / '
+                             'warm-disk-cache ms / jaxpr equation counts, scan off vs on '
+                             '(4 fresh child processes; no TPU, no probe)')
+    parser.add_argument('--compile-child', action='store_true',
+                        help='internal: run one compile-cost measurement in this process')
     parser.add_argument('--dry-run', action='store_true',
                         help='in-process CPU smoke: build the model + one tiny train/infer '
                              'step with the requested levers, print a result line, exit. '
@@ -259,6 +282,12 @@ def main():
         args.model = 'vit_tiny_patch16_224'
         args.steps = 5
 
+    if args.compile_child:
+        raise SystemExit(_compile_child(args))
+
+    if args.compile_report:
+        raise SystemExit(_compile_report(args))
+
     if args.dry_run:
         raise SystemExit(_dry_run(args))
 
@@ -272,17 +301,22 @@ def main():
     if not args.no_probe:
         # One short probe; its only purpose is to distinguish "unreachable
         # relay" (replay is honest) from "code regression" (report 0.0).
-        probed_ok = _probe_device(timeout_s=int(min(75, max(30, _remaining() - 240))))
+        probed_ok = _probe_device(timeout_s=int(min(PROBE_TIMEOUT, max(10, _remaining() - 60))))
         _status(f'probe {"succeeded" if probed_ok else "FAILED"}, launching measurement')
 
     # Even if the probe failed, still attempt the real run: the probe process
-    # itself may have wedged where a fresh process would not. Retry with a
-    # fresh process as long as ≥60s of budget remains (a generous
-    # BENCH_TOTAL_BUDGET buys real retries; the default 420s usually fits one).
+    # itself may have wedged where a fresh process would not. A live probe
+    # earns retries against the remaining budget; a FAILED probe gets exactly
+    # one fresh-process attempt capped at PROBE_TIMEOUT, so a downed relay
+    # aborts in ~2x TIMM_TPU_BENCH_PROBE_TIMEOUT instead of eating the whole
+    # budget in wedged children.
     result = None
     attempts_made = 0
-    while _remaining() - 15 >= 60 and attempts_made < 3:
-        result = _run_child(args, int(_remaining() - 15))
+    while _remaining() - 15 >= 30 and attempts_made < _max_attempts(probed_ok):
+        child_budget = int(_remaining() - 15)
+        if not probed_ok:
+            child_budget = min(child_budget, PROBE_TIMEOUT)
+        result = _run_child(args, child_budget)
         attempts_made += 1
         if result is not None and result.get('value', 0) > 0:
             break
@@ -351,10 +385,15 @@ def _dry_run(args) -> int:
     import timm_tpu
     from timm_tpu.loss import cross_entropy
     from timm_tpu.optim import create_optimizer_v2
+    from timm_tpu.utils import configure_compile_cache
 
+    configure_compile_cache()
     model_kwargs, opt_kwargs, tag = _apply_precision_knobs(args)
     img = min(args.img_size, 64)  # tiny input: the gate is "traces + runs", not perf
     model = timm_tpu.create_model(args.model, img_size=img, **model_kwargs)
+    if getattr(args, 'block_scan', False) and hasattr(model, 'set_block_scan'):
+        model.set_block_scan(True)
+        tag += ' [block_scan]'
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(2, img, img, 3), jnp.float32)
     t = jnp.asarray(rng.randint(0, model.num_classes, 2))
@@ -392,6 +431,124 @@ def _dry_run(args) -> int:
     return 0 if ok else 2
 
 
+def _compile_child(args) -> int:
+    """One compile-cost measurement in a FRESH process (so 'cold' means cold):
+    trace ms, lower+compile ms (hits the persistent disk cache when
+    TIMM_TPU_COMPILE_CACHE points at a warm dir), total jaxpr equation count."""
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')  # compile cost needs no TPU
+    except Exception:
+        pass
+    from timm_tpu.utils.compile_cache import configure_compile_cache, count_jaxpr_eqns
+    cache_dir = configure_compile_cache()
+
+    import jax.numpy as jnp
+    from flax import nnx
+
+    import timm_tpu
+
+    model = timm_tpu.create_model(args.model, img_size=args.img_size)
+    if args.block_scan and hasattr(model, 'set_block_scan'):
+        model.set_block_scan(True)
+    model.eval()
+    graphdef, state = nnx.split(model)
+    x = jnp.zeros((2, args.img_size, args.img_size, 3), jnp.float32)
+
+    def fwd(s, xx):
+        return nnx.merge(graphdef, s)(xx)
+
+    t0 = time.perf_counter()
+    traced = jax.jit(fwd).trace(state, x)
+    trace_ms = (time.perf_counter() - t0) * 1e3
+    eqns = count_jaxpr_eqns(traced.jaxpr)
+    t0 = time.perf_counter()
+    traced.lower().compile()
+    compile_ms = (time.perf_counter() - t0) * 1e3
+    print(json.dumps({
+        'metric': f'{args.model} fwd compile cost (scan={"on" if args.block_scan else "off"}, '
+                  f'cache={"set" if cache_dir else "off"})',
+        'value': round(trace_ms + compile_ms, 1), 'unit': 'ms', 'vs_baseline': None,
+        'trace_ms': round(trace_ms, 1), 'compile_ms': round(compile_ms, 1),
+        'jaxpr_eqns': eqns}), flush=True)
+    return 0
+
+
+def _run_compile_child(args, block_scan: bool, cache_dir: str):
+    """Spawn a fresh-process _compile_child run and parse its result line."""
+    cmd = [sys.executable, os.path.abspath(__file__), '--compile-child',
+           '--model', args.model, '--img-size', str(args.img_size)]
+    if block_scan:
+        cmd += ['--block-scan']
+    env = dict(os.environ, JAX_PLATFORMS='cpu', TIMM_TPU_COMPILE_CACHE=cache_dir)
+    try:
+        r = subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed((r.stdout or '').strip().splitlines()):
+        try:
+            d = json.loads(line)
+            if isinstance(d, dict) and 'trace_ms' in d:
+                return d
+        except Exception:
+            continue
+    tail = '\n'.join((r.stderr or '').strip().splitlines()[-10:])
+    print(f'compile child rc={r.returncode}, no result; stderr tail:\n{tail}',
+          file=sys.stderr, flush=True)
+    return None
+
+
+def _compile_report(args) -> int:
+    """Compile & input-pipeline cost report (PERF.md 'compile & input
+    pipeline'): for scan off/on, run a COLD child (fresh process, empty disk
+    cache) and a WARM child (fresh process, same disk cache) and report
+    cold-trace / cold-compile / warm-compile ms + jaxpr equation counts. CPU
+    only, measurable with the TPU relay down."""
+    import shutil
+    import tempfile
+
+    _status('compile-report: 4 fresh-process measurements (scan off/on x cold/warm)')
+    rows = {}
+    for scan in (False, True):
+        cache_dir = tempfile.mkdtemp(prefix='timm_tpu_ccache_')
+        try:
+            for run in ('cold', 'warm'):
+                r = _run_compile_child(args, scan, cache_dir)
+                if r is None:
+                    print(json.dumps({
+                        'metric': f'compile-report FAILED at scan={scan} {run}',
+                        'value': 0.0, 'unit': 'x', 'vs_baseline': None}), flush=True)
+                    return 2
+                rows[(scan, run)] = r
+                _status(f'compile-report: scan={"on" if scan else "off"} {run}: '
+                        f'trace {r["trace_ms"]}ms compile {r["compile_ms"]}ms '
+                        f'eqns {r["jaxpr_eqns"]}')
+        finally:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+    def total(scan, run):
+        r = rows[(scan, run)]
+        return r['trace_ms'] + r['compile_ms']
+
+    scan_speedup = total(False, 'cold') / max(total(True, 'cold'), 1e-9)
+    warm_ratio = rows[(True, 'warm')]['compile_ms'] / max(rows[(True, 'cold')]['compile_ms'], 1e-9)
+    eqn_ratio = rows[(False, 'cold')]['jaxpr_eqns'] / max(rows[(True, 'cold')]['jaxpr_eqns'], 1)
+    print(json.dumps({
+        'metric': (f'{args.model} compile report: cold trace+compile '
+                   f'{total(False, "cold"):.0f}ms (loop) -> {total(True, "cold"):.0f}ms (scan) '
+                   f'= {scan_speedup:.1f}x; warm disk-cache compile '
+                   f'{rows[(True, "warm")]["compile_ms"]:.0f}ms vs cold '
+                   f'{rows[(True, "cold")]["compile_ms"]:.0f}ms; jaxpr eqns '
+                   f'{rows[(False, "cold")]["jaxpr_eqns"]} (loop) vs '
+                   f'{rows[(True, "cold")]["jaxpr_eqns"]} (scan, {eqn_ratio:.1f}x fewer)'),
+        'value': round(scan_speedup, 2), 'unit': 'x cold trace+compile (scan vs loop)',
+        'vs_baseline': None,
+        'detail': {f'{"scan" if s else "loop"}_{r}': rows[(s, r)]
+                   for s in (False, True) for r in ('cold', 'warm')},
+        'warm_vs_cold_compile': round(warm_ratio, 3)}), flush=True)
+    return 0
+
+
 def _measure(args) -> int:
     """The actual device measurement (runs in the child process)."""
     # The parent enforces the real budget; this is a backstop so a wedged
@@ -409,6 +566,9 @@ def _measure(args) -> int:
     from timm_tpu.loss import cross_entropy
     from timm_tpu.optim import create_optimizer_v2
     from timm_tpu.parallel import create_mesh, data_sharding, set_global_mesh
+    from timm_tpu.utils import configure_compile_cache
+
+    configure_compile_cache()
 
     mesh = create_mesh()
     set_global_mesh(mesh)
@@ -423,6 +583,9 @@ def _measure(args) -> int:
     if args.img_size != 224:
         kwargs['img_size'] = args.img_size
     model = timm_tpu.create_model(args.model, dtype=jnp.bfloat16, **kwargs)
+    if args.block_scan and hasattr(model, 'set_block_scan'):
+        model.set_block_scan(True)
+        knob_tag += ' [block_scan]'
 
     rng = np.random.RandomState(0)
     x = jax.device_put(

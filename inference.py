@@ -46,6 +46,10 @@ parser.add_argument('--outputs-name', default=None)
 parser.add_argument('--output-dir', default=None)
 parser.add_argument('--output-type', default='csv', choices=['csv', 'json', 'parquet'])
 parser.add_argument('--filename-col', default='filename')
+parser.add_argument('--block-scan', action='store_true', default=False,
+                    help='scan-over-layers block execution (O(1)-in-depth trace/compile)')
+parser.add_argument('--device-prefetch', type=int, default=0, metavar='N',
+                    help='keep N batches in flight on device while the step runs; 0 disables')
 
 
 def main():
@@ -62,6 +66,8 @@ def main():
         # must land before the first device op (model init); env JAX_PLATFORMS
         # loses to the axon plugin's sitecustomize registration
         jax.config.update('jax_platforms', args.device)
+    from timm_tpu.utils import configure_compile_cache
+    configure_compile_cache()
     dtype = jnp.bfloat16 if args.amp else None
     try:
         model = timm_tpu.create_model(
@@ -72,6 +78,11 @@ def main():
             args.model, pretrained=args.pretrained, num_classes=args.num_classes, dtype=dtype)
     if args.checkpoint:
         load_checkpoint(model, args.checkpoint, use_ema=args.use_ema)
+    if args.block_scan:
+        if hasattr(model, 'set_block_scan'):
+            model.set_block_scan(True)
+        else:
+            _logger.warning(f'--block-scan: {args.model} has no scannable block stack; ignored')
     model.eval()
 
     data_config = resolve_data_config(vars(args), model=model)
@@ -87,6 +98,7 @@ def main():
         num_workers=args.workers,
         crop_pct=data_config['crop_pct'],
         crop_mode=data_config['crop_mode'],
+        device_prefetch=args.device_prefetch,
     )
 
     graphdef, state = nnx.split(model)

@@ -18,10 +18,15 @@ except Exception:
 # Persistent XLA compilation cache: model sweeps recompile the same tiny
 # fixture programs every run; caching compiled executables across pytest
 # invocations cuts full-suite wall time from ~9 min cold to well under the
-# 10-minute budget on warm runs (VERDICT r3 weak #7).
+# 10-minute budget on warm runs (VERDICT r3 weak #7). The env var is PINNED
+# here (not merely defaulted at read time) so subprocess tests (resilience
+# drills, compile-cache round-trips, bench children) inherit the same warm
+# dir — tier-1's budget must not depend on ambient process warmth.
+os.environ.setdefault(
+    'TIMM_TPU_COMPILE_CACHE',
+    os.environ.get('TIMM_TPU_XLA_CACHE', '/tmp/timm_tpu_xla_cache'))
 try:
-    _cache_dir = os.environ.get('TIMM_TPU_XLA_CACHE', '/tmp/timm_tpu_xla_cache')
-    jax.config.update('jax_compilation_cache_dir', _cache_dir)
+    jax.config.update('jax_compilation_cache_dir', os.environ['TIMM_TPU_COMPILE_CACHE'])
     jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
     jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
 except Exception:

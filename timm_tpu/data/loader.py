@@ -25,7 +25,7 @@ from .constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
 from .random_erasing import RandomErasing
 from .transforms_factory import create_transform
 
-__all__ = ['create_loader', 'StreamingLoader', 'ThreadedLoader']
+__all__ = ['create_loader', 'DevicePrefetcher', 'StreamingLoader', 'ThreadedLoader']
 
 # marker a worker emits for a sample dropped against the poison budget, so the
 # collator keeps its consumed-count bookkeeping without padding the batch
@@ -209,6 +209,67 @@ class StreamingLoader:
             x = self.random_erasing(x)
         return x, t
 
+
+
+class DevicePrefetcher:
+    """Double-buffer device-prefetch stage over any host-batch iterable.
+
+    The host loaders above stop at numpy: the consuming step then pays a
+    synchronous host→device transfer per batch (an input stall the device
+    sits idle through). This wrapper keeps up to ``size`` upcoming batches in
+    flight on device — ``jax.device_put`` dispatches the transfer
+    asynchronously, so batch k+1 streams to HBM while the step runs on batch
+    k. Batches are sharded over the global mesh batch axis via
+    ``parallel.shard_batch`` (single-device meshes degrade to a plain
+    device_put); re-sharding the yielded arrays downstream is a no-op.
+
+    Drain/stop semantics (PR-3 preemption contract): early termination of the
+    consumer (preemption checkpoint, exception, ``break``) closes the inner
+    iterator through the generator's ``finally`` — worker threads observe
+    their stop event and exit, and prefetched-but-unyielded device batches
+    are simply dropped. The recovery checkpoint records the index of the last
+    *yielded* batch, so ``--resume auto`` skip-counting is unaffected by the
+    prefetch depth.
+
+    Attribute access (``len()``, ``sampler``, ``mean``/``std``,
+    ``set_epoch``…) delegates to the wrapped loader.
+    """
+
+    def __init__(self, loader, size: int = 2):
+        self.loader = loader
+        self.size = max(1, int(size))
+
+    def __getattr__(self, name):
+        return getattr(self.loader, name)
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __iter__(self):
+        import collections
+
+        from ..parallel import shard_batch
+
+        buf = collections.deque()
+        it = iter(self.loader)
+        try:
+            while len(buf) < self.size:
+                try:
+                    buf.append(shard_batch(next(it)))
+                except StopIteration:
+                    break
+            while buf:
+                out = buf.popleft()
+                try:
+                    buf.append(shard_batch(next(it)))
+                except StopIteration:
+                    pass
+                yield out
+        finally:
+            buf.clear()
+            close = getattr(it, 'close', None)
+            if close is not None:
+                close()
 
 
 def _collate_arrays(imgs, targets):
@@ -481,10 +542,17 @@ def create_loader(
         seed: int = 42,
         persistent_workers: bool = True,
         worker_seeding: str = 'all',
+        device_prefetch: int = 0,
         **kwargs,
 ):
     """(reference loader.py:205). Returns a ThreadedLoader yielding
-    (images NHWC float32 [0,1], targets int) numpy batches."""
+    (images NHWC float32 [0,1], targets int) numpy batches.
+
+    ``device_prefetch=N`` (default 0 = off) appends a DevicePrefetcher stage
+    that keeps up to N batches in flight on device (sharded over the global
+    mesh), overlapping host→device transfer with the running step. Leave off
+    when the consumer still mutates batches on host (mixup, grad-accum
+    concatenation)."""
     import jax
 
     if num_aug_repeats and not hasattr(dataset, '__getitem__'):
@@ -537,11 +605,15 @@ def create_loader(
     )
     if not hasattr(dataset, '__getitem__'):
         # iterable (streaming) dataset: the reader owns shard assignment
-        return StreamingLoader(dataset, num_workers=num_workers, **loader_kwargs)
-    return ThreadedLoader(
-        dataset,
-        num_workers=num_workers,
-        seed=seed,
-        num_aug_repeats=num_aug_repeats,
-        **loader_kwargs,
-    )
+        loader = StreamingLoader(dataset, num_workers=num_workers, **loader_kwargs)
+    else:
+        loader = ThreadedLoader(
+            dataset,
+            num_workers=num_workers,
+            seed=seed,
+            num_aug_repeats=num_aug_repeats,
+            **loader_kwargs,
+        )
+    if device_prefetch:
+        loader = DevicePrefetcher(loader, size=device_prefetch)
+    return loader

@@ -11,19 +11,39 @@ import jax
 import jax.numpy as jnp
 from flax import nnx
 
-__all__ = ['DropPath', 'Dropout', 'DropBlock2d', 'calculate_drop_path_rates', 'drop_path', 'drop_block_2d']
+__all__ = ['DropPath', 'Dropout', 'DropBlock2d', 'calculate_drop_path_rates', 'drop_path',
+           'apply_drop_path', 'drop_block_2d']
 
 
-def drop_path(x, key, drop_prob: float = 0.0, scale_by_keep: bool = True):
-    """Per-sample stochastic depth (reference drop.py:~140)."""
-    if drop_prob == 0.0:
+def drop_path(x, key, drop_prob=0.0, scale_by_keep: bool = True):
+    """Per-sample stochastic depth (reference drop.py:~140).
+
+    `drop_prob` may be a traced scalar: scan-over-layers threads the per-layer
+    rate as data (`_manipulate.drop_path_scan_inputs`), where the zero-rate
+    early-out can't apply — a traced rate of 0 still reduces to the identity
+    (keep mask all-True, scale 1).
+    """
+    static = isinstance(drop_prob, (int, float))
+    if static and drop_prob == 0.0:
         return x
     keep_prob = 1.0 - drop_prob
     shape = (x.shape[0],) + (1,) * (x.ndim - 1)
-    mask = jax.random.bernoulli(key, keep_prob, shape)
+    mask = jax.random.bernoulli(
+        key, keep_prob if static else jnp.asarray(keep_prob, jnp.float32), shape)
     if scale_by_keep:
-        return jnp.where(mask, x / keep_prob, jnp.zeros((), x.dtype))
+        denom = keep_prob if static else jnp.asarray(keep_prob, x.dtype)
+        return jnp.where(mask, x / denom, jnp.zeros((), x.dtype))
     return jnp.where(mask, x, jnp.zeros((), x.dtype))
+
+
+def apply_drop_path(y, module: 'DropPath', override, site: int):
+    """Run a DropPath site: the module itself in loop mode, or the functional
+    form with the scanned per-layer ``(rates[S], keys[S])`` override in scan
+    mode (the merged block's DropPath modules are structural no-ops there)."""
+    if override is None:
+        return module(y)
+    rates, keys = override
+    return drop_path(y, keys[site], rates[site], module.scale_by_keep)
 
 
 class DropPath(nnx.Module):

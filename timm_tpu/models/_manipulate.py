@@ -13,7 +13,11 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 MATCH_PREV_GROUP = (99999,)
 
-__all__ = ['group_parameters', 'group_with_matcher', 'named_parameters', 'checkpoint_seq']
+__all__ = [
+    'group_parameters', 'group_with_matcher', 'named_parameters', 'checkpoint_seq',
+    'BlockStackError', 'iter_submodules', 'build_block_stack', 'scan_block_stack',
+    'drop_path_scan_inputs', 'resolve_block_scan', 'warn_scan_fallback',
+]
 
 
 def named_parameters(model) -> Dict[str, Any]:
@@ -107,6 +111,212 @@ def _run_modules(modules, x):
     for m in modules:
         x = m(x)
     return x
+
+
+# ---- scan-over-layers block stacking ----------------------------------------
+#
+# A depth-L transformer traced as a Python loop costs O(L) trace time and O(L)
+# XLA subgraphs to compile. For homogeneous block stacks the params can instead
+# be stacked into leading-axis pytrees and the stack run as ONE lax.scan whose
+# body is traced/compiled once — O(1) in depth (the MaxText/Flax big-model
+# recipe). The helpers below implement that generically for any nnx block list
+# so every ViT-family model (vision_transformer, deit, beit, eva) shares one
+# code path.
+
+
+class BlockStackError(RuntimeError):
+    """Raised when a block list cannot be stacked for lax.scan execution
+    (heterogeneous types/statics/shapes, live inner dropout RNG, <2 blocks).
+    Callers fall back to the Python loop."""
+
+
+def resolve_block_scan(flag) -> bool:
+    """Resolve a model's ``block_scan`` constructor arg: an explicit bool wins;
+    None reads the ``TIMM_TPU_BLOCK_SCAN`` env toggle (default off)."""
+    if flag is not None:
+        return bool(flag)
+    import os
+    return os.environ.get('TIMM_TPU_BLOCK_SCAN', '').lower() in ('1', 'true', 'yes', 'on')
+
+
+_SCAN_FALLBACK_WARNED = set()
+
+
+def warn_scan_fallback(model_name: str, err):
+    """Log (once per model-class/reason) that block_scan fell back to the loop."""
+    key = (model_name, str(err))
+    if key not in _SCAN_FALLBACK_WARNED:
+        _SCAN_FALLBACK_WARNED.add(key)
+        import logging
+        logging.getLogger(__name__).warning(
+            f'{model_name}: block_scan fell back to the Python block loop: {err}')
+
+
+def iter_submodules(module):
+    """Yield `module` and every nnx.Module reachable through its attributes
+    (including list/tuple containers), in deterministic attribute order."""
+    from flax import nnx
+    seen = set()
+
+    def _walk(m):
+        if id(m) in seen:
+            return
+        seen.add(id(m))
+        yield m
+        for v in vars(m).values():
+            if isinstance(v, nnx.Module):
+                yield from _walk(v)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if isinstance(item, nnx.Module):
+                        yield from _walk(item)
+
+    yield from _walk(module)
+
+
+_MEM_ADDR_RE = re.compile(r'0x[0-9a-fA-F]+')
+
+
+def _masked_graphdef_repr(graphdef) -> str:
+    """Graphdef repr with memory addresses masked: per-block init-fn closures
+    (`trunc_normal_.<locals>.init at 0x...`) are identity-distinct but
+    computation-irrelevant, while genuinely different statics (a depth-indexed
+    lambda_init float, a different submodule layout) stay visible."""
+    return _MEM_ADDR_RE.sub('0x', repr(graphdef))
+
+
+def build_block_stack(blocks, validate: bool = True):
+    """Split a homogeneous block list into ``(graphdef, rng_state, stacked)``
+    where ``stacked`` is the blocks' non-RNG state with a leading depth axis.
+
+    DropPath statics (per-layer rate float + forked stream) are neutralized
+    before splitting so a linearly-ramped stochastic-depth schedule doesn't
+    make the graphdefs heterogeneous: in scan mode the per-layer rates ride a
+    scanned rate vector and the keys are drawn eagerly outside the scan
+    (see `drop_path_scan_inputs`), so the merged blocks' DropPath modules must
+    be structural no-ops.
+
+    Raises BlockStackError when stacking is impossible or would silently
+    change semantics (different block types, depth-dependent statics, live
+    inner-dropout RNG that the scan body could not advance).
+    """
+    import jax
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from ..layers.drop import DropPath
+
+    blocks = list(blocks)
+    if len(blocks) < 2:
+        raise BlockStackError('need at least 2 blocks to scan')
+    if any(type(b) is not type(blocks[0]) for b in blocks[1:]):
+        raise BlockStackError(
+            f'heterogeneous block types: {sorted({type(b).__name__ for b in blocks})}')
+
+    if validate:
+        # an inner Dropout with a live stream would consume RNG state inside
+        # the scan body with no way to write the advanced counts back — every
+        # step would reuse the same mask. DropPath is exempt (handled via the
+        # scanned rate vector + eagerly drawn keys).
+        for b in blocks:
+            for sm in iter_submodules(b):
+                if isinstance(sm, nnx.Dropout) and sm.rngs is not None \
+                        and not sm.deterministic and sm.rate > 0:
+                    raise BlockStackError(
+                        'active inner dropout (train mode, rate>0) cannot run under scan')
+
+    dp_saved = []
+    for b in blocks:
+        for sm in iter_submodules(b):
+            if isinstance(sm, DropPath):
+                dp_saved.append((sm, sm.drop_prob, sm.rngs))
+                sm.drop_prob = 0.0
+                sm.rngs = None
+    try:
+        splits = [nnx.split(b, nnx.RngState, ...) for b in blocks]
+    finally:
+        for sm, p, r in dp_saved:
+            sm.drop_prob = p
+            sm.rngs = r
+
+    graphdef, rng_state, _ = splits[0]
+    if validate:
+        ref = _masked_graphdef_repr(graphdef)
+        for i, (gd, _, _) in enumerate(splits[1:], start=1):
+            if _masked_graphdef_repr(gd) != ref:
+                raise BlockStackError(
+                    f'block 0 and block {i} differ in static structure '
+                    '(depth-dependent statics or layout)')
+    try:
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *[s[2] for s in splits])
+    except (ValueError, TypeError) as e:
+        raise BlockStackError(f'block states are not stackable: {e}') from e
+    return graphdef, rng_state, stacked
+
+
+def drop_path_scan_inputs(blocks):
+    """Per-layer DropPath inputs for scan mode: ``(rates[L, S], keys[L, S])``
+    over the S DropPath sites of each of the L blocks, or None when no site is
+    active (eval mode, or every rate 0). Keys are drawn from each block's own
+    forked stream — the stream counts advance exactly as in loop mode."""
+    import jax.numpy as jnp
+
+    from ..layers.drop import DropPath
+
+    rows = [[sm for sm in iter_submodules(b) if isinstance(sm, DropPath)] for b in blocks]
+    n_sites = len(rows[0])
+    if n_sites == 0 or any(len(r) != n_sites for r in rows):
+        return None
+    if not any(m.drop_prob > 0 and m.rngs is not None and not m.deterministic
+               for row in rows for m in row):
+        return None
+    rates, keys, ref_key = [], [], None
+    for row in rows:
+        rrow, krow = [], []
+        for m in row:
+            live = m.rngs is not None and not m.deterministic and m.drop_prob > 0
+            rrow.append(m.drop_prob if live else 0.0)
+            k = m.rngs.dropout() if live else None
+            if k is not None:
+                ref_key = k
+            krow.append(k)
+        rates.append(rrow)
+        keys.append(krow)
+    # rate-0 sites keep everything regardless of key; reuse a drawn key there
+    keys = [[k if k is not None else ref_key for k in row] for row in keys]
+    return (jnp.asarray(rates, jnp.float32),
+            jnp.stack([jnp.stack(row) for row in keys]))
+
+
+def scan_block_stack(blocks, x, call_block=None, *, per_layer=None, remat: bool = False,
+                     remat_policy=None, collect: bool = False, validate: bool = True):
+    """Run a homogeneous block list as one ``jax.lax.scan`` over stacked
+    per-layer state: trace/compile cost is O(1) in depth.
+
+    ``call_block(block, x, extra)`` runs one merged block; ``extra`` is the
+    per-layer slice of the ``per_layer`` pytree (or None). ``remat=True``
+    wraps the body in `jax.checkpoint` (remat-inside-scan replaces
+    `checkpoint_seq` for scanned stacks). ``collect=True`` additionally
+    returns the stacked per-layer outputs ``[L, ...]`` (forward_intermediates).
+    """
+    import jax
+
+    graphdef, rng_state, stacked = build_block_stack(blocks, validate=validate)
+    if call_block is None:
+        call_block = lambda blk, xx, extra: blk(xx)
+
+    from flax import nnx
+
+    def body(carry, xs):
+        layer_state, extra = xs
+        blk = nnx.merge(graphdef, rng_state, layer_state)
+        y = call_block(blk, carry, extra)
+        return y, (y if collect else None)
+
+    if remat:
+        body = jax.checkpoint(body, policy=remat_policy)
+    out, ys = jax.lax.scan(body, x, (stacked, per_layer))
+    return (out, ys) if collect else out
 
 
 def checkpoint_seq(functions, x, every: int = 1, flatten: bool = False, skip_last: bool = False,

@@ -24,11 +24,14 @@ from ..layers import (
     trunc_normal_, zeros_,
 )
 from ..layers.attention import scaled_dot_product_attention
-from ..layers.drop import dropout_rng_key
+from ..layers.drop import apply_drop_path, dropout_rng_key
 from ..layers.pos_embed_rel import RelPosBias
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
-from ._manipulate import checkpoint_seq
+from ._manipulate import (
+    BlockStackError, checkpoint_seq, drop_path_scan_inputs, resolve_block_scan,
+    scan_block_stack, warn_scan_fallback,
+)
 from ._registry import generate_default_cfgs, register_model
 
 __all__ = ['Beit', 'BeitBlock', 'BeitAttention']
@@ -165,15 +168,15 @@ class BeitBlock(nnx.Module):
             self.gamma_1 = None
             self.gamma_2 = None
 
-    def __call__(self, x, shared_rel_pos_bias=None):
+    def __call__(self, x, shared_rel_pos_bias=None, drop_path_override=None):
         y = self.attn(self.norm1(x), shared_rel_pos_bias=shared_rel_pos_bias)
         if self.gamma_1 is not None:
             y = y * self.gamma_1[...].astype(y.dtype)
-        x = x + self.drop_path1(y)
+        x = x + apply_drop_path(y, self.drop_path1, drop_path_override, 0)
         y = self.mlp(self.norm2(x))
         if self.gamma_2 is not None:
             y = y * self.gamma_2[...].astype(y.dtype)
-        x = x + self.drop_path2(y)
+        x = x + apply_drop_path(y, self.drop_path2, drop_path_override, 1)
         return x
 
 
@@ -205,6 +208,7 @@ class Beit(nnx.Module):
             use_rel_pos_bias: bool = False,
             use_shared_rel_pos_bias: bool = False,
             head_init_scale: float = 0.001,
+            block_scan: Optional[bool] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -216,6 +220,7 @@ class Beit(nnx.Module):
         self.num_features = self.head_hidden_size = self.embed_dim = embed_dim
         self.num_prefix_tokens = 1
         self.grad_checkpointing = False
+        self.block_scan = resolve_block_scan(block_scan)
 
         self.patch_embed = PatchEmbed(
             img_size=img_size, patch_size=patch_size, in_chans=in_chans,
@@ -299,6 +304,10 @@ class Beit(nnx.Module):
     def set_grad_checkpointing(self, enable: bool = True):
         self.grad_checkpointing = enable
 
+    def set_block_scan(self, enable: bool = True):
+        """Toggle scan-over-layers block execution (see VisionTransformer)."""
+        self.block_scan = enable
+
     def get_classifier(self):
         return self.head
 
@@ -323,6 +332,20 @@ class Beit(nnx.Module):
         x = self.pos_drop(x)
 
         shared_bias = self.rel_pos_bias.get_bias() if self.rel_pos_bias is not None else None
+        if self.block_scan:
+            try:
+                dp = drop_path_scan_inputs(self.blocks)
+
+                def call(blk, xx, extra):
+                    return blk(xx, shared_rel_pos_bias=shared_bias, drop_path_override=extra)
+
+                x = scan_block_stack(
+                    self.blocks, x, call, per_layer=dp, remat=self.grad_checkpointing)
+                if self.norm is not None:
+                    x = self.norm(x)
+                return x
+            except BlockStackError as e:
+                warn_scan_fallback(type(self).__name__, e)
         if self.grad_checkpointing:
             if shared_bias is None:
                 x = checkpoint_seq(self.blocks, x)

@@ -20,9 +20,13 @@ from ..layers import (
     calculate_drop_path_rates, create_rope_embed, get_norm_layer,
     global_pool_nlc, resample_abs_pos_embed, to_2tuple, trunc_normal_, zeros_,
 )
+from ..layers.drop import apply_drop_path
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
-from ._manipulate import checkpoint_seq
+from ._manipulate import (
+    BlockStackError, checkpoint_seq, drop_path_scan_inputs, resolve_block_scan,
+    scan_block_stack, warn_scan_fallback,
+)
 from ._registry import generate_default_cfgs, register_model
 
 __all__ = ['Eva', 'EvaBlock', 'EvaAttention']
@@ -224,19 +228,22 @@ class EvaBlock(nnx.Module):
         self.ls2 = LayerScale(dim, init_values, param_dtype=param_dtype, rngs=rngs) if init_values else None
         self.drop_path2 = DropPath(drop_path, rngs=rngs)
 
-    def __call__(self, x, rope=None, attn_mask=None):
+    def __call__(self, x, rope=None, attn_mask=None, drop_path_override=None):
         if self.use_post_norm:
-            x = x + self.drop_path1(self.norm1(self.attn(x, rope=rope, attn_mask=attn_mask)))
-            x = x + self.drop_path2(self.norm2(self.mlp(x)))
+            x = x + apply_drop_path(
+                self.norm1(self.attn(x, rope=rope, attn_mask=attn_mask)),
+                self.drop_path1, drop_path_override, 0)
+            x = x + apply_drop_path(
+                self.norm2(self.mlp(x)), self.drop_path2, drop_path_override, 1)
             return x
         y = self.attn(self.norm1(x), rope=rope, attn_mask=attn_mask)
         if self.ls1 is not None:
             y = self.ls1(y)
-        x = x + self.drop_path1(y)
+        x = x + apply_drop_path(y, self.drop_path1, drop_path_override, 0)
         y = self.mlp(self.norm2(x))
         if self.ls2 is not None:
             y = self.ls2(y)
-        x = x + self.drop_path2(y)
+        x = x + apply_drop_path(y, self.drop_path2, drop_path_override, 1)
         return x
 
 
@@ -286,6 +293,7 @@ class Eva(nnx.Module):
             dynamic_img_size: bool = False,
             norm_layer: Optional[Union[str, Callable]] = None,
             act_layer: Union[str, Callable] = 'gelu',
+            block_scan: Optional[bool] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -301,6 +309,7 @@ class Eva(nnx.Module):
         self.no_embed_class = no_embed_class
         self.dynamic_img_size = dynamic_img_size
         self.grad_checkpointing = False
+        self.block_scan = resolve_block_scan(block_scan)
 
         # norm / pool placement (reference eva.py:643-651)
         activate_pre_norm = use_pre_transformer_norm
@@ -425,6 +434,11 @@ class Eva(nnx.Module):
     def set_grad_checkpointing(self, enable: bool = True):
         self.grad_checkpointing = enable
 
+    def set_block_scan(self, enable: bool = True):
+        """Toggle scan-over-layers block execution (see VisionTransformer).
+        Mixed-rope models thread their per-depth rope table through the scan."""
+        self.block_scan = enable
+
     def get_classifier(self):
         return self.head
 
@@ -475,6 +489,24 @@ class Eva(nnx.Module):
         return self.pos_drop(x), rope
 
     def _forward_blocks(self, x, rope, attn_mask=None):
+        if self.block_scan:
+            try:
+                dp = drop_path_scan_inputs(self.blocks)
+                # mixed rope is a per-depth table: thread it through the scan
+                # as data; a shared rope table is a closure constant
+                mixed = self.rope_mixed and rope is not None
+                per_layer = {'dp': dp, 'rope': rope if mixed else None}
+
+                def call(blk, xx, extra):
+                    blk_rope = extra['rope'] if mixed else rope
+                    return blk(xx, rope=blk_rope, attn_mask=attn_mask,
+                               drop_path_override=extra['dp'])
+
+                return scan_block_stack(
+                    self.blocks, x, call, per_layer=per_layer,
+                    remat=self.grad_checkpointing)
+            except BlockStackError as e:
+                warn_scan_fallback(type(self).__name__, e)
         remat_block = None
         if self.grad_checkpointing:
             def run_block(blk, x_, rope_, mask_):

@@ -26,9 +26,13 @@ from ..layers import (
     get_act_fn, get_norm_layer, global_pool_nlc, maybe_add_mask,
     resample_abs_pos_embed, scaled_dot_product_attention, trunc_normal_, zeros_,
 )
+from ..layers.drop import apply_drop_path
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
-from ._manipulate import checkpoint_seq
+from ._manipulate import (
+    BlockStackError, checkpoint_seq, drop_path_scan_inputs, resolve_block_scan,
+    scan_block_stack, warn_scan_fallback,
+)
 from ._registry import generate_default_cfgs, register_model
 
 __all__ = ['VisionTransformer', 'Block', 'ResPostBlock']
@@ -94,15 +98,15 @@ class Block(nnx.Module):
         self.ls2 = LayerScale(dim, init_values=init_values, param_dtype=param_dtype, rngs=rngs) if init_values else None
         self.drop_path2 = DropPath(drop_path, rngs=rngs)
 
-    def __call__(self, x, attn_mask=None):
+    def __call__(self, x, attn_mask=None, drop_path_override=None):
         y = self.attn(self.norm1(x), attn_mask=attn_mask)
         if self.ls1 is not None:
             y = self.ls1(y)
-        x = x + self.drop_path1(y)
+        x = x + apply_drop_path(y, self.drop_path1, drop_path_override, 0)
         y = self.mlp(self.norm2(x))
         if self.ls2 is not None:
             y = self.ls2(y)
-        x = x + self.drop_path2(y)
+        x = x + apply_drop_path(y, self.drop_path2, drop_path_override, 1)
         return x
 
 
@@ -155,9 +159,11 @@ class ResPostBlock(nnx.Module):
             self.norm1.scale[...] = self.norm1.scale[...] * init_values
             self.norm2.scale[...] = self.norm2.scale[...] * init_values
 
-    def __call__(self, x, attn_mask=None):
-        x = x + self.drop_path1(self.norm1(self.attn(x, attn_mask=attn_mask)))
-        x = x + self.drop_path2(self.norm2(self.mlp(x)))
+    def __call__(self, x, attn_mask=None, drop_path_override=None):
+        x = x + apply_drop_path(
+            self.norm1(self.attn(x, attn_mask=attn_mask)), self.drop_path1, drop_path_override, 0)
+        x = x + apply_drop_path(
+            self.norm2(self.mlp(x)), self.drop_path2, drop_path_override, 1)
         return x
 
 
@@ -511,6 +517,7 @@ class VisionTransformer(nnx.Module):
             mlp_layer: Callable = Mlp,
             attn_layer: Optional[Union[str, Callable]] = None,
             pad_tokens_to: Optional[Union[int, str]] = None,
+            block_scan: Optional[bool] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -552,6 +559,10 @@ class VisionTransformer(nnx.Module):
         self.dynamic_img_size = dynamic_img_size
         self.grad_checkpointing = False
         self.depth = depth
+        # scan-over-layers execution: one lax.scan over stacked per-layer
+        # params instead of a Python loop over L traced block subgraphs —
+        # O(1)-in-depth trace/compile. None → TIMM_TPU_BLOCK_SCAN env toggle.
+        self.block_scan = resolve_block_scan(block_scan)
 
         embed_args = {}
         if dynamic_img_size:
@@ -687,6 +698,12 @@ class VisionTransformer(nnx.Module):
     def set_grad_checkpointing(self, enable: bool = True):
         self.grad_checkpointing = enable
 
+    def set_block_scan(self, enable: bool = True):
+        """Toggle scan-over-layers execution of the block stack. When the
+        stack is not scannable (heterogeneous blocks, active inner dropout),
+        each forward transparently falls back to the Python loop (logged once)."""
+        self.block_scan = enable
+
     def get_classifier(self):
         return self.head
 
@@ -802,15 +819,48 @@ class VisionTransformer(nnx.Module):
             x = self.patch_drop(x)
         if self.norm_pre is not None:
             x = self.norm_pre(x)
-        if self.grad_checkpointing and attn_mask is None:
-            x = checkpoint_seq(self.blocks, x)
-        else:
-            for blk in self.blocks:
-                x = blk(x, attn_mask=attn_mask)
+        x = self._forward_block_stack(x, attn_mask=attn_mask)
         if self.norm is not None:
             x = self.norm(x)
         if x.shape[1] != orig_len:
             x = x[:, :orig_len]  # strip the alignment pad before the head
+        return x
+
+    def _forward_block_stack(self, x, attn_mask=None, collect=False, blocks=None):
+        """Execute the block stack. With `block_scan` on and a homogeneous
+        stack: one lax.scan over stacked per-layer params (O(1)-in-depth
+        trace/compile; remat-inside-scan replaces checkpoint_seq when grad
+        checkpointing is on; per-layer DropPath rates ride a scanned rate
+        vector). Otherwise: the Python loop (checkpoint_seq when grad
+        checkpointing and unmasked). `collect=True` additionally returns the
+        list of per-layer outputs (forward_intermediates)."""
+        blocks = self.blocks if blocks is None else blocks
+        if self.block_scan:
+            try:
+                dp = drop_path_scan_inputs(blocks)
+
+                def call(blk, xx, extra):
+                    return blk(xx, attn_mask=attn_mask, drop_path_override=extra)
+
+                out = scan_block_stack(
+                    blocks, x, call, per_layer=dp,
+                    remat=self.grad_checkpointing, collect=collect)
+                if collect:
+                    final, ys = out
+                    return final, [ys[i] for i in range(ys.shape[0])]
+                return out
+            except BlockStackError as e:
+                warn_scan_fallback(type(self).__name__, e)
+        if collect:
+            outs = []
+            for blk in blocks:
+                x = blk(x, attn_mask=attn_mask)
+                outs.append(x)
+            return x, outs
+        if self.grad_checkpointing and attn_mask is None:
+            return checkpoint_seq(blocks, x)
+        for blk in blocks:
+            x = blk(x, attn_mask=attn_mask)
         return x
 
     def pool(self, x, pool_type: Optional[str] = None, mask=None):
@@ -848,7 +898,15 @@ class VisionTransformer(nnx.Module):
             intermediates_only: bool = False,
             attn_mask=None,
     ):
-        """Collect intermediate block outputs (reference vision_transformer.py:1077)."""
+        """Collect intermediate block outputs (reference vision_transformer.py:1077).
+
+        With `block_scan` on, the full-depth path runs the scan with stacked
+        per-layer outputs and gathers `indices` from them. `stop_early=True`
+        slices the Python block list, which a stacked scan cannot represent —
+        that path (like a pruned model, see `prune_intermediate_layers`) always
+        uses the Python loop, so results never silently disagree with the
+        sliced `self.blocks`.
+        """
         assert output_fmt in ('NHWC', 'NLC'), 'Output format must be NHWC or NLC.'
         reshape = output_fmt == 'NHWC'
         take_indices, max_index = feature_take_indices(len(self.blocks), indices)
@@ -864,12 +922,18 @@ class VisionTransformer(nnx.Module):
         if self.norm_pre is not None:
             x = self.norm_pre(x)
 
-        intermediates = []
-        blocks = self.blocks if not stop_early else self.blocks[:max_index + 1]
-        for i, blk in enumerate(blocks):
-            x = blk(x, attn_mask=attn_mask)
-            if i in take_indices:
-                intermediates.append(self.norm(x) if (norm and self.norm is not None) else x)
+        if stop_early:
+            # scan runs the full stacked depth; early stop needs the loop
+            intermediates = []
+            for i, blk in enumerate(self.blocks[:max_index + 1]):
+                x = blk(x, attn_mask=attn_mask)
+                if i in take_indices:
+                    intermediates.append(self.norm(x) if (norm and self.norm is not None) else x)
+        else:
+            x, outs = self._forward_block_stack(x, attn_mask=attn_mask, collect=True)
+            intermediates = [
+                self.norm(outs[i]) if (norm and self.norm is not None) else outs[i]
+                for i in range(len(outs)) if i in take_indices]
 
         # split prefix tokens, reshape spatial
         prefix_tokens = None
@@ -894,6 +958,9 @@ class VisionTransformer(nnx.Module):
             prune_norm: bool = False,
             prune_head: bool = True,
     ):
+        """Safe under `block_scan`: the scan stacks whatever `self.blocks`
+        currently holds at call time, so a pruned stack scans at its pruned
+        depth (and a single remaining block falls back to the loop)."""
         take_indices, max_index = feature_take_indices(len(self.blocks), indices)
         self.blocks = nnx.List(list(self.blocks)[:max_index + 1])
         if prune_norm:

@@ -70,9 +70,16 @@ def replicate_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(batch, mesh: Optional[Mesh] = None):
-    """Place a host batch (pytree of arrays) sharded over the mesh batch axis."""
+    """Place a host batch (pytree of arrays) sharded over the mesh batch axis.
+    Non-array leaves pass through; 0-d arrays are replicated (a rank-0 value
+    has no batch dim to shard — seq_len/step counters in dict batches)."""
     mesh = mesh or get_global_mesh()
 
     def put(x):
-        return jax.device_put(x, data_sharding(mesh, ndim=getattr(x, 'ndim', 1)))
+        ndim = getattr(x, 'ndim', None)
+        if ndim is None:
+            return x
+        if ndim == 0:
+            return jax.device_put(x, replicate_sharding(mesh))
+        return jax.device_put(x, data_sharding(mesh, ndim=ndim))
     return jax.tree.map(put, batch)

@@ -114,6 +114,19 @@ class TrainingTask:
         self.ema_params = jax.tree.map(jnp.asarray, nnx.state(self.model, nnx.Param))
         self._train_step = None  # EMA presence is baked into the jitted step; rebuild
 
+    def set_block_scan(self, enable: bool = True) -> bool:
+        """Toggle scan-over-layers execution on the owned model (and its
+        sync'd EMA clone, which inherits the flag at sync time). The jitted
+        steps are invalidated explicitly: block_scan is a static model attr,
+        so a stale traced step would silently keep the old execution mode on
+        flax versions whose jit cache ignores attr-only graphdef changes."""
+        if not hasattr(self.model, 'set_block_scan'):
+            return False
+        self.model.set_block_scan(enable)
+        self._train_step = None
+        self._eval_step = None
+        return True
+
     def compile(self, backend: str = ''):
         self.compiled = True  # parity no-op; nnx.jit is always on (task.py:90)
 

@@ -1,0 +1,105 @@
+"""Persistent XLA compilation cache + compile-cost reporting utilities.
+
+JAX's persistent compilation cache makes compiled executables durable across
+processes: a second cold process re-loading the same program pays only a disk
+read instead of a full XLA compile. Until this module existed, the warm
+``/tmp/timm_tpu_xla_cache`` that tier-1's wall-clock budget depends on was set
+only by tests/conftest.py — entry-script runs (train/validate/inference/bench)
+recompiled everything from scratch every process.
+
+One subtlety this module handles: JAX latches its "is the cache enabled?"
+decision at the FIRST compilation of the process (``_cache_checked`` in
+``jax._src.compilation_cache``). Setting ``jax_compilation_cache_dir`` after
+any jit has run silently does nothing. ``configure_compile_cache`` therefore
+resets the cache state after (re)configuring so late configuration still takes
+effect.
+
+Environment knobs:
+  TIMM_TPU_COMPILE_CACHE            cache dir; '', '0' or 'off' disables.
+                                    (TIMM_TPU_XLA_CACHE is honored as a
+                                    legacy fallback spelling.)
+  TIMM_TPU_COMPILE_CACHE_MIN_ENTRY_BYTES    min executable size to persist
+                                            (default 0 = everything)
+  TIMM_TPU_COMPILE_CACHE_MIN_COMPILE_SECS   min compile time to persist
+                                            (default 0.5s)
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+_logger = logging.getLogger(__name__)
+
+DEFAULT_CACHE_DIR = '/tmp/timm_tpu_xla_cache'
+
+_DISABLED = ('', '0', 'off', 'false', 'none')
+
+
+def resolve_cache_dir(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Explicit arg > TIMM_TPU_COMPILE_CACHE > legacy TIMM_TPU_XLA_CACHE >
+    DEFAULT_CACHE_DIR. Returns None when disabled."""
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            'TIMM_TPU_COMPILE_CACHE',
+            os.environ.get('TIMM_TPU_XLA_CACHE', DEFAULT_CACHE_DIR))
+    if cache_dir is None or cache_dir.strip().lower() in _DISABLED:
+        return None
+    return cache_dir
+
+
+def configure_compile_cache(
+        cache_dir: Optional[str] = None,
+        min_entry_size_bytes: Optional[int] = None,
+        min_compile_time_secs: Optional[float] = None,
+) -> Optional[str]:
+    """Point JAX's persistent compilation cache at a durable directory.
+
+    Call at process start (all four entry scripts and the tier-1 conftest do)
+    so every compile in the process is eligible. Returns the configured dir,
+    or None when disabled. Safe to call more than once and after jits have
+    already run (the cache-enabled latch is reset).
+    """
+    import jax
+
+    cache_dir = resolve_cache_dir(cache_dir)
+    if cache_dir is None:
+        return None
+    if min_entry_size_bytes is None:
+        min_entry_size_bytes = int(os.environ.get('TIMM_TPU_COMPILE_CACHE_MIN_ENTRY_BYTES', '0'))
+    if min_compile_time_secs is None:
+        min_compile_time_secs = float(os.environ.get('TIMM_TPU_COMPILE_CACHE_MIN_COMPILE_SECS', '0.5'))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', min_entry_size_bytes)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', min_compile_time_secs)
+    except Exception as e:  # out-of-tree jax without these flags: degrade loudly
+        _logger.warning(f'persistent compile cache not configured: {e}')
+        return None
+    try:
+        # un-latch the once-per-process enabled check so configuration after
+        # an early jit (imports, probes) still takes effect
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:
+        pass
+    return cache_dir
+
+
+def count_jaxpr_eqns(jaxpr) -> int:
+    """Total equation count of a (closed) jaxpr including nested sub-jaxprs
+    (scan/while/cond bodies, remat). The proxy for trace/lowering cost: a
+    Python block loop contributes O(depth) equations, a scanned stack O(1)."""
+    jaxpr = getattr(jaxpr, 'jaxpr', jaxpr)
+    n = 0
+    for eqn in jaxpr.eqns:
+        n += 1
+        for v in eqn.params.values():
+            if hasattr(v, 'jaxpr'):
+                n += count_jaxpr_eqns(v)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if hasattr(item, 'jaxpr'):
+                        n += count_jaxpr_eqns(item)
+    return n

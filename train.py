@@ -58,6 +58,12 @@ def make_parser():
     group.add_argument('--drop-path', type=float, default=None, metavar='PCT')
     group.add_argument('--grad-accum-steps', type=int, default=1, metavar='N')
     group.add_argument('--grad-checkpointing', action='store_true', default=False)
+    group.add_argument('--block-scan', action='store_true', default=False,
+                       help='run homogeneous transformer block stacks as one lax.scan '
+                            'over stacked per-layer params (O(1)-in-depth trace/compile)')
+    group.add_argument('--device-prefetch', type=int, default=0, metavar='N',
+                       help='keep N batches in flight on device (async host->device '
+                            'transfer overlapped with the step); 0 disables')
     group.add_argument('--amp', action='store_true', default=False,
                        help='bf16 compute (the TPU-native AMP)')
     group.add_argument('--amp-dtype', default='bfloat16', type=str)
@@ -245,6 +251,10 @@ def main():
 
     setup_default_logging()
     args, args_text = _parse_args()
+    # durable compiles: every process reuses the on-disk XLA executable cache
+    # (TIMM_TPU_COMPILE_CACHE; see timm_tpu/utils/compile_cache.py)
+    from timm_tpu.utils import configure_compile_cache
+    configure_compile_cache()
     if args.fault_inject:
         set_fault_injector(args.fault_inject)
     if args.device:
@@ -289,6 +299,11 @@ def main():
         args.num_classes = model.num_classes
     if args.grad_checkpointing:
         model.set_grad_checkpointing(True)
+    if args.block_scan:
+        if hasattr(model, 'set_block_scan'):
+            model.set_block_scan(True)
+        else:
+            _logger.warning(f'--block-scan: {args.model} has no scannable block stack; ignored')
 
     # AugMix aug-splits (reference train.py:886-913): wrap BNs with per-split
     # statistics before the optimizer captures the param tree
@@ -463,6 +478,17 @@ def main():
                 mixup_alpha=args.mixup, cutmix_alpha=args.cutmix, cutmix_minmax=args.cutmix_minmax,
                 prob=args.mixup_prob, switch_prob=args.mixup_switch_prob, mode=args.mixup_mode,
                 label_smoothing=args.smoothing, num_classes=args.num_classes)
+
+    if args.device_prefetch:
+        from timm_tpu.data.loader import DevicePrefetcher
+        loader_eval = DevicePrefetcher(loader_eval, size=args.device_prefetch)
+        if mixup_fn is None and args.grad_accum_steps == 1:
+            loader_train = DevicePrefetcher(loader_train, size=args.device_prefetch)
+        else:
+            # mixup / grad-accum concatenation still mutate batches on host;
+            # prefetching to device first would bounce them straight back
+            _logger.info('--device-prefetch: train loader stays on host '
+                         '(mixup or --grad-accum-steps > 1 active); eval loader prefetches')
 
     # scheduler
     try:

@@ -55,6 +55,10 @@ parser.add_argument('--model-list', default='', type=str, metavar='FILENAME or W
                     help='evaluate a list/wildcard of models in sequence')
 parser.add_argument('--retry', default=False, action='store_true',
                     help='halve batch size and retry on resource exhaustion')
+parser.add_argument('--block-scan', action='store_true', default=False,
+                    help='scan-over-layers block execution (O(1)-in-depth trace/compile)')
+parser.add_argument('--device-prefetch', type=int, default=0, metavar='N',
+                    help='keep N batches in flight on device while the step runs; 0 disables')
 
 
 def validate(args):
@@ -68,6 +72,8 @@ def validate(args):
         # must land before the first device op; env JAX_PLATFORMS loses to the
         # axon plugin's sitecustomize registration
         jax.config.update('jax_platforms', args.device)
+    from timm_tpu.utils import configure_compile_cache
+    configure_compile_cache()
     mesh = create_mesh()
     set_global_mesh(mesh)
 
@@ -87,6 +93,11 @@ def validate(args):
     num_classes = args.num_classes or model.num_classes
     if args.checkpoint:
         load_checkpoint(model, args.checkpoint, use_ema=args.use_ema)
+    if args.block_scan:
+        if hasattr(model, 'set_block_scan'):
+            model.set_block_scan(True)
+        else:
+            _logger.warning(f'--block-scan: {args.model} has no scannable block stack; ignored')
     model.eval()
 
     data_config = resolve_data_config(vars(args), model=model)
@@ -117,6 +128,7 @@ def validate(args):
         num_workers=args.workers,
         crop_pct=data_config['crop_pct'],
         crop_mode=data_config['crop_mode'],
+        device_prefetch=args.device_prefetch,
     )
 
     real_labels = None

@@ -33,7 +33,6 @@ reports value 0.0 and a nonzero exit code — never a stale number.
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import os
 import subprocess
@@ -169,6 +168,10 @@ def _run_child(args, timeout_s: int) -> dict | None:
     # precision/alignment A/B levers must reach the measurement process
     if args.block_scan:
         cmd += ['--block-scan']
+    if args.fsdp:
+        cmd += ['--fsdp', str(args.fsdp)]
+    if args.no_donate:
+        cmd += ['--no-donate']
     if args.pad_tokens:
         cmd += ['--pad-tokens', str(args.pad_tokens)]
     if args.softmax_dtype:
@@ -256,6 +259,12 @@ def main():
     parser.add_argument('--block-scan', action='store_true', default=False,
                         help='scan-over-layers block execution: one lax.scan over '
                              'stacked per-layer params (O(1)-in-depth trace/compile)')
+    parser.add_argument('--fsdp', type=int, default=0, metavar='N',
+                        help='shard params + optimizer state over an N-way fsdp mesh '
+                             "axis (ZeRO-style; mesh becomes ('data', 'fsdp')); 0 = off")
+    parser.add_argument('--no-donate', action='store_true', default=False,
+                        help='disable buffer donation of params/opt state in the jitted '
+                             'step (A/B the input-output aliasing win)')
     parser.add_argument('--compile-report', action='store_true', default=False,
                         help='CPU compile-cost report: cold trace ms / cold compile ms / '
                              'warm-disk-cache ms / jaxpr equation counts, scan off vs on '
@@ -385,31 +394,54 @@ def _dry_run(args) -> int:
     import timm_tpu
     from timm_tpu.loss import cross_entropy
     from timm_tpu.optim import create_optimizer_v2
+    from timm_tpu.parallel import (
+        build_opt_shardings, build_param_shardings, create_mesh, set_global_mesh, shard_batch,
+    )
     from timm_tpu.utils import configure_compile_cache
 
     configure_compile_cache()
+    # single-device mesh unless --fsdp is being smoked: SPMD-partitioning the
+    # tiny dry-run program over every visible device multiplies its compile
+    # cost for no extra coverage (the flag-combination sweep runs 9 of these)
+    fsdp = getattr(args, 'fsdp', 0)
+    mesh = create_mesh(fsdp=fsdp) if fsdp else create_mesh(devices=jax.devices()[:1])
+    set_global_mesh(mesh)
     model_kwargs, opt_kwargs, tag = _apply_precision_knobs(args)
     img = min(args.img_size, 64)  # tiny input: the gate is "traces + runs", not perf
     model = timm_tpu.create_model(args.model, img_size=img, **model_kwargs)
     if getattr(args, 'block_scan', False) and hasattr(model, 'set_block_scan'):
         model.set_block_scan(True)
         tag += ' [block_scan]'
+    if getattr(args, 'fsdp', 0):
+        tag += f' [fsdp={args.fsdp}]'
+    if getattr(args, 'no_donate', False):
+        tag += ' [no-donate]'
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(2, img, img, 3), jnp.float32)
-    t = jnp.asarray(rng.randint(0, model.num_classes, 2))
+    n = max(2, mesh.size)  # batch must divide over the mesh batch axes
+    batch = shard_batch({'x': jnp.asarray(rng.rand(n, img, img, 3), jnp.float32),
+                         't': jnp.asarray(rng.randint(0, model.num_classes, n))}, mesh)
+    x, t = batch['x'], batch['t']
 
     model.train()
     opt = create_optimizer_v2(model, opt='adamw', lr=1e-3, weight_decay=0.05, **opt_kwargs)
     graphdef, params, rest = nnx.split(model, nnx.Param, ...)
-    opt_state = opt.init(params)
+    param_sh = build_param_shardings(params, mesh)
+    opt_sh, _ = build_opt_shardings(opt, params, mesh)
+    params = jax.device_put(params, param_sh)
+    opt_state = jax.jit(opt.init, out_shardings=opt_sh)(params)  # no-donate: init
 
-    def loss_fn(p):
-        m = nnx.merge(graphdef, p, rest)
-        return cross_entropy(m(x), t)
+    def train_step(p, o):
+        def loss_fn(p):
+            m = nnx.merge(graphdef, p, rest)
+            return cross_entropy(m(x), t)
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = opt.update(grads, o, p, lr=1e-3)
+        return optax.apply_updates(p, updates), o, loss
 
-    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
-    updates, opt_state = opt.update(grads, opt_state, params, lr=1e-3)
-    params = optax.apply_updates(params, updates)
+    donate = () if getattr(args, 'no_donate', False) else (0, 1)
+    params, opt_state, loss = jax.jit(
+        train_step, donate_argnums=donate,
+        in_shardings=(param_sh, opt_sh), out_shardings=(param_sh, opt_sh, None))(params, opt_state)
     model = nnx.merge(graphdef, params, rest)
     model.eval()
     logits = model(x)
@@ -565,12 +597,15 @@ def _measure(args) -> int:
     import timm_tpu
     from timm_tpu.loss import cross_entropy
     from timm_tpu.optim import create_optimizer_v2
-    from timm_tpu.parallel import create_mesh, data_sharding, set_global_mesh
+    from timm_tpu.parallel import (
+        build_opt_shardings, build_param_shardings, create_mesh, data_sharding,
+        replicate_sharding, set_global_mesh,
+    )
     from timm_tpu.utils import configure_compile_cache
 
     configure_compile_cache()
 
-    mesh = create_mesh()
+    mesh = create_mesh(fsdp=args.fsdp if args.fsdp else None)
     set_global_mesh(mesh)
     n_chips = mesh.size
     # bs128/chip benched fastest for ViT-B train on v5e with the einsum
@@ -598,12 +633,19 @@ def _measure(args) -> int:
         model.train()
         opt = create_optimizer_v2(model, opt='adamw', lr=1e-3, weight_decay=0.05, **opt_kwargs)
         graphdef, params, rest = nnx.split(model, nnx.Param, ...)
-        opt_state = opt.init(params)
+        # FSDP placement: large weights + their m/v shard over the 'fsdp'
+        # axis (replicated-everything when the mesh has no such axis)
+        param_sh = build_param_shardings(params, mesh)
+        opt_sh, _ = build_opt_shardings(opt, params, mesh)
+        params = jax.device_put(params, param_sh)
+        # abstract on-mesh init: replicated m/v never materialize
+        opt_state = jax.jit(opt.init, out_shardings=opt_sh)(params)  # no-donate: init
 
         # donation + returning the updated state lets XLA alias the params and
         # AdamW buffers in place (input-output aliasing): ~1 GB less HBM copy
-        # traffic per fused K-step call for ViT-B
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        # traffic per fused K-step call for ViT-B. --no-donate A/Bs it off.
+        donate = () if args.no_donate else (0, 1)
+
         def multi_step(params, opt_state, x, t):
             def body(carry, _):
                 params, opt_state = carry
@@ -617,6 +659,11 @@ def _measure(args) -> int:
                 return (params, opt_state), loss
             (params, opt_state), losses = jax.lax.scan(body, (params, opt_state), None, length=K)
             return params, opt_state, losses[-1]
+
+        multi_step = jax.jit(
+            multi_step, donate_argnums=donate,
+            in_shardings=(param_sh, opt_sh, data_sharding(mesh, 4), data_sharding(mesh, 1)),
+            out_shardings=(param_sh, opt_sh, replicate_sharding(mesh)))
 
         # warm-up compiles + runs once; its returned state feeds the timed
         # call (donation invalidates the inputs, and chaining state is the
@@ -664,6 +711,10 @@ def _measure(args) -> int:
     if _WATCHDOG is not None:
         _WATCHDOG.cancel()  # measurement done; disarm watchdog
     baseline = BASELINES.get((args.model, args.bench))
+    # mesh shape + donation state make BENCH_*.json rows attributable to the
+    # sharding/donation configuration that produced them
+    mesh_tag = 'x'.join(str(mesh.shape[a]) for a in mesh.axis_names) + f'({",".join(mesh.axis_names)})'
+    knob_tag += f' [mesh={mesh_tag}, donate={"off" if args.no_donate else "on"}]'
     metric = f'{args.model} {args.bench} img/s/chip (bf16, bs{batch_size}, {n_chips} chip){knob_tag}'
     if mfu is not None:
         metric += f', MFU={mfu:.2f}'

@@ -1,0 +1,167 @@
+"""Subprocess drill for the FSDP acceptance tests (tests/test_sharding.py).
+
+Run with XLA_FLAGS=--xla_force_host_platform_device_count=N (the parent test
+sets it). Modes:
+
+  parity8 <dir>  — 8 virtual CPU devices: train the golden-fixture ViT 3
+                   steps under a ('data','fsdp')=(2,4) mesh AND on a single
+                   device; assert param/EMA parity ≤1e-6; durably save the
+                   sharded task's checkpoint twice (raw sharded jax arrays vs
+                   pre-gathered host arrays) and prove the SHA-256 sidecars
+                   are byte-identical.
+  load1 <dir>    — 1 device: verify the 8-device checkpoint, load it into a
+                   single-device task, compare eval logits against the ones
+                   the sharded task recorded, and re-save to prove the
+                   manifest is stable across a save→load→save round trip.
+
+Prints one JSON line with the results; exit 0 on success.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault('XLA_FLAGS', '--xla_force_host_platform_device_count=8')
+
+import jax
+
+try:
+    jax.config.update('jax_platforms', 'cpu')
+    jax.config.update('jax_num_cpu_devices', int(os.environ.get('TIMM_TPU_DRILL_DEVICES', '8')))
+except Exception:
+    pass
+
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import timm_tpu  # noqa: E402
+from timm_tpu.loss import LabelSmoothingCrossEntropy  # noqa: E402
+from timm_tpu.optim import create_optimizer_v2  # noqa: E402
+from timm_tpu.parallel import create_mesh, shard_batch  # noqa: E402
+from timm_tpu.resilience import load_with_fallback  # noqa: E402
+from timm_tpu.resilience.durable import atomic_write_npz, read_manifest, verify_checkpoint  # noqa: E402
+from timm_tpu.task import ClassificationTask  # noqa: E402
+from timm_tpu.utils import configure_compile_cache  # noqa: E402
+from timm_tpu.utils.serialization import flatten_pytree  # noqa: E402
+
+configure_compile_cache()
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'fixtures', 'vit_tiny_img64_golden.npz')
+MODEL, IMG, CLASSES = 'vit_tiny_patch16_224', 64, 1000
+STEPS, BATCH = 3, 8
+
+
+def golden_batch(mesh):
+    with np.load(FIXTURE) as d:
+        x = np.tile(d['x'], (BATCH // d['x'].shape[0], 1, 1, 1))
+    t = np.random.RandomState(0).randint(0, CLASSES, BATCH)
+    return shard_batch({'input': jnp.asarray(x), 'target': jnp.asarray(t)}, mesh)
+
+
+def make_task(mesh):
+    model = timm_tpu.create_model(MODEL, img_size=IMG)
+    # block_scan composes with fsdp sharding + scanned accumulation (PR 4);
+    # it also keeps the drill's compile cost O(1) in depth
+    model.set_block_scan(True)
+    opt = create_optimizer_v2(model, opt='sgd', lr=0.05, momentum=0.9)
+    task = ClassificationTask(model, optimizer=opt, mesh=mesh,
+                              train_loss_fn=LabelSmoothingCrossEntropy(0.1))
+    task.setup_ema(decay=0.9)
+    return task
+
+
+def train(task, mesh):
+    batch = golden_batch(mesh)
+    for i in range(STEPS):
+        metrics = task.train_step(batch, lr=0.05, step=i + 1)
+    assert np.isfinite(float(metrics['loss'])), metrics
+    return task
+
+
+def host_params(task):
+    return {k: np.asarray(v) for k, v in flatten_pytree(nnx.state(task.model, nnx.Param)).items()}
+
+
+def max_diff(a, b):
+    assert set(a) == set(b)
+    return max(float(np.abs(a[k] - b[k]).max()) for k in a)
+
+
+def parity8(workdir):
+    assert len(jax.devices()) == 8, jax.devices()
+    mesh_fsdp = create_mesh(fsdp=4)
+    task_f = train(make_task(mesh_fsdp), mesh_fsdp)
+
+    mesh_1 = create_mesh(devices=jax.devices()[:1])
+    task_1 = train(make_task(mesh_1), mesh_1)
+
+    p_diff = max_diff(host_params(task_f), host_params(task_1))
+    e_diff = max_diff({k: np.asarray(v) for k, v in flatten_pytree(task_f.ema_params).items()},
+                      {k: np.asarray(v) for k, v in flatten_pytree(task_1.ema_params).items()})
+
+    # eval logits recorded for the cross-mesh reload drill
+    batch = golden_batch(mesh_fsdp)
+    logits = np.asarray(task_f.eval_step({'input': batch['input']}))
+    np.save(os.path.join(workdir, 'logits_fsdp.npy'), logits)
+
+    # durable save #1: the full checkpoint schema, with the PARAM leaves left
+    # as raw fsdp-sharded jax.Arrays — exercising durable._gather_to_host
+    state = task_f.get_checkpoint_state()
+    raw = dict(state)
+    from jax.tree_util import tree_flatten_with_path
+    from timm_tpu.parallel.sharding import _kp_str
+    for kp, leaf in tree_flatten_with_path(nnx.state(task_f.model, nnx.Param))[0]:
+        raw['state_dict.' + _kp_str(kp)] = leaf  # sharded jax.Array, NOT gathered
+    ckpt_f = os.path.join(workdir, 'ckpt_fsdp.npz')
+    atomic_write_npz(ckpt_f, raw, meta={'epoch': 0, 'mesh': '2x4'})
+    # durable save #2: same content pre-gathered to host — the sidecars must
+    # be byte-identical or checkpoint hashes would depend on the mesh shape
+    ckpt_h = os.path.join(workdir, 'ckpt_host.npz')
+    atomic_write_npz(ckpt_h, {k: np.asarray(v) for k, v in raw.items()}, meta={'epoch': 0})
+    mf, mh = read_manifest(ckpt_f), read_manifest(ckpt_h)
+    same = {k: v['sha256'] for k, v in mf['arrays'].items()} == \
+           {k: v['sha256'] for k, v in mh['arrays'].items()}
+
+    print(json.dumps({
+        'devices': len(jax.devices()),
+        'mesh': [int(mesh_fsdp.shape['data']), int(mesh_fsdp.shape['fsdp'])],
+        'max_param_diff': p_diff,
+        'max_ema_diff': e_diff,
+        'manifest_matches_unsharded': bool(same),
+    }))
+
+
+def load1(workdir):
+    assert len(jax.devices()) == 1, jax.devices()
+    ckpt = os.path.join(workdir, 'ckpt_fsdp.npz')
+    ok, reason = verify_checkpoint(ckpt)
+    state, meta, used = load_with_fallback(ckpt)
+    mesh = create_mesh()
+    task = make_task(mesh)
+    task.load_checkpoint_state(state)
+    with np.load(FIXTURE) as d:
+        x = np.tile(d['x'], (BATCH // d['x'].shape[0], 1, 1, 1))
+    logits = np.asarray(task.eval_step({'input': shard_batch(jnp.asarray(x), mesh)}))
+    saved = np.load(os.path.join(workdir, 'logits_fsdp.npy'))
+    eval_diff = float(np.abs(logits - saved).max())
+
+    resaved = os.path.join(workdir, 'ckpt_resaved.npz')
+    atomic_write_npz(resaved, {k: np.asarray(v) for k, v in state.items()}, meta={'epoch': 0})
+    m0, m1 = read_manifest(ckpt), read_manifest(resaved)
+    stable = {k: v['sha256'] for k, v in m0['arrays'].items()} == \
+             {k: v['sha256'] for k, v in m1['arrays'].items()}
+
+    print(json.dumps({
+        'devices': len(jax.devices()),
+        'verified': bool(ok), 'verify_reason': reason,
+        'loaded': used == ckpt,
+        'eval_matches_saved_logits': eval_diff,
+        'resave_manifest_matches': bool(stable),
+    }))
+
+
+if __name__ == '__main__':
+    mode, workdir = sys.argv[1], sys.argv[2]
+    {'parity8': parity8, 'load1': load1}[mode](workdir)

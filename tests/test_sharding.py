@@ -1,16 +1,46 @@
-"""Sharded-execution semantics tests (SURVEY §7 hard part (c)):
-BatchNorm batch statistics under a sharded batch must equal the
-global-batch statistics computed on one device."""
+"""Sharded-execution tests.
+
+1. BatchNorm semantics under a sharded batch (SURVEY §7 hard part (c)).
+2. FSDP partition rules: every ViT param resolves to exactly one rule; m/v
+   optimizer slots mirror their param's spec (what makes donation aliasing
+   legal).
+3. Donated jitted steps: re-using a donated buffer raises; every jit in
+   timm_tpu/task/ declares donate_argnums or an explicit no-donate reason.
+4. Scanned grad accumulation: grad parity ≤1e-6 vs the legacy unroll, and
+   jaxpr trace size is O(1) in grad_accum_steps.
+5. 8-CPU-device subprocess drills: ('data','fsdp') train parity vs a single
+   device ≤1e-6 after 3 updates, and checkpoint save-on-8-device →
+   load-on-1-device with a byte-stable SHA-256 sidecar.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from flax import nnx
+from jax.sharding import PartitionSpec as P
 
 import timm_tpu
 from timm_tpu.layers import BatchNormAct2d
-from timm_tpu.parallel import shard_batch
+from timm_tpu.loss import LabelSmoothingCrossEntropy
+from timm_tpu.optim import create_optimizer_v2
+from timm_tpu.parallel import (
+    build_opt_shardings, build_param_shardings, create_mesh, default_partition_rules,
+    match_rule, param_bytes_per_device, path_specs, shard_batch,
+)
+from timm_tpu.task import ClassificationTask
 
+pytestmark = pytest.mark.sharding
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), '..'))
+
+
+# ---- BatchNorm under a sharded batch (pre-FSDP coverage, kept) --------------
 
 def test_bn_sharded_stats_match_global(mesh8):
     """Train-mode BN over an 8-way sharded batch: running stats and outputs
@@ -56,8 +86,6 @@ def test_bn_model_sharded_train_step_matches_global(mesh8):
     """Full jitted train step of a BN trunk (test_resnet) through the REAL
     task path: loss, grad norm, and updated BN running stats identical
     whether the batch is 8-way sharded or unsharded."""
-    from timm_tpu.optim import create_optimizer_v2
-    from timm_tpu.task import ClassificationTask
     rng = np.random.RandomState(0)
     x_np = rng.rand(16, 64, 64, 3).astype(np.float32)
     t_np = rng.randint(0, 10, 16)
@@ -84,3 +112,314 @@ def test_bn_model_sharded_train_step_matches_global(mesh8):
         np.testing.assert_allclose(
             flat_s[path], leaf_g, rtol=1e-4, atol=1e-5,
             err_msg=f'sharded BN running stats diverged at {path}')
+
+
+# ---- FSDP partition rules ----------------------------------------------------
+
+def _fsdp_mesh(fsdp=4):
+    return create_mesh(fsdp=fsdp)
+
+
+def _param_paths(model_name, **kwargs):
+    model = timm_tpu.create_model(model_name, **kwargs)
+    from timm_tpu.utils.serialization import flatten_pytree
+    return flatten_pytree(nnx.state(model, nnx.Param))
+
+
+@pytest.mark.parametrize('model_name,kwargs', [
+    ('test_vit', dict(num_classes=10, img_size=32)),
+    ('vit_tiny_patch16_224', dict(img_size=64)),
+])
+def test_every_vit_param_matches_exactly_one_rule(model_name, kwargs):
+    """The default rule set is disjoint + exhaustive on the ViT family: each
+    param path matches EXACTLY one non-catch-all rule (first-match-wins never
+    has to disambiguate), so placement is auditable from the table alone."""
+    rules = default_partition_rules()
+    specific, catchall = rules[:-1], rules[-1]
+    assert catchall.pattern == '.*'
+    for path in _param_paths(model_name, **kwargs):
+        n = sum(1 for r in specific if r.matches(path))
+        assert n == 1, f'{path} matched {n} rules (expected exactly 1)'
+        idx, rule = match_rule(path, rules)
+        assert rules[idx].matches(path)
+
+
+def test_rule_specs_shard_large_kernels_replicate_small(mesh8):
+    mesh = _fsdp_mesh(4)
+    specs = path_specs(_param_paths('test_vit', num_classes=10, img_size=32), mesh)
+    # large matmul weights shard on 'fsdp'
+    for path in ('blocks.0.attn.qkv.kernel', 'blocks.0.mlp.fc1.kernel', 'blocks.1.mlp.fc2.kernel'):
+        assert any(ax == 'fsdp' for ax in specs[path]), f'{path}: {specs[path]}'
+    # norm scales / biases / tokens stay replicated
+    for path in ('blocks.0.norm1.scale', 'blocks.0.attn.qkv.bias', 'cls_token', 'pos_embed', 'norm.bias'):
+        assert specs[path] == P(), f'{path}: {specs[path]}'
+    # a 1-axis data mesh replicates everything (exact pre-FSDP behaviour)
+    flat_specs = path_specs(_param_paths('test_vit', num_classes=10, img_size=32), mesh8)
+    assert all(s == P() for s in flat_specs.values())
+
+
+def test_opt_state_specs_mirror_param_specs():
+    """AdamW m/v (and any other param-shaped slot) must inherit the param's
+    spec leaf-for-leaf — donation aliasing requires input and output
+    placement to agree, and m/v live exactly where their param lives."""
+    mesh = _fsdp_mesh(4)
+    model = timm_tpu.create_model('test_vit', num_classes=10, img_size=32)
+    opt = create_optimizer_v2(model, opt='adamw', lr=1e-3, weight_decay=0.05)
+    params = nnx.state(model, nnx.Param)
+    pspecs = path_specs(params, mesh)
+    opt_sh, abstract = build_opt_shardings(opt, params, mesh)
+
+    from jax.tree_util import tree_flatten_with_path
+    from timm_tpu.parallel.sharding import _kp_str
+    flat, _ = tree_flatten_with_path(opt_sh)
+    mirrored = 0
+    for kp, sharding in flat:
+        path = _kp_str(kp)
+        for ppath, pspec in pspecs.items():
+            if path == ppath or path.endswith('.' + ppath):
+                assert sharding.spec == pspec, f'{path}: {sharding.spec} != param {pspec}'
+                mirrored += 1
+                break
+        else:
+            assert sharding.spec == P(), f'non-param slot {path} must be replicated'
+    # at least mu+nu for every param mirrored
+    assert mirrored >= 2 * len(pspecs)
+
+
+def test_param_bytes_per_device_accounting():
+    mesh = _fsdp_mesh(4)
+    params = timm_tpu.create_model('test_vit', num_classes=10, img_size=32)
+    tree = nnx.state(params, nnx.Param)
+    rep, shard = param_bytes_per_device(tree, mesh)
+    assert shard < rep, (rep, shard)
+    # every sharded kernel contributes bytes/4; the floor is all-replicated
+    assert shard > rep // 4
+
+
+# ---- 2-axis mesh + batch divisibility ---------------------------------------
+
+def test_create_mesh_fsdp_shapes(mesh8):
+    mesh = create_mesh(fsdp=4)
+    assert mesh.axis_names == ('data', 'fsdp')
+    assert dict(mesh.shape) == {'data': 2, 'fsdp': 4}
+    assert create_mesh().axis_names == ('data',)  # fsdp=1 keeps the 1-axis mesh
+    with pytest.raises(ValueError, match='fsdp=3'):
+        create_mesh(fsdp=3)
+
+
+def test_shard_batch_2axis_and_divisibility_error(mesh8):
+    mesh = _fsdp_mesh(4)
+    batch = shard_batch({'input': jnp.ones((16, 4, 4, 3)), 'target': jnp.zeros((16,), jnp.int32)}, mesh)
+    # batch shards over the data x fsdp product
+    assert len(batch['input'].sharding.device_set) == 8
+    # loud error instead of an opaque XLA reshape failure
+    with pytest.raises(ValueError, match='not divisible by the mesh batch-shard count 8'):
+        shard_batch(jnp.ones((12, 4)), mesh)
+    with pytest.raises(ValueError, match='divisible'):
+        shard_batch({'input': jnp.ones((6, 2))}, mesh8)
+
+
+# ---- donated jitted steps ----------------------------------------------------
+
+def _make_task(mesh, opt='sgd', **kwargs):
+    model = timm_tpu.create_model('test_vit', num_classes=10, img_size=32)
+    optimizer = create_optimizer_v2(model, opt=opt, lr=0.1, momentum=0.9)
+    return ClassificationTask(model, optimizer=optimizer, mesh=mesh,
+                              train_loss_fn=LabelSmoothingCrossEntropy(0.1), **kwargs)
+
+
+def _batch(mesh, n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return shard_batch({'input': jnp.asarray(rng.rand(n, 32, 32, 3), jnp.float32),
+                        'target': jnp.asarray(rng.randint(0, 10, n))}, mesh)
+
+
+def test_train_step_donates_param_and_opt_buffers(mesh8):
+    """The jitted step donates params/opt state/EMA: after one step the OLD
+    buffers are deleted, and touching one raises instead of silently reading
+    stale memory."""
+    task = _make_task(mesh8)
+    task.setup_ema(decay=0.5)
+    old_param = jax.tree.leaves(nnx.state(task.model, nnx.Param))[0]
+    old_opt = next(l for l in jax.tree.leaves(task.opt_state)
+                   if hasattr(l, 'shape') and l.size > 1)
+    old_ema = jax.tree.leaves(task.ema_params)[0]
+    task.train_step(_batch(mesh8), lr=0.1, step=1)
+    for name, buf in [('param', old_param), ('opt', old_opt), ('ema', old_ema)]:
+        with pytest.raises(RuntimeError):
+            np.asarray(buf)
+            pytest.fail(f'donated {name} buffer was still readable')
+
+
+def test_eval_after_donated_train_step(mesh8):
+    """Donation must not leave the task holding deleted arrays: eval (incl.
+    EMA eval) works right after a donated train step."""
+    task = _make_task(mesh8)
+    task.setup_ema(decay=0.5)
+    batch = _batch(mesh8)
+    for i in range(2):
+        task.train_step(batch, lr=0.1, step=i + 1)
+    out = task.eval_step({'input': batch['input']})
+    out_ema = task.eval_step({'input': batch['input']}, use_ema=True)
+    assert np.isfinite(np.asarray(out)).all() and np.isfinite(np.asarray(out_ema)).all()
+
+
+def test_task_jits_declare_donation_or_reason():
+    """Lint: every jax.jit/nnx.jit call in timm_tpu/task/ must declare
+    donate_argnums or carry an explicit `# no-donate:` reason — the PERF.md
+    item-3a regression (donation landed in bench only, never in the real
+    step) cannot silently return."""
+    task_dir = os.path.join(REPO_ROOT, 'timm_tpu', 'task')
+    pattern = re.compile(r'(?:jax|nnx)\.jit\s*\(')
+    violations = []
+    for fname in sorted(os.listdir(task_dir)):
+        if not fname.endswith('.py'):
+            continue
+        with open(os.path.join(task_dir, fname)) as f:
+            lines = f.read().splitlines()
+        for i, line in enumerate(lines):
+            if not pattern.search(line.split('#')[0]):
+                continue
+            window = '\n'.join(lines[max(0, i - 3):i + 12])
+            if 'donate_argnums' not in window and 'no-donate:' not in window:
+                violations.append(f'{fname}:{i + 1}: {line.strip()}')
+    assert not violations, (
+        'jit call(s) in timm_tpu/task/ without donate_argnums or a '
+        f'`# no-donate: <reason>` comment:\n' + '\n'.join(violations))
+
+
+# ---- scanned grad accumulation ----------------------------------------------
+
+def test_scanned_accum_matches_unrolled(mesh8):
+    """Grad parity: one SGD step at lr=0.1 makes the param delta a scaled
+    gradient, so param agreement ≤1e-6 is gradient agreement ≤1e-5."""
+    batch = _batch(mesh8)
+    results = {}
+    for scan in (True, False):
+        task = _make_task(mesh8, grad_accum_steps=4, grad_accum_scan=scan)
+        m = task.train_step(batch, lr=0.1, step=1)
+        results[scan] = (float(m['loss']),
+                         jax.tree.map(np.asarray, nnx.state(task.model, nnx.Param)))
+    assert results[True][0] == pytest.approx(results[False][0], abs=1e-6)
+    diffs = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.abs(a - b).max()), results[True][1], results[False][1]))
+    assert max(diffs) <= 1e-6, f'scan vs unroll param diff {max(diffs)}'
+
+
+def test_scanned_accum_matches_single_large_batch(mesh8):
+    t1 = _make_task(mesh8)
+    t2 = _make_task(mesh8, grad_accum_steps=2)
+    batch = _batch(mesh8, n=16)
+    l1 = float(t1.train_step(batch, lr=1e-3)['loss'])
+    l2 = float(t2.train_step(batch, lr=1e-3)['loss'])
+    assert l1 == pytest.approx(l2, abs=1e-3)
+
+
+def test_accum_trace_size_o1_in_steps(mesh8):
+    """Acceptance: grad_accum_steps=8 no longer scales trace size ~8x vs
+    grad_accum_steps=2 (the old Python unroll did)."""
+    from timm_tpu.utils.compile_cache import count_jaxpr_eqns
+    batch = _batch(mesh8)
+
+    def eqns(accum, scan):
+        task = _make_task(mesh8, grad_accum_steps=accum, grad_accum_scan=scan)
+        return count_jaxpr_eqns(task.trace_train_step(batch, lr=0.1))
+
+    scan2, scan8 = eqns(2, True), eqns(8, True)
+    assert scan8 < 2 * scan2, f'scanned trace cost grew with accum steps: {scan2} -> {scan8}'
+    unroll8 = eqns(8, False)
+    assert unroll8 > 2 * scan8, \
+        f'expected the unrolled jaxpr to dwarf the scanned one: {unroll8} vs {scan8}'
+
+
+# ---- fsdp end-to-end in-process ---------------------------------------------
+
+def test_fsdp_task_train_eval_checkpoint_roundtrip(mesh8):
+    """('data','fsdp') task: params/opt actually sharded, train+eval run, and
+    a checkpoint saved from the fsdp task loads into a plain data-mesh task
+    with identical eval outputs (round-trip across mesh shapes, in-process)."""
+    mesh = _fsdp_mesh(4)
+    task = _make_task(mesh, opt='adamw')
+    qkv = nnx.state(task.model, nnx.Param)['blocks'][0]['attn']['qkv']['kernel'].value
+    assert any(ax == 'fsdp' for ax in qkv.sharding.spec)
+    sharded_opt = [l for l in jax.tree.leaves(task.opt_state)
+                   if hasattr(l, 'sharding') and any(ax is not None for ax in l.sharding.spec)]
+    assert sharded_opt, 'optimizer m/v must be fsdp-sharded'
+    batch = _batch(mesh)
+    for i in range(2):
+        m = task.train_step(batch, lr=1e-3, step=i + 1)
+    assert np.isfinite(float(m['loss']))
+    state = task.get_checkpoint_state()
+
+    task2 = _make_task(mesh8, opt='adamw')
+    task2.load_checkpoint_state(state)
+    x = _batch(mesh8)['input']
+    a = np.asarray(task.eval_step({'input': shard_batch(np.asarray(x), mesh)}))
+    b = np.asarray(task2.eval_step({'input': x}))
+    # params round-trip bit-exactly; the tolerance is fp32 reduction-order
+    # noise from evaluating under different mesh shapes
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_create_sharded_model_abstract_init(caplog):
+    """`nnx.eval_shape`-based init creates params directly on-mesh (no eager
+    replicated copy, no fallback warning) with rule-conformant placement."""
+    import logging
+    from timm_tpu.parallel import create_sharded_model
+    mesh = _fsdp_mesh(4)
+    with caplog.at_level(logging.WARNING, logger='timm_tpu.parallel.sharding'):
+        model = create_sharded_model(
+            lambda: timm_tpu.create_model('test_vit', num_classes=10, img_size=32), mesh)
+    assert not any('abstract init failed' in r.message for r in caplog.records), \
+        'abstract init silently fell back to eager construction'
+    qkv = nnx.state(model, nnx.Param)['blocks'][0]['attn']['qkv']['kernel'].value
+    assert any(ax == 'fsdp' for ax in qkv.sharding.spec)
+    x = shard_batch(jnp.zeros((8, 32, 32, 3)), mesh)
+    model.eval()
+    out = model(x)
+    assert out.shape == (8, 10) and np.isfinite(np.asarray(out)).all()
+
+
+# ---- subprocess drills: forced 8-device mesh parity + 1-device reload -------
+
+_DRILL = os.path.join(os.path.dirname(__file__), 'fsdp_drill.py')
+
+
+def _run_drill(mode, workdir, devices):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS='cpu',
+        XLA_FLAGS=f'--xla_force_host_platform_device_count={devices}',
+        TIMM_TPU_DRILL_DEVICES=str(devices),
+        TF_CPP_MIN_LOG_LEVEL='3',
+    )
+    r = subprocess.run([sys.executable, _DRILL, mode, str(workdir)],
+                       capture_output=True, text=True, env=env, cwd=REPO_ROOT, timeout=300)
+    assert r.returncode == 0, f'{mode} drill failed rc={r.returncode}:\n{r.stderr[-3000:]}'
+    out = [l for l in r.stdout.strip().splitlines() if l.startswith('{')]
+    assert out, f'no JSON result from {mode} drill:\n{r.stdout[-2000:]}'
+    return json.loads(out[-1])
+
+
+def test_fsdp_8device_parity_and_cross_mesh_checkpoint(tmp_path):
+    """Acceptance drill: under a forced 8-CPU-device ('data','fsdp') mesh the
+    golden-fixture train step matches the single-device step ≤1e-6 (params
+    after 3 updates), the durable checkpoint written from the sharded task
+    carries the same SHA-256 sidecar a single-device save produces, and a
+    fresh 1-device process verifies + loads it (save-on-8 → load-on-1)."""
+    res = _run_drill('parity8', tmp_path, devices=8)
+    assert res['devices'] == 8 and res['mesh'] == [2, 4]
+    assert res['max_param_diff'] <= 1e-6, res
+    assert res['max_ema_diff'] <= 1e-6, res
+    assert os.path.exists(tmp_path / 'ckpt_fsdp.npz')
+    # sidecar is byte-stable across mesh shapes: sharded-save hashes equal
+    # the unsharded-save hashes computed in the same child
+    assert res['manifest_matches_unsharded'], res
+
+    res1 = _run_drill('load1', tmp_path, devices=1)
+    assert res1['devices'] == 1
+    assert res1['verified'] and res1['loaded'], res1
+    assert res1['resave_manifest_matches'], res1
+    # logits re-computed on a different mesh shape: fp32 reduction-order noise
+    # only (params themselves round-trip bit-exactly, proven by the manifest)
+    assert res1['eval_matches_saved_logits'] <= 1e-5, res1

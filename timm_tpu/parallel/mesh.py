@@ -4,12 +4,20 @@ TPU-native replacement for the reference's DDP/NCCL stack
 (reference: timm/utils/distributed.py:79-159, task/classification.py:64-66).
 
 Data parallelism is expressed as a mesh, not processes: batches are sharded
-over the 'data' axis, params are replicated, and XLA emits the grad
-all-reduce over ICI/DCN. For multi-host pods the mesh is 2-level
-('dcn' × 'ici') so collectives ride ICI within a slice.
+over the batch axes, params are replicated (or fsdp-sharded, see
+parallel/sharding.py), and XLA emits the grad all-reduce over ICI/DCN.
+
+Mesh shapes:
+  * `('data',)` — plain data parallelism (the default);
+  * `('dcn', 'data')` — multi-host pods with multiple DCN slices, so
+    collectives ride ICI within a slice;
+  * `('data', 'fsdp')` / `('dcn', 'data', 'fsdp')` — ZeRO-style sharding:
+    the BATCH is sharded over the product of every axis (all devices see
+    different samples), while params/optimizer state shard over 'fsdp' only.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -18,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     'create_mesh', 'data_sharding', 'replicate_sharding', 'shard_batch',
-    'get_global_mesh', 'set_global_mesh',
+    'get_global_mesh', 'set_global_mesh', 'batch_axes',
 ]
 
 _GLOBAL_MESH: Optional[Mesh] = None
@@ -28,16 +36,36 @@ def create_mesh(
         devices: Optional[Sequence] = None,
         data_axis: str = 'data',
         num_slices: Optional[int] = None,
+        fsdp: Optional[int] = None,
 ) -> Mesh:
-    """1-D data-parallel mesh, or ('dcn', 'data') 2-level when multiple DCN
-    slices are present. Shardings in this framework reference the 'data' axis
-    (and 'dcn' when present) for the batch dimension.
+    """Data-parallel mesh, optionally with an 'fsdp' parameter-sharding axis.
+
+    `fsdp=N` (or env TIMM_TPU_FSDP) folds the trailing N devices of each
+    data group into a second axis: 8 devices with fsdp=4 gives a
+    ``('data', 'fsdp')`` mesh of shape (2, 4). Batches still shard over all
+    8 devices (see `shard_batch`); params/optimizer state shard over the 4
+    fsdp devices per data group (parallel/sharding.py). With multiple DCN
+    slices the mesh is ``('dcn', data_axis[, 'fsdp'])`` so collectives ride
+    ICI within a slice.
     """
     devices = list(devices) if devices is not None else jax.devices()
+    if fsdp is None:
+        fsdp = int(os.environ.get('TIMM_TPU_FSDP', '1') or 1)
+    fsdp = max(1, fsdp)
     if num_slices is None:
         # group by process/slice when running multi-host
         slice_ids = {getattr(d, 'slice_index', 0) for d in devices}
         num_slices = len(slice_ids)
+    if fsdp > 1:
+        per_slice = len(devices) // max(num_slices, 1)
+        if per_slice % fsdp != 0:
+            raise ValueError(
+                f'fsdp={fsdp} must divide the {per_slice} devices per slice '
+                f'({len(devices)} devices / {num_slices} slice(s))')
+        if num_slices > 1:
+            dev_array = np.array(devices).reshape(num_slices, -1, fsdp)
+            return Mesh(dev_array, ('dcn', data_axis, 'fsdp'))
+        return Mesh(np.array(devices).reshape(-1, fsdp), (data_axis, 'fsdp'))
     if num_slices > 1:
         dev_array = np.array(devices).reshape(num_slices, -1)
         return Mesh(dev_array, ('dcn', data_axis))
@@ -56,13 +84,19 @@ def get_global_mesh() -> Mesh:
     return _GLOBAL_MESH
 
 
-def _batch_axes(mesh: Mesh) -> Tuple[str, ...]:
-    return tuple(n for n in mesh.axis_names)  # batch sharded over all mesh axes
+def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Batch is sharded over EVERY mesh axis — including 'fsdp': under ZeRO
+    all devices are data-parallel workers; only the parameter/optimizer
+    placement distinguishes the fsdp sub-axis."""
+    return tuple(n for n in mesh.axis_names)
+
+
+_batch_axes = batch_axes  # backwards-compat private alias
 
 
 def data_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
     """Shard the leading (batch) dim over every mesh axis; replicate the rest."""
-    return NamedSharding(mesh, P(_batch_axes(mesh), *([None] * (ndim - 1))))
+    return NamedSharding(mesh, P(batch_axes(mesh), *([None] * (ndim - 1))))
 
 
 def replicate_sharding(mesh: Mesh) -> NamedSharding:
@@ -70,10 +104,16 @@ def replicate_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(batch, mesh: Optional[Mesh] = None):
-    """Place a host batch (pytree of arrays) sharded over the mesh batch axis.
-    Non-array leaves pass through; 0-d arrays are replicated (a rank-0 value
-    has no batch dim to shard — seq_len/step counters in dict batches)."""
+    """Place a host batch (pytree of arrays) sharded over the mesh batch axes
+    (their product for a 2-axis ('data', 'fsdp') mesh). Non-array leaves pass
+    through; 0-d arrays are replicated (a rank-0 value has no batch dim to
+    shard — seq_len/step counters in dict batches).
+
+    Raises a loud ValueError when the global batch is not divisible by the
+    total batch-shard count — the alternative is an opaque XLA reshape error
+    from deep inside the jitted step."""
     mesh = mesh or get_global_mesh()
+    n_shards = int(np.prod([mesh.shape[a] for a in batch_axes(mesh)]))
 
     def put(x):
         ndim = getattr(x, 'ndim', None)
@@ -81,5 +121,11 @@ def shard_batch(batch, mesh: Optional[Mesh] = None):
             return x
         if ndim == 0:
             return jax.device_put(x, replicate_sharding(mesh))
+        if x.shape[0] % n_shards != 0:
+            raise ValueError(
+                f'Global batch dim {x.shape[0]} is not divisible by the mesh batch-shard '
+                f'count {n_shards} (mesh {dict(mesh.shape)}; the batch shards over '
+                f'{"x".join(batch_axes(mesh))}). Pad the batch or pick a batch size that '
+                f'divides evenly — e.g. validate.py pads the final partial batch.')
         return jax.device_put(x, data_sharding(mesh, ndim=ndim))
     return jax.tree.map(put, batch)

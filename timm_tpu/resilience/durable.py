@@ -81,6 +81,19 @@ def manifest_path(path: str) -> str:
     return base + '.manifest.json'
 
 
+def _gather_to_host(v) -> np.ndarray:
+    """Gather a (possibly fsdp-sharded) array to one full host copy before it
+    is hashed/written, so the npz bytes and the SHA-256 sidecar are identical
+    for EVERY mesh shape: save-on-8-device and save-on-1-device produce
+    byte-equal checkpoints. Single-process sharded arrays gather via
+    np.asarray; multi-host (not fully addressable) arrays ride a process
+    allgather first."""
+    if hasattr(v, 'is_fully_addressable') and not v.is_fully_addressable:
+        from jax.experimental import multihost_utils  # deferred: numpy-only module otherwise
+        v = multihost_utils.process_allgather(v)
+    return np.asarray(v)
+
+
 def _array_digest(arr: np.ndarray) -> str:
     arr = np.ascontiguousarray(arr)
     h = hashlib.sha256()
@@ -99,7 +112,7 @@ def atomic_write_npz(path: str, arrays: Dict[str, np.ndarray], meta: Optional[di
     """
     from .faultinject import get_fault_injector
 
-    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    arrays = {k: _gather_to_host(v) for k, v in arrays.items()}
     d = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(prefix='.' + os.path.basename(path) + '.', suffix='.tmp', dir=d)
     try:

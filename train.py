@@ -64,6 +64,11 @@ def make_parser():
     group.add_argument('--device-prefetch', type=int, default=0, metavar='N',
                        help='keep N batches in flight on device (async host->device '
                             'transfer overlapped with the step); 0 disables')
+    group.add_argument('--fsdp', type=int, default=0, metavar='N',
+                       help="shard params + optimizer state over an N-way 'fsdp' mesh axis "
+                            '(ZeRO-style; batch still shards over all devices). N must '
+                            'divide the per-slice device count; 0 disables '
+                            '(env TIMM_TPU_FSDP is the fallback default)')
     group.add_argument('--amp', action='store_true', default=False,
                        help='bf16 compute (the TPU-native AMP)')
     group.add_argument('--amp-dtype', default='bfloat16', type=str)
@@ -264,7 +269,7 @@ def main():
     world_size, rank, _ = init_distributed_device(args)
     random_seed(args.seed, rank)
 
-    mesh = create_mesh()
+    mesh = create_mesh(fsdp=args.fsdp if args.fsdp else None)
     set_global_mesh(mesh)
     n_devices = mesh.size
     _logger.info(f'Training on mesh {mesh} ({n_devices} devices, {world_size} processes)')
@@ -286,15 +291,25 @@ def main():
     # pass img_size only to models whose constructor takes it; fixed-field
     # conv nets get resized inputs via resolve_data_config instead. The retry
     # is limited to the exact img_size TypeError so real errors still surface.
-    if args.img_size is not None:
-        try:
-            model = create_model(args.model, img_size=args.img_size, **factory_kwargs, **model_kwargs)
-        except TypeError as e:
-            if 'img_size' not in str(e):
-                raise
-            model = create_model(args.model, **factory_kwargs, **model_kwargs)
+    def _build_model():
+        if args.img_size is not None:
+            try:
+                return create_model(args.model, img_size=args.img_size, **factory_kwargs, **model_kwargs)
+            except TypeError as e:
+                if 'img_size' not in str(e):
+                    raise
+        return create_model(args.model, **factory_kwargs, **model_kwargs)
+
+    if 'fsdp' in mesh.axis_names:
+        # abstract init: nnx.eval_shape resolves the partition rules against
+        # the abstract param shapes and a jitted constructor materializes each
+        # shard on its owning devices — a replicated full-model copy never
+        # exists (falls back to eager build + reshard for non-traceable
+        # constructors, e.g. pretrained-weight loading)
+        from timm_tpu.parallel import create_sharded_model
+        model = create_sharded_model(_build_model, mesh)
     else:
-        model = create_model(args.model, **factory_kwargs, **model_kwargs)
+        model = _build_model()
     if args.num_classes is None:
         args.num_classes = model.num_classes
     if args.grad_checkpointing:
@@ -360,6 +375,15 @@ def main():
         nonfinite_tolerance=args.nonfinite_tolerance,
         **task_kwargs,
     )
+
+    if 'fsdp' in mesh.axis_names:
+        from flax import nnx
+        from timm_tpu.parallel import param_bytes_per_device
+        rep_b, shard_b = param_bytes_per_device(nnx.state(model, nnx.Param), mesh)
+        _logger.info(
+            f'FSDP over {mesh.shape["fsdp"]} devices: params per device '
+            f'{shard_b / 1e6:.1f} MB (vs {rep_b / 1e6:.1f} MB replicated); optimizer '
+            f'm/v shard identically (parallel/sharding.py rules)')
 
     # loss selection (ref train.py:886-913)
     if args.jsd_loss:

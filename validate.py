@@ -59,6 +59,9 @@ parser.add_argument('--block-scan', action='store_true', default=False,
                     help='scan-over-layers block execution (O(1)-in-depth trace/compile)')
 parser.add_argument('--device-prefetch', type=int, default=0, metavar='N',
                     help='keep N batches in flight on device while the step runs; 0 disables')
+parser.add_argument('--fsdp', type=int, default=0, metavar='N',
+                    help="shard model weights over an N-way 'fsdp' mesh axis for eval "
+                         '(fits models larger than one chip HBM); 0 disables')
 
 
 def validate(args):
@@ -74,7 +77,7 @@ def validate(args):
         jax.config.update('jax_platforms', args.device)
     from timm_tpu.utils import configure_compile_cache
     configure_compile_cache()
-    mesh = create_mesh()
+    mesh = create_mesh(fsdp=args.fsdp if args.fsdp else None)
     set_global_mesh(mesh)
 
     dtype = jnp.bfloat16 if args.amp else None
@@ -139,6 +142,11 @@ def validate(args):
 
     from flax import nnx
     graphdef, state = nnx.split(model)
+    if 'fsdp' in mesh.axis_names:
+        # large weights shard over 'fsdp' (path-rule placement); XLA gathers
+        # them before use, so eval fits models larger than one chip's HBM
+        from timm_tpu.parallel import build_param_shardings
+        state = jax.device_put(state, build_param_shardings(state, mesh))
     mean = jnp.asarray(data_config['mean'], jnp.float32).reshape(1, 1, 1, -1)
     std = jnp.asarray(data_config['std'], jnp.float32).reshape(1, 1, 1, -1)
 

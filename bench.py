@@ -170,6 +170,8 @@ def _run_child(args, timeout_s: int) -> dict | None:
         cmd += ['--block-scan']
     if args.fsdp:
         cmd += ['--fsdp', str(args.fsdp)]
+    if args.tp:
+        cmd += ['--tp', str(args.tp)]
     if args.no_donate:
         cmd += ['--no-donate']
     if args.pad_tokens:
@@ -262,6 +264,11 @@ def main():
     parser.add_argument('--fsdp', type=int, default=0, metavar='N',
                         help='shard params + optimizer state over an N-way fsdp mesh '
                              "axis (ZeRO-style; mesh becomes ('data', 'fsdp')); 0 = off")
+    parser.add_argument('--tp', type=int, default=0, metavar='N',
+                        help="tensor parallelism: N-way 'model' mesh axis sharding "
+                             'attention heads + MLP hidden, with activation sharding '
+                             'constraints on the residual stream; composes with --fsdp '
+                             "(mesh becomes ('data'[, 'fsdp'], 'model')); 0 = off")
     parser.add_argument('--no-donate', action='store_true', default=False,
                         help='disable buffer donation of params/opt state in the jitted '
                              'step (A/B the input-output aliasing win)')
@@ -400,11 +407,15 @@ def _dry_run(args) -> int:
     from timm_tpu.utils import configure_compile_cache
 
     configure_compile_cache()
-    # single-device mesh unless --fsdp is being smoked: SPMD-partitioning the
-    # tiny dry-run program over every visible device multiplies its compile
+    # single-device mesh unless --fsdp/--tp is being smoked: SPMD-partitioning
+    # the tiny dry-run program over every visible device multiplies its compile
     # cost for no extra coverage (the flag-combination sweep runs 9 of these)
     fsdp = getattr(args, 'fsdp', 0)
-    mesh = create_mesh(fsdp=fsdp) if fsdp else create_mesh(devices=jax.devices()[:1])
+    tp = getattr(args, 'tp', 0)
+    if fsdp or tp:
+        mesh = create_mesh(fsdp=fsdp or None, tp=tp or None)
+    else:
+        mesh = create_mesh(devices=jax.devices()[:1])
     set_global_mesh(mesh)
     model_kwargs, opt_kwargs, tag = _apply_precision_knobs(args)
     img = min(args.img_size, 64)  # tiny input: the gate is "traces + runs", not perf
@@ -414,6 +425,8 @@ def _dry_run(args) -> int:
         tag += ' [block_scan]'
     if getattr(args, 'fsdp', 0):
         tag += f' [fsdp={args.fsdp}]'
+    if getattr(args, 'tp', 0):
+        tag += f' [tp={args.tp}]'
     if getattr(args, 'no_donate', False):
         tag += ' [no-donate]'
     rng = np.random.RandomState(0)
@@ -605,7 +618,8 @@ def _measure(args) -> int:
 
     configure_compile_cache()
 
-    mesh = create_mesh(fsdp=args.fsdp if args.fsdp else None)
+    mesh = create_mesh(fsdp=args.fsdp if args.fsdp else None,
+                       tp=args.tp if args.tp else None)
     set_global_mesh(mesh)
     n_chips = mesh.size
     # bs128/chip benched fastest for ViT-B train on v5e with the einsum

@@ -148,10 +148,13 @@ class Attention(nnx.Module):
         self.proj_drop = Dropout(proj_drop, rngs=rngs)
 
     def _qkv(self, x):
+        from ..parallel import shard_activation
         B, N, C = x.shape
         qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, self.head_dim)
         qkv = qkv.transpose(2, 0, 3, 1, 4)  # (3, B, H, N, D)
-        q, k, v = qkv[0], qkv[1], qkv[2]
+        # heads over 'model' matches the column-parallel qkv kernel split, so
+        # scores/softmax/values never leave the owning tp shard
+        q, k, v = (shard_activation(t, 'heads') for t in (qkv[0], qkv[1], qkv[2]))
         if self.q_norm is not None:
             q = self.q_norm(q)
         if self.k_norm is not None:
@@ -159,6 +162,7 @@ class Attention(nnx.Module):
         return q, k, v
 
     def __call__(self, x, attn_mask=None):
+        from ..parallel import shard_activation
         B, N, C = x.shape
         q, k, v = self._qkv(x)
         dropout_p = 0.0 if self.attn_drop.deterministic else self.attn_drop_rate
@@ -167,7 +171,7 @@ class Attention(nnx.Module):
             q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale,
             softmax_dtype=self.softmax_dtype,
         )
-        x = x.transpose(0, 2, 1, 3).reshape(B, N, C)
+        x = shard_activation(x.transpose(0, 2, 1, 3).reshape(B, N, C), 'hidden')
         if self.norm is not None:
             x = self.norm(x)
         x = self.proj(x)
@@ -238,6 +242,7 @@ class AttentionRope(nnx.Module):
         self.proj_drop = Dropout(proj_drop, rngs=rngs)
 
     def __call__(self, x, rope=None, attn_mask=None):
+        from ..parallel import shard_activation
         B, N, C = x.shape
         if self.qkv is not None:
             qkv = self.qkv(x).reshape(B, N, 3, self.num_heads, self.head_dim)
@@ -247,6 +252,7 @@ class AttentionRope(nnx.Module):
             q = self.q_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
             k = self.k_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
             v = self.v_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        q, k, v = (shard_activation(t, 'heads') for t in (q, k, v))
         if self.q_norm is not None:
             q = self.q_norm(q)
         if self.k_norm is not None:
@@ -270,7 +276,7 @@ class AttentionRope(nnx.Module):
             q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, dropout_key=dropout_key, scale=self.scale,
             softmax_dtype=self.softmax_dtype,
         )
-        x = x.transpose(0, 2, 1, 3).reshape(B, N, self.attn_dim)
+        x = shard_activation(x.transpose(0, 2, 1, 3).reshape(B, N, self.attn_dim), 'hidden')
         if self.norm is not None:
             x = self.norm(x)
         x = self.proj(x)

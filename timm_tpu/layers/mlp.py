@@ -22,6 +22,15 @@ from .weight_init import trunc_normal_, zeros_
 __all__ = ['Mlp', 'GluMlp', 'SwiGLU', 'SwiGLUPacked', 'GatedMlp', 'ConvMlp', 'GlobalResponseNormMlp']
 
 
+def _shard_hidden(x):
+    """Pin the post-fc1 hidden tensor over the 'model' mesh axis (no-op
+    without one): fc1 is column-parallel under tensor parallelism, so the
+    act/drop/norm elementwise chain runs on the shard fc1 produced instead of
+    an all-gathered copy (parallel/constraints.py)."""
+    from ..parallel import shard_activation
+    return shard_activation(x, 'hidden')
+
+
 class Mlp(nnx.Module):
     """fc1 → act → drop → (norm) → fc2 → drop."""
 
@@ -60,7 +69,7 @@ class Mlp(nnx.Module):
         self.drop2 = Dropout(drop_probs[1], rngs=rngs)
 
     def __call__(self, x):
-        x = self.fc1(x)
+        x = _shard_hidden(self.fc1(x))
         x = self.act(x)
         x = self.drop1(x)
         if self.norm is not None:
@@ -150,6 +159,7 @@ class GluMlp(nnx.Module):
         x = self.fc1(x)
         x1, x2 = jnp.split(x, 2, axis=-1)
         x = x1 * self.act(x2) if self.gate_last else self.act(x1) * x2
+        x = _shard_hidden(x)
         x = self.drop1(x)
         if self.norm is not None:
             x = self.norm(x)
@@ -195,7 +205,7 @@ class SwiGLU(nnx.Module):
         self.drop2 = Dropout(drop_probs[1], rngs=rngs)
 
     def __call__(self, x):
-        x = self.act(self.fc1_g(x)) * self.fc1_x(x)
+        x = _shard_hidden(self.act(self.fc1_g(x)) * self.fc1_x(x))
         x = self.drop1(x)
         if self.norm is not None:
             x = self.norm(x)

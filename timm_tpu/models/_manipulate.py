@@ -298,8 +298,17 @@ def scan_block_stack(blocks, x, call_block=None, *, per_layer=None, remat: bool 
     wraps the body in `jax.checkpoint` (remat-inside-scan replaces
     `checkpoint_seq` for scanned stacks). ``collect=True`` additionally
     returns the stacked per-layer outputs ``[L, ...]`` (forward_intermediates).
+
+    On a mesh with a 'model' axis the scan CARRY is pinned to the residual
+    sharding (batch over data/fsdp, channels over 'model') — both the initial
+    carry and the per-step output. Without the in-body constraint GSPMD must
+    pick one layout for the whole while-loop and picks replicated, which is
+    the involuntary-remat pattern PERF.md documents; with it, activations
+    stay model-sharded across all L layers. No-op on tp=1 meshes.
     """
     import jax
+
+    from ..parallel import shard_activation
 
     graphdef, rng_state, stacked = build_block_stack(blocks, validate=validate)
     if call_block is None:
@@ -307,10 +316,13 @@ def scan_block_stack(blocks, x, call_block=None, *, per_layer=None, remat: bool 
 
     from flax import nnx
 
+    x = shard_activation(x, 'residual')
+
     def body(carry, xs):
         layer_state, extra = xs
         blk = nnx.merge(graphdef, rng_state, layer_state)
         y = call_block(blk, carry, extra)
+        y = shard_activation(y, 'residual')
         return y, (y if collect else None)
 
     if remat:

@@ -95,7 +95,8 @@ class BeitAttention(nnx.Module):
                 self.q_bias[...], jnp.zeros_like(self.q_bias[...]), self.v_bias[...]])
             qkv = qkv + bias.astype(qkv.dtype)
         qkv = qkv.reshape(B, N, 3, self.num_heads, self.head_dim).transpose(2, 0, 3, 1, 4)
-        q, k, v = qkv[0], qkv[1], qkv[2]
+        from ..parallel import shard_activation
+        q, k, v = (shard_activation(t, 'heads') for t in (qkv[0], qkv[1], qkv[2]))
 
         attn_bias = None
         if self.rel_pos_bias is not None:
@@ -113,7 +114,7 @@ class BeitAttention(nnx.Module):
         x = scaled_dot_product_attention(
             q, k, v, attn_mask=attn_bias, dropout_p=dropout_p, dropout_key=dropout_key,
             scale=self.scale, fused=False)
-        x = x.transpose(0, 2, 1, 3).reshape(B, N, -1)
+        x = shard_activation(x.transpose(0, 2, 1, 3).reshape(B, N, -1), 'hidden')
         x = self.proj(x)
         return self.proj_drop(x)
 
@@ -346,6 +347,8 @@ class Beit(nnx.Module):
                 return x
             except BlockStackError as e:
                 warn_scan_fallback(type(self).__name__, e)
+        from ..parallel import shard_activation
+        x = shard_activation(x, 'residual')
         if self.grad_checkpointing:
             if shared_bias is None:
                 x = checkpoint_seq(self.blocks, x)
@@ -354,10 +357,10 @@ class Beit(nnx.Module):
                 # graph handling sees the module directly (not via a partial)
                 remat_block = nnx.remat(lambda blk, x_, b: blk(x_, shared_rel_pos_bias=b))
                 for blk in self.blocks:
-                    x = remat_block(blk, x, shared_bias)
+                    x = shard_activation(remat_block(blk, x, shared_bias), 'residual')
         else:
             for blk in self.blocks:
-                x = blk(x, shared_rel_pos_bias=shared_bias)
+                x = shard_activation(blk(x, shared_rel_pos_bias=shared_bias), 'residual')
         if self.norm is not None:
             x = self.norm(x)
         return x

@@ -108,6 +108,8 @@ class EvaAttention(nnx.Module):
             q = self.q_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
             k = self.k_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
             v = self.v_proj(x).reshape(B, N, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        from ..parallel import shard_activation
+        q, k, v = (shard_activation(t, 'heads') for t in (q, k, v))
         if self.q_norm is not None:
             q = self.q_norm(q)
         if self.k_norm is not None:
@@ -128,7 +130,7 @@ class EvaAttention(nnx.Module):
         dropout_key = self._drk(self.attn_drop) if dropout_p > 0.0 else None
         x = self._sdpa(q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
                        dropout_key=dropout_key, scale=self.scale)
-        x = x.transpose(0, 2, 1, 3).reshape(B, N, C)
+        x = shard_activation(x.transpose(0, 2, 1, 3).reshape(B, N, C), 'hidden')
         if self.norm is not None:
             x = self.norm(x)
         x = self.proj(x)
@@ -507,6 +509,8 @@ class Eva(nnx.Module):
                     remat=self.grad_checkpointing)
             except BlockStackError as e:
                 warn_scan_fallback(type(self).__name__, e)
+        from ..parallel import shard_activation
+        x = shard_activation(x, 'residual')
         remat_block = None
         if self.grad_checkpointing:
             def run_block(blk, x_, rope_, mask_):
@@ -519,6 +523,7 @@ class Eva(nnx.Module):
                 x = remat_block(blk, x, blk_rope, attn_mask)
             else:
                 x = blk(x, rope=blk_rope, attn_mask=attn_mask)
+            x = shard_activation(x, 'residual')
         return x
 
     def forward_features(self, x, attn_mask=None):

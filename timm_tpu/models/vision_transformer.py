@@ -833,7 +833,10 @@ class VisionTransformer(nnx.Module):
         checkpointing is on; per-layer DropPath rates ride a scanned rate
         vector). Otherwise: the Python loop (checkpoint_seq when grad
         checkpointing and unmasked). `collect=True` additionally returns the
-        list of per-layer outputs (forward_intermediates)."""
+        list of per-layer outputs (forward_intermediates). Either path pins
+        the residual stream to the tensor-parallel layout on 'model' meshes
+        (scan does it on the carry inside scan_block_stack)."""
+        from ..parallel import shard_activation
         blocks = self.blocks if blocks is None else blocks
         if self.block_scan:
             try:
@@ -851,16 +854,17 @@ class VisionTransformer(nnx.Module):
                 return out
             except BlockStackError as e:
                 warn_scan_fallback(type(self).__name__, e)
+        x = shard_activation(x, 'residual')
         if collect:
             outs = []
             for blk in blocks:
-                x = blk(x, attn_mask=attn_mask)
+                x = shard_activation(blk(x, attn_mask=attn_mask), 'residual')
                 outs.append(x)
             return x, outs
         if self.grad_checkpointing and attn_mask is None:
             return checkpoint_seq(blocks, x)
         for blk in blocks:
-            x = blk(x, attn_mask=attn_mask)
+            x = shard_activation(blk(x, attn_mask=attn_mask), 'residual')
         return x
 
     def pool(self, x, pool_type: Optional[str] = None, mask=None):

@@ -1,13 +1,15 @@
 from .mesh import (
-    batch_axes, create_mesh, data_sharding, get_global_mesh, replicate_sharding, set_global_mesh,
-    shard_batch,
+    batch_axes, create_mesh, data_sharding, get_global_mesh, nonmodel_batch_axes, peek_global_mesh,
+    replicate_sharding, set_global_mesh, shard_batch,
 )
 from .distributed import (
     all_hosts_flag, init_distributed_device, is_distributed_env, is_primary, reduce_tensor,
     world_info,
 )
 from .sharding import (
-    PartitionRule, abstract_init_sharded, build_opt_shardings, build_param_shardings,
-    create_sharded_model, default_partition_rules, fsdp_size, inherit_param_specs, match_rule,
-    param_bytes_per_device, path_specs, replicated_like, shard_pytree, spec_for_param,
+    PartitionRule, abstract_init_sharded, activation_bytes_per_device, build_opt_shardings,
+    build_param_shardings, create_sharded_model, default_partition_rules, fsdp_size,
+    inherit_param_specs, match_rule, param_bytes_per_device, path_specs, replicated_like,
+    shard_pytree, spec_for_param, tp_size,
 )
+from .constraints import shard_activation

@@ -1,0 +1,75 @@
+"""Activation sharding constraints for tensor parallelism.
+
+Parameter sharding alone (parallel/sharding.py) leaves GSPMD free to pick
+activation layouts, and on a ('data', 'fsdp', 'model') mesh it picks badly:
+PERF.md records XLA "involuntary full rematerialization" notes where
+replicated activations meet model-sharded kernels inside the scanned block
+body — every device all-gathers the full hidden tensor it was supposed to
+never materialize. `jax.lax.with_sharding_constraint` pins the layout at the
+three places that matter (the MaxText/big_vision idiom):
+
+  * 'residual' — the (B, N, C) stream between blocks AND the lax.scan carry
+    (models/_manipulate.py), batch over the non-'model' axes, channels over
+    'model';
+  * 'heads'    — (B, H, N, D) attention tensors, heads over 'model';
+  * 'hidden'   — (B, N, hidden) MLP/attention intermediates, hidden over
+    'model'.
+
+Everything degrades to a no-op: no global mesh, no 'model' axis, a rank the
+kind does not expect (vmapped calls see rank-2 slices), or a dim not
+divisible by its axis size — so single-device eval, tp=1 meshes, and odd
+head counts all run today's programs unchanged. Constraints are sharding
+METADATA, not collectives: tp=1 output is bit-identical, and under tp>1 any
+numeric difference is fp reduction order only.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import nonmodel_batch_axes, peek_global_mesh
+
+__all__ = ['shard_activation']
+
+# kind -> (expected rank, index of the dim sharded over 'model')
+_KINDS = {
+    'residual': (3, 2),  # (B, N, C): channels over 'model'
+    'heads': (4, 1),     # (B, H, N, head_dim): heads over 'model'
+    'hidden': (3, 2),    # (B, N, hidden): hidden features over 'model'
+}
+
+
+def shard_activation(x, kind: str, mesh: Optional[Mesh] = None):
+    """Constrain one activation tensor's layout; identity when the mesh (or
+    tensor) can't honour it.
+
+    Inside jit this lowers to a sharding_constraint op — the presence the
+    remat regression test greps for in the scan-body jaxpr. Outside jit (or
+    when no constraint applies) it returns `x` untouched, so eager layer
+    calls and unit tests never pay for it.
+    """
+    if kind not in _KINDS:
+        raise ValueError(f'unknown activation kind {kind!r}; expected one of {sorted(_KINDS)}')
+    mesh = mesh if mesh is not None else peek_global_mesh()
+    if mesh is None or 'model' not in mesh.axis_names:
+        return x
+    rank, model_dim = _KINDS[kind]
+    shape = getattr(x, 'shape', None)
+    if shape is None or len(shape) != rank:
+        return x
+    batch_axes = nonmodel_batch_axes(mesh)
+    n_batch = 1
+    for a in batch_axes:
+        n_batch *= int(mesh.shape[a])
+    if n_batch > 1 and shape[0] % n_batch != 0:
+        return x
+    spec = [None] * rank
+    if n_batch > 1:
+        spec[0] = batch_axes if len(batch_axes) > 1 else batch_axes[0]
+    if shape[model_dim] % int(mesh.shape['model']) == 0:
+        spec[model_dim] = 'model'
+    if all(s is None for s in spec):
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))

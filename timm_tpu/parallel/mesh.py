@@ -4,7 +4,7 @@ TPU-native replacement for the reference's DDP/NCCL stack
 (reference: timm/utils/distributed.py:79-159, task/classification.py:64-66).
 
 Data parallelism is expressed as a mesh, not processes: batches are sharded
-over the batch axes, params are replicated (or fsdp-sharded, see
+over the batch axes, params are replicated (or fsdp/tensor-sharded, see
 parallel/sharding.py), and XLA emits the grad all-reduce over ICI/DCN.
 
 Mesh shapes:
@@ -13,7 +13,15 @@ Mesh shapes:
     collectives ride ICI within a slice;
   * `('data', 'fsdp')` / `('dcn', 'data', 'fsdp')` — ZeRO-style sharding:
     the BATCH is sharded over the product of every axis (all devices see
-    different samples), while params/optimizer state shard over 'fsdp' only.
+    different samples), while params/optimizer state shard over 'fsdp' only;
+  * `('data', 'fsdp', 'model')` — adds Megatron-style tensor parallelism:
+    attention QKV/proj kernels shard heads and MLP fc1/fc2 kernels shard the
+    hidden dim over 'model', and activation sharding constraints
+    (parallel/constraints.py) keep the residual stream and attention/MLP
+    internals sharded inside the block scan. The INPUT batch still shards
+    over the product of all axes (maximum host→device transfer parallelism);
+    the model's first residual constraint redistributes it to
+    (batch over data×fsdp) × (channels over model).
 """
 from __future__ import annotations
 
@@ -26,10 +34,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     'create_mesh', 'data_sharding', 'replicate_sharding', 'shard_batch',
-    'get_global_mesh', 'set_global_mesh', 'batch_axes',
+    'get_global_mesh', 'set_global_mesh', 'peek_global_mesh', 'batch_axes',
+    'nonmodel_batch_axes',
 ]
 
 _GLOBAL_MESH: Optional[Mesh] = None
+
+
+def _mesh_axes_str(axes) -> str:
+    """'data=2, fsdp=2, model=2 (8 devices)' from {axis: size} pairs."""
+    items = list(axes.items() if isinstance(axes, dict) else axes)
+    total = int(np.prod([s for _, s in items])) if items else 1
+    return ', '.join(f'{n}={s}' for n, s in items) + f' ({total} devices)'
 
 
 def create_mesh(
@@ -37,35 +53,56 @@ def create_mesh(
         data_axis: str = 'data',
         num_slices: Optional[int] = None,
         fsdp: Optional[int] = None,
+        tp: Optional[int] = None,
 ) -> Mesh:
-    """Data-parallel mesh, optionally with an 'fsdp' parameter-sharding axis.
+    """Data-parallel mesh, optionally with 'fsdp' (parameter sharding) and
+    'model' (tensor parallelism) axes.
 
-    `fsdp=N` (or env TIMM_TPU_FSDP) folds the trailing N devices of each
-    data group into a second axis: 8 devices with fsdp=4 gives a
-    ``('data', 'fsdp')`` mesh of shape (2, 4). Batches still shard over all
-    8 devices (see `shard_batch`); params/optimizer state shard over the 4
-    fsdp devices per data group (parallel/sharding.py). With multiple DCN
-    slices the mesh is ``('dcn', data_axis[, 'fsdp'])`` so collectives ride
-    ICI within a slice.
+    `fsdp=N` (or env TIMM_TPU_FSDP) folds N devices of each data group into a
+    second axis; `tp=M` (or env TIMM_TPU_TP) folds M more into a trailing
+    'model' axis: 8 devices with fsdp=2, tp=2 gives a
+    ``('data', 'fsdp', 'model')`` mesh of shape (2, 2, 2). Batches shard over
+    the product of ALL axes (see `shard_batch`); params/optimizer state shard
+    over 'fsdp', and attention-head / MLP-hidden kernel dims (plus the
+    activation constraints) shard over 'model' (parallel/sharding.py). With
+    multiple DCN slices the mesh is ``('dcn', data_axis[, 'fsdp'][, 'model'])``
+    so collectives ride ICI within a slice. `fsdp=1`/`tp=1` (the defaults)
+    omit their axes entirely, reproducing the smaller-mesh behaviour exactly.
     """
     devices = list(devices) if devices is not None else jax.devices()
     if fsdp is None:
         fsdp = int(os.environ.get('TIMM_TPU_FSDP', '1') or 1)
     fsdp = max(1, fsdp)
+    if tp is None:
+        tp = int(os.environ.get('TIMM_TPU_TP', '1') or 1)
+    tp = max(1, tp)
     if num_slices is None:
         # group by process/slice when running multi-host
         slice_ids = {getattr(d, 'slice_index', 0) for d in devices}
         num_slices = len(slice_ids)
+    # trailing axes (closest ICI neighbours) host the most collective-hungry
+    # parallelism: fsdp before model, model innermost
+    trailing = []
     if fsdp > 1:
+        trailing.append(('fsdp', fsdp))
+    if tp > 1:
+        trailing.append(('model', tp))
+    if trailing:
         per_slice = len(devices) // max(num_slices, 1)
-        if per_slice % fsdp != 0:
+        n_trail = fsdp * tp
+        if per_slice % n_trail != 0:
+            axes = [('data', per_slice // n_trail if n_trail and per_slice % n_trail == 0 else '?'),
+                    ('fsdp', fsdp), ('model', tp)]
             raise ValueError(
-                f'fsdp={fsdp} must divide the {per_slice} devices per slice '
-                f'({len(devices)} devices / {num_slices} slice(s))')
+                f'mesh axes fsdp={fsdp} x tp={tp} = {n_trail} must divide the {per_slice} '
+                f'devices per slice ({len(devices)} devices / {num_slices} slice(s)); '
+                f'requested mesh would be ({", ".join(f"{n}={s}" for n, s in axes)})')
+        shape = [-1] + [s for _, s in trailing]
+        names = (data_axis,) + tuple(n for n, _ in trailing)
         if num_slices > 1:
-            dev_array = np.array(devices).reshape(num_slices, -1, fsdp)
-            return Mesh(dev_array, ('dcn', data_axis, 'fsdp'))
-        return Mesh(np.array(devices).reshape(-1, fsdp), (data_axis, 'fsdp'))
+            dev_array = np.array(devices).reshape(num_slices, *shape)
+            return Mesh(dev_array, ('dcn',) + names)
+        return Mesh(np.array(devices).reshape(*shape), names)
     if num_slices > 1:
         dev_array = np.array(devices).reshape(num_slices, -1)
         return Mesh(dev_array, ('dcn', data_axis))
@@ -84,11 +121,27 @@ def get_global_mesh() -> Mesh:
     return _GLOBAL_MESH
 
 
+def peek_global_mesh() -> Optional[Mesh]:
+    """The global mesh if one was set, WITHOUT creating a default one — the
+    zero-cost probe the activation-constraint helpers use on every layer call
+    (parallel/constraints.py): no mesh or no 'model' axis → no-op."""
+    return _GLOBAL_MESH
+
+
 def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
-    """Batch is sharded over EVERY mesh axis — including 'fsdp': under ZeRO
-    all devices are data-parallel workers; only the parameter/optimizer
-    placement distinguishes the fsdp sub-axis."""
+    """Batch is sharded over EVERY mesh axis — including 'fsdp' and 'model':
+    from the host's view all devices are data-parallel workers; only the
+    parameter placement and the in-model activation constraints distinguish
+    the fsdp/model sub-axes."""
     return tuple(n for n in mesh.axis_names)
+
+
+def nonmodel_batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Batch axes for ACTIVATIONS inside the model: everything but 'model'.
+    Under tensor parallelism the 'model' axis carries head/hidden channel
+    shards, so the activation batch dim shards over the remaining axes only
+    (the residual-stream constraint redistributes the input batch once)."""
+    return tuple(n for n in mesh.axis_names if n != 'model')
 
 
 _batch_axes = batch_axes  # backwards-compat private alias
@@ -105,15 +158,17 @@ def replicate_sharding(mesh: Mesh) -> NamedSharding:
 
 def shard_batch(batch, mesh: Optional[Mesh] = None):
     """Place a host batch (pytree of arrays) sharded over the mesh batch axes
-    (their product for a 2-axis ('data', 'fsdp') mesh). Non-array leaves pass
-    through; 0-d arrays are replicated (a rank-0 value has no batch dim to
-    shard — seq_len/step counters in dict batches).
+    (their product for multi-axis ('data', 'fsdp'[, 'model']) meshes).
+    Non-array leaves pass through; 0-d arrays are replicated (a rank-0 value
+    has no batch dim to shard — seq_len/step counters in dict batches).
 
     Raises a loud ValueError when the global batch is not divisible by the
     total batch-shard count — the alternative is an opaque XLA reshape error
     from deep inside the jitted step."""
     mesh = mesh or get_global_mesh()
-    n_shards = int(np.prod([mesh.shape[a] for a in batch_axes(mesh)]))
+    axes = batch_axes(mesh)
+    sizes = [(a, int(mesh.shape[a])) for a in axes]
+    n_shards = int(np.prod([s for _, s in sizes]))
 
     def put(x):
         ndim = getattr(x, 'ndim', None)
@@ -122,10 +177,14 @@ def shard_batch(batch, mesh: Optional[Mesh] = None):
         if ndim == 0:
             return jax.device_put(x, replicate_sharding(mesh))
         if x.shape[0] % n_shards != 0:
+            b = x.shape[0]
+            lo, hi = (b // n_shards) * n_shards, -(-b // n_shards) * n_shards
+            nearest = f'{hi}' if lo == 0 else f'{lo} or {hi}'
             raise ValueError(
-                f'Global batch dim {x.shape[0]} is not divisible by the mesh batch-shard '
-                f'count {n_shards} (mesh {dict(mesh.shape)}; the batch shards over '
-                f'{"x".join(batch_axes(mesh))}). Pad the batch or pick a batch size that '
-                f'divides evenly — e.g. validate.py pads the final partial batch.')
+                f'Global batch dim {b} is not divisible by the mesh batch-shard '
+                f'count {n_shards}: the batch shards over the product of ALL mesh axes '
+                f'({_mesh_axes_str(sizes)}). Nearest legal global batch: {nearest}. '
+                f'Pad the batch or pick a batch size that divides evenly — e.g. '
+                f'validate.py pads the final partial batch.')
         return jax.device_put(x, data_sharding(mesh, ndim=ndim))
     return jax.tree.map(put, batch)

@@ -41,7 +41,8 @@ __all__ = [
     'spec_for_param', 'build_param_shardings', 'path_specs',
     'inherit_param_specs', 'build_opt_shardings',
     'shard_pytree', 'abstract_init_sharded', 'create_sharded_model',
-    'replicated_like', 'fsdp_size', 'param_bytes_per_device',
+    'replicated_like', 'fsdp_size', 'tp_size', 'param_bytes_per_device',
+    'activation_bytes_per_device',
 ]
 
 # Sharding a tiny tensor buys no memory and costs collective latency; params
@@ -54,9 +55,13 @@ class PartitionRule:
     """One ordered partition rule: `pattern` is re.search'ed against the
     '.'-joined param path; first match wins.
 
-    `action` is either 'fsdp_largest' (shard the largest dimension divisible
-    by the fsdp axis size), 'replicate', or an explicit PartitionSpec-like
-    tuple (validated against the leaf's rank/divisibility at apply time).
+    `action` is one of 'fsdp_largest' (shard the largest dimension divisible
+    by the fsdp axis size), 'megatron_col' / 'megatron_row' (tensor
+    parallelism: shard the output / input feature dim over 'model', stacking
+    'fsdp' on another dim when both axes exist; with no 'model' axis these
+    delegate to 'fsdp_largest' so tp=1 placement is bit-identical to the
+    2-axis mesh), 'replicate', or an explicit PartitionSpec-like tuple
+    (validated against the leaf's rank/divisibility at apply time).
     """
     pattern: str
     action: Any = 'fsdp_largest'
@@ -66,19 +71,44 @@ class PartitionRule:
         return re.search(self.pattern, path) is not None
 
 
-def default_partition_rules() -> Tuple[PartitionRule, ...]:
-    """FSDP rules for the timm_tpu model families. Ordered, first-match-wins,
-    mutually exclusive on every ViT param path (tests assert exactly one rule
-    matches each param):
+# Tensor-parallel kernel paths (Megatron split): column-parallel layers write
+# the dimension that gets CONSUMED shard-local downstream (attention heads for
+# qkv/q/k/v, MLP hidden for fc1*), row-parallel layers read it back and XLA
+# emits one reduce per pair (attn.proj, mlp.fc2). The generic kernel rule
+# excludes all four via lookahead so the rule table stays DISJOINT — the
+# exactly-one-rule test is what keeps placement auditable.
+_TP_ATTN_QKV = r'\.attn\.(?:qkv|q_proj|k_proj|v_proj)\.kernel$'
+_TP_ATTN_OUT = r'\.attn\.proj\.kernel$'
+_TP_MLP_IN = r'\.mlp\.(?:fc1|fc1_g|fc1_x)\.kernel$'
+_TP_MLP_OUT = r'\.mlp\.fc2\.kernel$'
+_TP_KERNEL_PATTERNS = (_TP_ATTN_QKV, _TP_ATTN_OUT, _TP_MLP_IN, _TP_MLP_OUT)
+_GENERIC_KERNEL = r'^(?!.*(?:' + '|'.join(_TP_KERNEL_PATTERNS) + r')).*\.kernel$'
 
-      1. 2D+ matmul / conv kernels        -> shard largest divisible dim
-      2. biases                           -> replicate
-      3. norm scales / LayerScale gammas  -> replicate
-      4. tokens & position embeddings     -> replicate
-      5. everything else                  -> replicate (catch-all)
+
+def default_partition_rules() -> Tuple[PartitionRule, ...]:
+    """FSDP + tensor-parallel rules for the timm_tpu model families. Ordered,
+    first-match-wins, mutually exclusive on every ViT param path (tests assert
+    exactly one rule matches each param):
+
+      1. attention qkv / q,k,v kernels    -> heads over 'model' (column)
+      2. attention output proj kernels    -> input dim over 'model' (row)
+      3. MLP fc1 (incl. glu gates)        -> hidden over 'model' (column)
+      4. MLP fc2                          -> hidden over 'model' (row)
+      5. other 2D+ matmul / conv kernels  -> shard largest divisible dim
+      6. biases                           -> replicate
+      7. norm scales / LayerScale gammas  -> replicate
+      8. tokens & position embeddings     -> replicate
+      9. everything else                  -> replicate (catch-all)
+
+    Rules 1-4 fall back to 'fsdp_largest' placement when the mesh has no
+    'model' axis, so tp=1 reproduces the 2-axis table exactly.
     """
     return (
-        PartitionRule(r'\.kernel$', 'fsdp_largest', name='kernel'),
+        PartitionRule(_TP_ATTN_QKV, 'megatron_col', name='attn-qkv'),
+        PartitionRule(_TP_ATTN_OUT, 'megatron_row', name='attn-out'),
+        PartitionRule(_TP_MLP_IN, 'megatron_col', name='mlp-fc1'),
+        PartitionRule(_TP_MLP_OUT, 'megatron_row', name='mlp-fc2'),
+        PartitionRule(_GENERIC_KERNEL, 'fsdp_largest', name='kernel'),
         PartitionRule(r'\.bias$', 'replicate', name='bias'),
         PartitionRule(r'(^|\.)(scale|weight|gamma|gamma_1|gamma_2|lambda_q1|lambda_q2|lambda_k1|lambda_k2)$',
                       'replicate', name='norm-scale'),
@@ -93,6 +123,11 @@ def fsdp_size(mesh: Mesh) -> int:
     return int(mesh.shape['fsdp']) if 'fsdp' in mesh.axis_names else 1
 
 
+def tp_size(mesh: Mesh) -> int:
+    """Size of the 'model' (tensor-parallel) axis, or 1 when the mesh has none."""
+    return int(mesh.shape['model']) if 'model' in mesh.axis_names else 1
+
+
 def match_rule(path: str, rules: Optional[Sequence[PartitionRule]] = None) -> Tuple[int, PartitionRule]:
     """First-match-wins rule lookup; returns (index, rule). The default rule
     set ends with a catch-all so this always resolves."""
@@ -102,6 +137,78 @@ def match_rule(path: str, rules: Optional[Sequence[PartitionRule]] = None) -> Tu
             return i, rule
     raise ValueError(f'No partition rule matched param path {path!r} '
                      f'(rule sets should end with a catch-all)')
+
+
+_WARNED_PATHS = set()
+
+
+def _warn_once(path: str, msg: str):
+    """Log a WARNING the first time a given param path degrades — loud enough
+    to audit (tests assert on it), quiet enough not to spam every step."""
+    if path not in _WARNED_PATHS:
+        _WARNED_PATHS.add(path)
+        _logger.warning(msg)
+
+
+def _fsdp_largest_spec(path: str, shape: Sequence[int], mesh: Mesh,
+                       min_shard_size: int) -> P:
+    """'fsdp_largest' action: shard the largest fsdp-divisible dim."""
+    n_shard = fsdp_size(mesh)
+    size = int(np.prod(shape)) if len(shape) else 1
+    if n_shard <= 1 or len(shape) < 2 or size < min_shard_size:
+        return P()
+    # largest divisible dim → most even memory split; ties break to the
+    # RIGHTMOST such dim (output features; matches megatron convention)
+    best = None
+    for i, d in enumerate(shape):
+        if d % n_shard == 0 and (best is None or d >= shape[best]):
+            best = i
+    if best is None:
+        _logger.debug(f'fsdp: no dim of {path} {tuple(shape)} divisible by {n_shard}; replicating')
+        return P()
+    spec = [None] * len(shape)
+    spec[best] = 'fsdp'
+    return P(*spec)
+
+
+def _megatron_spec(path: str, shape: Sequence[int], mesh: Mesh, rule_name: str,
+                   col: bool, min_shard_size: int) -> P:
+    """'megatron_col'/'megatron_row' actions: tensor-parallel kernel split.
+
+    Column-parallel shards the LAST dim (output features — stacked heads for
+    qkv, MLP hidden for fc1) over 'model'; row-parallel shards the FIRST dim
+    (input features). When the mesh also has an fsdp axis the largest
+    remaining divisible dim picks up 'fsdp' too (2-D sharded weights,
+    MaxText-style), which is what the optimizer m/v inherit so donation
+    aliasing stays legal. Without a 'model' axis this IS 'fsdp_largest' —
+    tp=1 placement is bit-identical to the 2-axis mesh. A head/hidden dim
+    not divisible by the tp size replicates with a logged warning (never
+    silently): the checkpoint still loads, placement is just degraded.
+    """
+    n_tp = tp_size(mesh)
+    if n_tp <= 1:
+        return _fsdp_largest_spec(path, shape, mesh, min_shard_size)
+    size = int(np.prod(shape)) if len(shape) else 1
+    if len(shape) < 2 or size < min_shard_size:
+        return P()
+    model_dim = len(shape) - 1 if col else 0
+    if shape[model_dim] % n_tp != 0:
+        _warn_once(path, (
+            f"tp rule {rule_name!r}: {'output' if col else 'input'} dim "
+            f'{shape[model_dim]} of {path} {tuple(shape)} is not divisible by '
+            f"the 'model' axis size {n_tp}; replicating this param"))
+        return P()
+    spec = [None] * len(shape)
+    spec[model_dim] = 'model'
+    n_fsdp = fsdp_size(mesh)
+    if n_fsdp > 1:
+        best = None
+        for i, d in enumerate(shape):
+            if i != model_dim and d % n_fsdp == 0 and (best is None or d >= shape[best]):
+                best = i
+        if best is not None:
+            spec[best] = 'fsdp'
+    return P(*spec)
 
 
 def spec_for_param(
@@ -114,33 +221,21 @@ def spec_for_param(
     """Resolve one param's PartitionSpec from the rule table + its shape.
 
     Shape validation is part of the contract: when the matched rule wants to
-    shard but no dimension is divisible by the fsdp axis size (or the param is
-    tiny), the param falls back to replicated so any checkpoint loads on any
-    mesh shape.
+    shard but no dimension is divisible by the owning axis size (or the param
+    is tiny), the param falls back to replicated so any checkpoint loads on
+    any mesh shape.
     """
-    n_shard = fsdp_size(mesh)
-    if n_shard <= 1:
+    if fsdp_size(mesh) <= 1 and tp_size(mesh) <= 1:
         return P()
     _, rule = match_rule(path, rules)
     action = rule.action
     if action == 'replicate':
         return P()
-    size = int(np.prod(shape)) if len(shape) else 1
     if action == 'fsdp_largest':
-        if len(shape) < 2 or size < min_shard_size:
-            return P()
-        # largest divisible dim → most even memory split; ties break to the
-        # RIGHTMOST such dim (output features; matches megatron convention)
-        best = None
-        for i, d in enumerate(shape):
-            if d % n_shard == 0 and (best is None or d >= shape[best]):
-                best = i
-        if best is None:
-            _logger.debug(f'fsdp: no dim of {path} {tuple(shape)} divisible by {n_shard}; replicating')
-            return P()
-        spec = [None] * len(shape)
-        spec[best] = 'fsdp'
-        return P(*spec)
+        return _fsdp_largest_spec(path, shape, mesh, min_shard_size)
+    if action in ('megatron_col', 'megatron_row'):
+        return _megatron_spec(path, shape, mesh, rule.name or rule.pattern,
+                              action == 'megatron_col', min_shard_size)
     # explicit spec tuple: validate rank + divisibility, else replicate loudly
     spec = tuple(action)
     if len(spec) != len(shape):
@@ -325,16 +420,67 @@ def create_sharded_model(
         return model
 
 
+def _spec_shard_count(spec: P, mesh: Mesh) -> int:
+    """How many ways a spec splits a tensor: the product of the mesh sizes of
+    every named axis in it (a 2-D ('fsdp','model') spec divides bytes by
+    fsdp_size * tp_size, not fsdp_size alone)."""
+    n = 1
+    for ax in spec:
+        if ax is None:
+            continue
+        for a in (ax if isinstance(ax, tuple) else (ax,)):
+            n *= int(mesh.shape[a])
+    return n
+
+
 def param_bytes_per_device(tree, mesh: Mesh,
                            rules: Optional[Sequence[PartitionRule]] = None) -> Tuple[int, int]:
-    """(replicated_bytes, fsdp_sharded_bytes) a single device would hold for
-    `tree` under the rule set — the PERF.md 'Sharding & memory' numbers."""
-    n = fsdp_size(mesh)
+    """(replicated_bytes, sharded_bytes) a single device would hold for
+    `tree` under the rule set — the PERF.md 'Sharding & memory' numbers.
+    Sharded bytes divide by the product of EVERY mesh axis in the param's
+    spec (fsdp x model for the 2-D tensor-parallel kernels)."""
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     rep = shard = 0
     for kp, leaf in flat:
         nbytes = int(np.prod(getattr(leaf, 'shape', ()) or (1,))) * np.dtype(leaf.dtype).itemsize
         rep += nbytes
         spec = spec_for_param(_kp_str(kp), getattr(leaf, 'shape', ()), mesh, rules)
-        shard += nbytes // n if any(ax is not None for ax in spec) else nbytes
+        shard += nbytes // _spec_shard_count(spec, mesh)
     return rep, shard
+
+
+def activation_bytes_per_device(
+        mesh: Mesh,
+        *,
+        batch_size: int,
+        seq_len: int,
+        width: int,
+        depth: int,
+        mlp_ratio: float = 4.0,
+        bytes_per_elem: int = 4,
+) -> Tuple[int, int]:
+    """(unconstrained_bytes, constrained_bytes) of transformer-block
+    activations one device holds per step — the PERF.md companion to
+    `param_bytes_per_device` for fsdp x tp grids.
+
+    Counts the dominant per-block tensors (residual stream, q/k/v, MLP
+    hidden ~ seq_len x width x (4 + mlp_ratio) elements) across `depth`
+    blocks. 'Unconstrained' is the PR-5 state: the batch dim shards over the
+    non-'model' axes but channels replicate, so adding tp devices buys no
+    activation memory (this is exactly the involuntary-remat regime).
+    'Constrained' applies the parallel/constraints.py specs: channel/head/
+    hidden dims additionally shard over 'model' where divisible, so
+    activation bytes scale ~1/tp. With tp=1 the two numbers are equal.
+    """
+    n_tp = tp_size(mesh)
+    n_batch = max(1, int(np.prod([int(s) for s in mesh.shape.values()])) // n_tp)
+    hidden = int(width * mlp_ratio)
+
+    def elems(channel_div: bool) -> int:
+        resid_qkv = 4 * seq_len * width // (n_tp if channel_div and width % n_tp == 0 else 1)
+        mlp = seq_len * hidden // (n_tp if channel_div and hidden % n_tp == 0 else 1)
+        return batch_size * depth * (resid_qkv + mlp)
+
+    unconstrained = elems(False) * bytes_per_elem // n_batch
+    constrained = elems(True) * bytes_per_elem // n_batch
+    return unconstrained, constrained

@@ -14,7 +14,14 @@ the **jitted train/eval step functions**. Design:
   * placement comes from `parallel/sharding.py`: on a 1-axis data mesh every
     sharding is replicated (exact pre-FSDP behaviour); on a
     ``('data', 'fsdp')`` mesh large weights and their optimizer slots shard
-    over 'fsdp' and GSPMD emits the gather/scatter collectives.
+    over 'fsdp' and GSPMD emits the gather/scatter collectives; on a
+    ``('data', 'fsdp', 'model')`` mesh the attention/MLP kernels additionally
+    shard heads/hidden over 'model' (Megatron split) and the models'
+    activation constraints (parallel/constraints.py) keep the residual
+    stream and block internals sharded inside the scanned step. The jit
+    wiring below is axis-agnostic — the same in/out sharding trees carry
+    1-, 2-, and 3-axis placements, and donation stays legal because the
+    optimizer/EMA state inherits each param's spec leaf-for-leaf.
   * optimizer/EMA state is created ON-MESH via `jax.eval_shape` + jitted
     init with `out_shardings` — a replicated host copy of m/v never exists.
   * the reference's AMP scaler (utils/cuda.py:46) is unnecessary — bf16

@@ -69,6 +69,12 @@ def make_parser():
                             '(ZeRO-style; batch still shards over all devices). N must '
                             'divide the per-slice device count; 0 disables '
                             '(env TIMM_TPU_FSDP is the fallback default)')
+    group.add_argument('--tp', type=int, default=0, metavar='N',
+                       help="tensor parallelism: shard attention heads + MLP hidden over an "
+                            "N-way 'model' mesh axis (Megatron split) with activation "
+                            'sharding constraints on the residual stream. Composes with '
+                            '--fsdp (fsdp*tp must divide the per-slice device count); '
+                            '0 disables (env TIMM_TPU_TP is the fallback default)')
     group.add_argument('--amp', action='store_true', default=False,
                        help='bf16 compute (the TPU-native AMP)')
     group.add_argument('--amp-dtype', default='bfloat16', type=str)
@@ -269,7 +275,8 @@ def main():
     world_size, rank, _ = init_distributed_device(args)
     random_seed(args.seed, rank)
 
-    mesh = create_mesh(fsdp=args.fsdp if args.fsdp else None)
+    mesh = create_mesh(fsdp=args.fsdp if args.fsdp else None,
+                       tp=args.tp if args.tp else None)
     set_global_mesh(mesh)
     n_devices = mesh.size
     _logger.info(f'Training on mesh {mesh} ({n_devices} devices, {world_size} processes)')
@@ -300,7 +307,7 @@ def main():
                     raise
         return create_model(args.model, **factory_kwargs, **model_kwargs)
 
-    if 'fsdp' in mesh.axis_names:
+    if 'fsdp' in mesh.axis_names or 'model' in mesh.axis_names:
         # abstract init: nnx.eval_shape resolves the partition rules against
         # the abstract param shapes and a jitted constructor materializes each
         # shard on its owning devices — a replicated full-model copy never
@@ -376,14 +383,24 @@ def main():
         **task_kwargs,
     )
 
-    if 'fsdp' in mesh.axis_names:
+    if 'fsdp' in mesh.axis_names or 'model' in mesh.axis_names:
         from flax import nnx
-        from timm_tpu.parallel import param_bytes_per_device
+        from timm_tpu.parallel import activation_bytes_per_device, param_bytes_per_device
         rep_b, shard_b = param_bytes_per_device(nnx.state(model, nnx.Param), mesh)
+        axes_str = ' x '.join(f'{a}={mesh.shape[a]}' for a in mesh.axis_names)
         _logger.info(
-            f'FSDP over {mesh.shape["fsdp"]} devices: params per device '
+            f'Sharded mesh ({axes_str}): params per device '
             f'{shard_b / 1e6:.1f} MB (vs {rep_b / 1e6:.1f} MB replicated); optimizer '
             f'm/v shard identically (parallel/sharding.py rules)')
+        width = getattr(model, 'embed_dim', None)
+        depth = len(getattr(model, 'blocks', None) or ())
+        seq_len = getattr(getattr(model, 'patch_embed', None), 'num_patches', None)
+        if width and depth and seq_len:
+            act_u, act_c = activation_bytes_per_device(
+                mesh, batch_size=args.batch_size, seq_len=seq_len, width=width, depth=depth)
+            _logger.info(
+                f'Estimated block activations per device: {act_c / 1e6:.1f} MB with '
+                f'activation sharding constraints (vs {act_u / 1e6:.1f} MB without)')
 
     # loss selection (ref train.py:886-913)
     if args.jsd_loss:

@@ -62,6 +62,9 @@ parser.add_argument('--device-prefetch', type=int, default=0, metavar='N',
 parser.add_argument('--fsdp', type=int, default=0, metavar='N',
                     help="shard model weights over an N-way 'fsdp' mesh axis for eval "
                          '(fits models larger than one chip HBM); 0 disables')
+parser.add_argument('--tp', type=int, default=0, metavar='N',
+                    help="tensor parallelism for eval: shard attention heads + MLP hidden "
+                         "over an N-way 'model' mesh axis (composes with --fsdp); 0 disables")
 
 
 def validate(args):
@@ -77,7 +80,8 @@ def validate(args):
         jax.config.update('jax_platforms', args.device)
     from timm_tpu.utils import configure_compile_cache
     configure_compile_cache()
-    mesh = create_mesh(fsdp=args.fsdp if args.fsdp else None)
+    mesh = create_mesh(fsdp=args.fsdp if args.fsdp else None,
+                       tp=args.tp if args.tp else None)
     set_global_mesh(mesh)
 
     dtype = jnp.bfloat16 if args.amp else None
@@ -142,9 +146,10 @@ def validate(args):
 
     from flax import nnx
     graphdef, state = nnx.split(model)
-    if 'fsdp' in mesh.axis_names:
-        # large weights shard over 'fsdp' (path-rule placement); XLA gathers
-        # them before use, so eval fits models larger than one chip's HBM
+    if 'fsdp' in mesh.axis_names or 'model' in mesh.axis_names:
+        # large weights shard over 'fsdp'/'model' (path-rule placement); XLA
+        # gathers/keeps shards as the constraints dictate, so eval fits models
+        # larger than one chip's HBM
         from timm_tpu.parallel import build_param_shardings
         state = jax.device_put(state, build_param_shardings(state, mesh))
     mean = jnp.asarray(data_config['mean'], jnp.float32).reshape(1, 1, 1, -1)

@@ -287,6 +287,14 @@ def main():
                              'selftest: truncated-checkpoint fallback, reader retry/backoff, '
                              'poison-skip budget, @-step faults. SPEC is parse-checked; the '
                              'canonical drill set always runs (tier-1 smoke, no TPU).')
+    parser.add_argument('--serve', action='store_true',
+                        help='run the serving load drill instead of a train/infer bench: '
+                             'canonical continuous-batching vs per-request A/B (two models, '
+                             'two buckets, one LRU eviction) on synthetic open-loop Poisson '
+                             'traffic, reporting p50/p99 latency and img/s. CPU-runnable; '
+                             'combine with --dry-run for the tier-1 smoke.')
+    parser.add_argument('--serve-requests', type=int, default=256, metavar='N',
+                        help='(with --serve) requests per drill arm')
     parser.add_argument('--child', action='store_true',
                         help='internal: run the measurement in this process')
     parser.add_argument('--watchdog-s', type=int, default=None,
@@ -303,6 +311,9 @@ def main():
 
     if args.compile_report:
         raise SystemExit(_compile_report(args))
+
+    if args.serve:
+        raise SystemExit(_serve_drill(args))
 
     if args.dry_run:
         raise SystemExit(_dry_run(args))
@@ -474,6 +485,40 @@ def _dry_run(args) -> int:
                   f'{jax.default_backend()}, loss finite={ok}{fault_note}',
         'value': 1.0 if ok else 0.0, 'unit': 'ok', 'vs_baseline': None}), flush=True)
     return 0 if ok else 2
+
+
+def _serve_drill(args) -> int:
+    """Canonical serving A/B drill (ISSUE 8 acceptance): the SAME open-loop
+    Poisson schedule against the continuous-batching engine (buckets (4, 16),
+    two models under an HBM budget that forces one LRU eviction) and the
+    per-request baseline (bucket (1,), zero wait). Prints the human p50/p99
+    summary line, then the JSON result line whose value is the img/s speedup.
+    CPU-runnable end to end — wired like the --fault-inject drill smoke."""
+    from timm_tpu.serve import canonical_drill, summary_line
+
+    _status('serve drill: continuous-batching vs per-request A/B')
+    t0 = time.perf_counter()
+    try:
+        ab = canonical_drill(num_requests=args.serve_requests,
+                             persist_all_programs=True)
+    except AssertionError as e:
+        print(json.dumps({
+            'metric': f'serve drill FAILED: {e}',
+            'value': 0.0, 'unit': 'x img/s vs per-request', 'vs_baseline': None}),
+            flush=True)
+        return 2
+    c, b = ab['continuous'], ab['per_request']
+    print(summary_line(ab), flush=True)
+    print(json.dumps({
+        'metric': (f'serve drill: continuous-batching img/s vs per-request at equal '
+                   f'offered load ({c["num_requests"]} reqs @ {c["offered_rps"]} req/s; '
+                   f'continuous p50 {c["p50_ms"]}ms p99 {c["p99_ms"]}ms, '
+                   f'per-request p50 {b["p50_ms"]}ms p99 {b["p99_ms"]}ms; '
+                   f'buckets {tuple(c["buckets"])}, {c["evictions"]} eviction(s), '
+                   f'{time.perf_counter() - t0:.1f}s wall)'),
+        'value': ab['speedup'], 'unit': 'x img/s vs per-request',
+        'vs_baseline': None}), flush=True)
+    return 0
 
 
 def _compile_child(args) -> int:

@@ -117,10 +117,19 @@ def main():
         top_probs = jnp.take_along_axis(probs, order, axis=-1)
         return order, top_probs
 
+    # every batch — including the final partial one — runs at one padded
+    # bucket shape, so the whole loop uses a single compiled executable
+    # instead of paying a fresh XLA compile for the odd-sized last batch
+    from timm_tpu.serve import batch_bucket, pad_rows, strip_rows
+    bucket = batch_bucket(args.batch_size)
+
     all_indices, all_probs = [], []
     t0 = time.time()
     for x_np, _ in loader:
-        idx, prb = infer_step(state, jnp.asarray(x_np))
+        n = int(x_np.shape[0])
+        if n != bucket:  # partial final batch: pad up to the bucket shape
+            x_np, _valid = pad_rows(np.asarray(x_np), bucket)
+        idx, prb = strip_rows(infer_step(state, jnp.asarray(x_np)), n)
         all_indices.append(np.asarray(idx))
         all_probs.append(np.asarray(prb))
     if not all_indices:

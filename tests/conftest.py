@@ -35,6 +35,15 @@ except Exception:
 import pytest
 
 
+def pytest_configure(config):
+    # registered in pyproject.toml too; double registration is harmless and
+    # keeps `pytest tests/test_serve.py` warning-free outside the repo root
+    config.addinivalue_line(
+        'markers',
+        'serve: continuous-batching inference engine — bucketing, admission '
+        'queue, AOT prewarm, LRU residency, load drill (runs in tier-1)')
+
+
 @pytest.fixture(scope='session')
 def mesh8():
     from timm_tpu.parallel import create_mesh, set_global_mesh

@@ -1,0 +1,177 @@
+"""Multi-model residency: HBM-budgeted LRU pool over the registry.
+
+The registry exposes ~900 entrypoints; a serving process can keep only a few
+resident in HBM at once. The pool loads models lazily from registered
+factories (``timm_tpu.create_model`` + optional checkpoint), places their
+state on the mesh under the FSDP/TP partition rules, hands each new resident
+to the engine's prewarm hook (per-model AOT compile of every declared
+bucket, warmed from the persistent compile cache), and evicts the
+least-recently-used resident when the per-device budget is exceeded.
+
+Eviction drops the pool's references; JAX frees the device buffers once the
+engine's in-flight steps release theirs, so an evicted model's outstanding
+batches still complete. A single model larger than the whole budget is kept
+(serving it is the job) with a loud warning rather than an eviction livelock.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ['ResidentModel', 'ModelPool']
+
+
+class ResidentModel:
+    """One loaded model: split graphdef/state on the mesh + the per-bucket
+    compiled executables the engine attaches at prewarm."""
+
+    def __init__(self, name: str, graphdef, state, param_bytes: int, input_size):
+        self.name = name
+        self.graphdef = graphdef
+        self.state = state
+        self.param_bytes = int(param_bytes)
+        self.input_size = input_size  # (H, W, C) the compiled programs expect
+        self.compiled: Dict[int, object] = {}  # bucket -> AOT executable
+        self.prewarm_stats: Dict[str, float] = {}
+        self.last_used = time.perf_counter()
+
+    def touch(self):
+        self.last_used = time.perf_counter()
+
+
+def _state_bytes_per_device(state, mesh) -> int:
+    """Per-device HBM the state occupies under the partition rules (the
+    budget is per chip — replicated totals would overcount sharded models)."""
+    from ..parallel import param_bytes_per_device
+    try:
+        _, sharded = param_bytes_per_device(state, mesh)
+        return int(sharded)
+    except Exception:
+        import jax
+        return int(sum(
+            int(np.prod(getattr(l, 'shape', ()) or (1,))) * np.dtype(l.dtype).itemsize
+            for l in jax.tree.leaves(state)))
+
+
+class ModelPool:
+    """LRU residency over lazily-built models.
+
+    ``register(name, factory)`` declares how to build a model (it is NOT
+    loaded yet); ``acquire(name)`` returns the resident entry, loading —
+    and evicting — as needed. ``prewarm_fn`` (set by the engine) runs once
+    per load, before the model serves its first request.
+    """
+
+    def __init__(self, mesh, budget_bytes: Optional[int] = None,
+                 prewarm_fn: Optional[Callable[[ResidentModel], None]] = None):
+        self.mesh = mesh
+        self.budget_bytes = budget_bytes
+        self.prewarm_fn = prewarm_fn
+        self._factories: Dict[str, Callable[[], object]] = {}
+        self._resident: 'OrderedDict[str, ResidentModel]' = OrderedDict()
+        self._lock = threading.RLock()
+        self.stats = {'loads': 0, 'evictions': 0, 'hits': 0}
+
+    # -- registration ---------------------------------------------------------
+
+    def register(self, name: str, factory: Callable[[], object],
+                 input_size=None):
+        """``input_size`` — (H, W, C) the compiled programs will expect;
+        resolved from the model's default_cfg when omitted."""
+        with self._lock:
+            self._factories[name] = (factory, input_size)
+
+    @property
+    def registered(self):
+        return tuple(self._factories)
+
+    @property
+    def resident_names(self):
+        with self._lock:
+            return tuple(self._resident)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(r.param_bytes for r in self._resident.values())
+
+    # -- residency ------------------------------------------------------------
+
+    def acquire(self, name: str) -> ResidentModel:
+        with self._lock:
+            res = self._resident.get(name)
+            if res is not None:
+                self._resident.move_to_end(name)
+                res.touch()
+                self.stats['hits'] += 1
+                return res
+            if name not in self._factories:
+                raise KeyError(f'model {name!r} not registered with the serve pool '
+                               f'(registered: {list(self._factories)})')
+            return self._load(name)
+
+    def _load(self, name: str) -> ResidentModel:
+        import jax
+        from flax import nnx
+
+        from ..parallel import build_param_shardings
+
+        t0 = time.perf_counter()
+        factory, input_size = self._factories[name]
+        model = factory()
+        model.eval()
+        if input_size is None:
+            cfg = getattr(model, 'default_cfg', None) or {}
+            chw = cfg.get('input_size') or (3, 224, 224)
+            input_size = (int(chw[1]), int(chw[2]), int(chw[0]))  # CHW cfg → HWC input
+        h, w, c = (int(s) for s in input_size)
+        graphdef, state = nnx.split(model)
+        nbytes = _state_bytes_per_device(state, self.mesh)
+        self._evict_to_fit(nbytes, loading=name)
+        if 'fsdp' in self.mesh.axis_names or 'model' in self.mesh.axis_names:
+            state = jax.device_put(state, build_param_shardings(state, self.mesh))
+        res = ResidentModel(name, graphdef, state, nbytes, (h, w, c))
+        res.prewarm_stats['load_ms'] = (time.perf_counter() - t0) * 1e3
+        if self.prewarm_fn is not None:
+            self.prewarm_fn(res)
+        self._resident[name] = res
+        self.stats['loads'] += 1
+        _logger.info(
+            f'serve pool: loaded {name} ({nbytes / 1e6:.1f} MB/device, '
+            f'{len(self._resident)} resident, '
+            f'{self.resident_bytes() / 1e6:.1f} MB of '
+            f'{"unbounded" if self.budget_bytes is None else f"{self.budget_bytes / 1e6:.1f} MB"} budget)')
+        return res
+
+    def _evict_to_fit(self, incoming_bytes: int, loading: str):
+        if self.budget_bytes is None:
+            return
+        if incoming_bytes > self.budget_bytes:
+            _logger.warning(
+                f'serve pool: model {loading!r} alone ({incoming_bytes / 1e6:.1f} MB/device) '
+                f'exceeds the HBM budget ({self.budget_bytes / 1e6:.1f} MB); '
+                f'keeping it resident anyway — raise the budget or serve a smaller model')
+        while self._resident and \
+                self.resident_bytes() + incoming_bytes > self.budget_bytes:
+            victim, res = self._resident.popitem(last=False)  # LRU order
+            self.stats['evictions'] += 1
+            _logger.info(
+                f'serve pool: evicted {victim} ({res.param_bytes / 1e6:.1f} MB/device) '
+                f'to fit {loading} within the {self.budget_bytes / 1e6:.1f} MB budget')
+
+    def evict(self, name: str) -> bool:
+        with self._lock:
+            res = self._resident.pop(name, None)
+            if res is not None:
+                self.stats['evictions'] += 1
+            return res is not None
+
+    def clear(self):
+        with self._lock:
+            self._resident.clear()

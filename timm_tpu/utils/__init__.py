@@ -1,7 +1,8 @@
 from .attention_extract import AttentionExtract
 from .checkpoint_saver import CheckpointSaver
 from .clip_grad import adaptive_clip_grad, clip_grad_norm, clip_grad_value, dispatch_clip_grad, global_grad_norm
-from .compile_cache import configure_compile_cache, count_jaxpr_eqns
+from .compile_cache import (cache_event_total, collect_cache_events,
+                            configure_compile_cache, count_jaxpr_eqns)
 from .log import FormatterNoInfo, setup_default_logging
 from .metrics import AverageMeter, accuracy
 from .model import freeze, get_state_dict, reparameterize_model, unfreeze, unwrap_model

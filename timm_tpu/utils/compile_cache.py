@@ -25,9 +25,10 @@ Environment knobs:
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
-from typing import Optional
+from typing import Dict, Optional
 
 _logger = logging.getLogger(__name__)
 
@@ -85,6 +86,52 @@ def configure_compile_cache(
     except Exception:
         pass
     return cache_dir
+
+
+# -- compile-cache event accounting -------------------------------------------
+# JAX emits '/jax/compilation_cache/cache_hits' / 'cache_misses' monitoring
+# events on every compile with the persistent cache enabled. One module-level
+# listener fans out to whichever collectors are active, so nested measurements
+# (engine prewarm inside drill inside test) each see their own counts.
+
+_ACTIVE_COLLECTORS: list = []
+_LISTENER_INSTALLED = False
+
+
+def _install_cache_listener():
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event, **kwargs):
+            if '/compilation_cache/' not in event:
+                return
+            for c in list(_ACTIVE_COLLECTORS):
+                c[event] = c.get(event, 0) + 1
+
+        monitoring.register_event_listener(_on_event)
+        _LISTENER_INSTALLED = True
+    except Exception as e:  # out-of-tree jax: counts degrade to zeros
+        _logger.warning(f'compile-cache event listener unavailable: {e}')
+
+
+@contextlib.contextmanager
+def collect_cache_events():
+    """Collect JAX compilation-cache events within the block into a dict."""
+    _install_cache_listener()
+    counts: Dict[str, int] = {}
+    _ACTIVE_COLLECTORS.append(counts)
+    try:
+        yield counts
+    finally:
+        _ACTIVE_COLLECTORS.remove(counts)
+
+
+def cache_event_total(counts: Dict[str, int], suffix: str) -> int:
+    """Sum event counts whose key ends with ``suffix`` (e.g. 'cache_hits')."""
+    return sum(v for k, v in counts.items() if k.endswith(suffix))
 
 
 def count_jaxpr_eqns(jaxpr) -> int:

@@ -170,16 +170,18 @@ def validate(args):
         acc5 = ((top == target[:, None]).any(axis=-1) * w).sum() / denom * 100.0
         return loss, acc1, acc5, top[:, ::-1]  # top-5 preds, best first
 
+    # one bucket shape for the whole eval: batch_size rounded up to the mesh
+    # shard count. The final partial batch pads up to the SAME shape as every
+    # other batch (masked slots), so the loop compiles exactly one executable
+    # instead of paying a fresh XLA compile for the odd-sized last batch.
+    from timm_tpu.serve import batch_bucket, pad_rows
+    bucket = batch_bucket(args.batch_size, mesh.size)
+
     loss_m, top1_m, top5_m, time_m = AverageMeter(), AverageMeter(), AverageMeter(), AverageMeter()
     end = time.time()
     for batch_idx, (x_np, t_np) in enumerate(loader):
         n = x_np.shape[0]
-        pad = (-n) % mesh.size  # mesh sharding needs batch % devices == 0
-        valid_np = np.ones(n + pad, bool)
-        if pad:
-            x_np = np.concatenate([x_np, np.repeat(x_np[:1], pad, axis=0)])
-            t_np = np.concatenate([t_np, np.repeat(t_np[:1], pad)])
-            valid_np[n:] = False
+        x_np, t_np, valid_np = pad_rows(np.asarray(x_np), bucket, np.asarray(t_np))
         batch = shard_batch({'x': jnp.asarray(x_np), 't': jnp.asarray(t_np),
                              'v': jnp.asarray(valid_np)}, mesh)
         loss, acc1, acc5, topk = eval_step(state, batch['x'], batch['t'], batch['v'])

@@ -2,12 +2,25 @@
 # Round-5 bench self-measurement loop: keep trying until the TPU answers,
 # then refresh the self-measured result every ~45 min. The self loop can
 # afford a much larger wall-clock budget than the driver's run.
+#
+# BENCH_SELF.json is the v2 document (timm_tpu/perfbudget/replay.py): failed
+# rounds append structured abort records (bounded history) instead of leaving
+# an empty file, and the replay below streams its per-step results into the
+# same document.
 cd /root/repo
 while true; do
   BENCH_TOTAL_BUDGET=1800 python bench.py --save-self >> /tmp/bench_loop.log 2>&1
   rc=$?
   echo "[$(date -u +%FT%TZ)] bench.py --save-self rc=$rc" >> /tmp/bench_loop.log
   if [ $rc -eq 0 ]; then
+    # first healthy window: run the whole queued PERF.md A/B checklist once
+    # (donation, pad-tokens, bf16 knobs, fsdp x tp grid, flash gate, profiler
+    # trace, serve drill) — results land in BENCH_SELF.json step by step
+    if [ ! -f /tmp/bench_replay_done ]; then
+      BENCH_TOTAL_BUDGET=5400 python bench.py --replay --save-self >> /tmp/bench_loop.log 2>&1
+      echo "[$(date -u +%FT%TZ)] bench.py --replay rc=$? (one-shot)" >> /tmp/bench_loop.log
+      touch /tmp/bench_replay_done
+    fi
     sleep 2700
   else
     sleep 180

@@ -53,7 +53,9 @@ BASELINES = {
 # bf16 peak FLOP/s per chip for MFU reporting
 CHIP_PEAK = {'v5e': 197e12, 'v5litepod': 197e12, 'v4': 275e12, 'v5p': 459e12, 'v6e': 918e12}
 
-SELF_RESULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'BENCH_SELF.json')
+SELF_RESULT_PATH = os.environ.get(
+    'TIMM_TPU_BENCH_SELF',
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), 'BENCH_SELF.json'))
 
 TOTAL_BUDGET = int(os.environ.get('BENCH_TOTAL_BUDGET', '420'))
 
@@ -110,6 +112,8 @@ def _arm_watchdog(seconds: int):
 
 def _probe_device(timeout_s: int) -> bool:
     """Run a tiny device op in a SUBPROCESS so a wedged relay can't hang us."""
+    if os.environ.get('TIMM_TPU_BENCH_FORCE_PROBE_FAIL'):
+        return False  # test knob: drill the abort/replay paths without a downed relay
     code = (
         'import jax, jax.numpy as jnp\n'
         'x = jnp.ones((128, 128))\n'
@@ -132,6 +136,9 @@ def _replay_self_result(reason: str) -> int:
     try:
         with open(SELF_RESULT_PATH) as f:
             saved = json.load(f)
+        if not saved.get('result'):
+            # a v2 file holding only abort records has nothing honest to replay
+            raise ValueError('no replayable result recorded')
         out = dict(saved['result'])
         out['replay'] = True
         out['measured_at'] = saved.get('measured_at', '?')
@@ -295,6 +302,21 @@ def main():
                              'combine with --dry-run for the tier-1 smoke.')
     parser.add_argument('--serve-requests', type=int, default=256, metavar='N',
                         help='(with --serve) requests per drill arm')
+    parser.add_argument('--replay', action='store_true',
+                        help='execute the entire queued PERF.md A/B checklist (donation, '
+                             'pad-tokens, bf16 knobs, fsdp x tp grid, flash gate, profiler '
+                             'trace, serve drill) as one scripted sequence, recording every '
+                             'step into BENCH_SELF.json. Combine with --dry-run for the '
+                             'tier-1 CPU smoke (tiny models, same code path).')
+    parser.add_argument('--replay-steps', default='', metavar='A,B',
+                        help='(with --replay) comma-separated subset of step ids')
+    parser.add_argument('--profile', action='store_true',
+                        help='capture a jax.profiler trace of the train step for --model '
+                             'and print the self-parsed MXU vs non-MXU op summary '
+                             '(PERF.md checklist item 6, unattended)')
+    parser.add_argument('--profile-dir', default='', metavar='DIR',
+                        help='trace output dir (default: a fresh temp dir; TensorBoard-'
+                             'loadable for the deep-dive)')
     parser.add_argument('--child', action='store_true',
                         help='internal: run the measurement in this process')
     parser.add_argument('--watchdog-s', type=int, default=None,
@@ -311,6 +333,12 @@ def main():
 
     if args.compile_report:
         raise SystemExit(_compile_report(args))
+
+    if args.replay:
+        raise SystemExit(_replay_checklist(args))
+
+    if args.profile:
+        raise SystemExit(_profile_run(args))
 
     if args.serve:
         raise SystemExit(_serve_drill(args))
@@ -351,17 +379,21 @@ def main():
     if result is not None and result.get('value', 0) > 0:
         print(json.dumps(result), flush=True)
         if args.save_self:
-            with open(SELF_RESULT_PATH, 'w') as f:
-                json.dump({'measured_at': time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
-                           'result': result}, f, indent=1)
+            # v2 document writer: preserves the abort history + last replay
+            # run instead of clobbering the whole file with a bare result
+            from timm_tpu.perfbudget.replay import record_result
+            record_result(SELF_RESULT_PATH, result)
         raise SystemExit(0)
 
     attempted = (f'{attempts_made} fresh-process bench attempt(s) failed'
                  if attempts_made else 'no bench attempt fit the remaining budget')
     if not probed_ok:
         # Device provably unreachable: replay is honest here (and exits 3).
-        raise SystemExit(_replay_self_result(f'TPU unreachable: probe failed and {attempted}'))
+        reason = f'TPU unreachable: probe failed and {attempted}'
+        _record_abort(reason, args)
+        raise SystemExit(_replay_self_result(reason))
     if not attempts_made:
+        _record_abort('INCOMPLETE: probe succeeded but no bench attempt fit the budget', args)
         print(json.dumps({
             'metric': 'benchmark INCOMPLETE: probe succeeded but no bench attempt fit '
                       f'the remaining budget (BENCH_TOTAL_BUDGET={TOTAL_BUDGET}s too small)',
@@ -369,6 +401,7 @@ def main():
         raise SystemExit(2)
     # Probe succeeded but the bench failed: a genuine regression.
     # Never mask it with a stale replay — report 0.0 and fail.
+    _record_abort(f'FAILED: {attempted} despite a live device probe', args)
     print(json.dumps({
         'metric': f'benchmark FAILED: {attempted} despite a '
                   'live device probe (likely code regression; see stderr)',
@@ -519,6 +552,96 @@ def _serve_drill(args) -> int:
         'value': ab['speedup'], 'unit': 'x img/s vs per-request',
         'vs_baseline': None}), flush=True)
     return 0
+
+
+def _force_cpu_topology():
+    """The fsdp x tp replay/profile steps need 8 devices; a CPU host only
+    grows them if the XLA flag is exported before jax's FIRST import (no-op
+    once jax is loaded, and harmless on a real TPU backend)."""
+    if 'jax' in sys.modules:
+        return
+    flags = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=8').strip()
+
+
+def _record_abort(reason: str, args) -> None:
+    """An aborted round used to leave an EMPTY BENCH_SELF.json behind (the
+    round-4/round-5 failure mode); now it appends a structured abort record
+    to the v2 document while preserving the last good result. Gated on
+    --save-self (same consent as the result write) and must never itself
+    take the process down."""
+    if not args.save_self:
+        return
+    try:
+        from timm_tpu.perfbudget.replay import record_abort
+        record_abort(SELF_RESULT_PATH, reason, {
+            'model': args.model, 'bench': args.bench,
+            'budget_s': TOTAL_BUDGET, 'probe_timeout_s': PROBE_TIMEOUT})
+    except Exception as e:
+        print(f'abort record failed: {e!r}', file=sys.stderr, flush=True)
+
+
+def _replay_checklist(args) -> int:
+    """The whole queued PERF.md "next-round on-device checklist" as ONE
+    unattended scripted sequence (timm_tpu.perfbudget.replay). --dry-run is
+    the tier-1 CPU smoke over the identical code path; live mode is the real
+    relay-window run. Either way every step's record streams into
+    BENCH_SELF.json as it lands, so a run killed mid-checklist keeps
+    everything measured so far."""
+    _force_cpu_topology()
+    from timm_tpu.perfbudget.replay import load_self_doc, run_replay, validate_self_result
+    from timm_tpu.utils import configure_compile_cache
+
+    configure_compile_cache()
+    names = [s.strip() for s in args.replay_steps.split(',') if s.strip()] or None
+    _status(f'replay: PERF.md checklist ({"dry-run" if args.dry_run else "LIVE"})')
+    doc, rc = run_replay(dry_run=args.dry_run, self_path=SELF_RESULT_PATH,
+                         names=names, trace_dir=args.profile_dir or None,
+                         log=lambda m: _status(m))
+    errs = validate_self_result(load_self_doc(SELF_RESULT_PATH))
+    statuses = ' '.join(f"{s['id']}={s['status']}" for s in doc['steps'])
+    print(json.dumps({
+        'metric': (f"replay ({'dry-run' if args.dry_run else 'live'}): "
+                   f"{doc['completed']}/{doc['total']} ok, {doc['failed']} failed, "
+                   f"{doc['skipped']} skipped -> {SELF_RESULT_PATH} [{statuses}]"
+                   + (f'; SCHEMA ERRORS: {errs}' if errs else '')),
+        'value': float(doc['completed']), 'unit': 'checklist steps ok',
+        'vs_baseline': None}), flush=True)
+    return rc if not errs else (rc or 2)
+
+
+def _profile_run(args) -> int:
+    """Unattended profiler harness (PERF.md checklist item 6): capture a
+    jax.profiler trace of the train step for --model and print the
+    self-parsed MXU vs non-MXU op-category summary. The trace directory is
+    kept on disk (TensorBoard/XProf-loadable) for the human deep-dive."""
+    _force_cpu_topology()
+    from timm_tpu.perfbudget.replay import _run_profile
+    from timm_tpu.utils import configure_compile_cache
+
+    configure_compile_cache()
+    img = min(args.img_size, 64) if args.dry_run else args.img_size
+    spec = {'model': args.model, 'img_size': img,
+            'batch': args.batch_size or (8 if args.dry_run else 32),
+            'steps': max(1, min(args.steps, 3))}
+    if args.fsdp:
+        spec['fsdp'] = args.fsdp
+    if args.tp:
+        spec['tp'] = args.tp
+    _status(f'profile: tracing {args.model} train step ({spec["steps"]} step(s))')
+    summary = _run_profile(spec, args.profile_dir or None)
+    ok = summary.get('status') == 'ok'
+    mxu = summary.get('mxu_frac')
+    print(json.dumps({
+        'metric': (f"profiler trace {args.model}: {summary.get('total_events', 0)} device-op "
+                   f"events, MXU {summary.get('mxu_us', 0.0):.0f}us vs other "
+                   f"{summary.get('non_mxu_us', 0.0):.0f}us -> {summary.get('trace_dir', '?')}"),
+        'value': round(mxu, 4) if mxu is not None else 0.0,
+        'unit': 'MXU time fraction', 'vs_baseline': None,
+        'summary': summary}), flush=True)
+    return 0 if ok else 2
 
 
 def _compile_child(args) -> int:

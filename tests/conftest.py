@@ -42,6 +42,10 @@ def pytest_configure(config):
         'markers',
         'serve: continuous-batching inference engine — bucketing, admission '
         'queue, AOT prewarm, LRU residency, load drill (runs in tier-1)')
+    config.addinivalue_line(
+        'markers',
+        'perfbudget: hardware-independent perf-regression budgets + profiler '
+        'harness + bench replay smoke (runs in tier-1)')
 
 
 @pytest.fixture(scope='session')

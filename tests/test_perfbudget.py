@@ -1,0 +1,376 @@
+"""Perf-budget suite: hardware-independent regression gates + replay smoke.
+
+1. Budget semantics: the tolerance policy fails on regression AND on silent
+   improvement (re-baseline only via --update-budgets), and a metric that
+   silently stops being measured fails as 'missing'.
+2. Seed budgets: probing the live code against tests/fixtures/
+   perf_budgets.json stays clean; an injected block_scan=False regression
+   trips the jaxpr-eqn AND trace-time budgets for the scanned config.
+3. BENCH_SELF.json v2 document: result/abort/replay round-trips, v1 upgrade,
+   bounded abort history, schema validation.
+4. `bench.py --replay --dry-run` (subprocess): the ENTIRE queued PERF.md
+   checklist completes unattended with a schema-valid BENCH_SELF.json; an
+   aborted bench round appends a structured abort record while preserving
+   the prior result.
+5. Profiler: perfetto parsing + MXU vs non-MXU classification on a
+   synthetic trace (deterministic; the real-trace path is exercised by the
+   replay's `profile` step).
+"""
+import gzip
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from timm_tpu.perfbudget import (
+    DEFAULT_MATRIX, ProbeConfig, check_counter, check_counter_min, check_ratio_max,
+    check_ratio_min, check_upper, compare_budgets, compare_config, format_violations,
+    latest_trace_file, load_budgets, load_self_doc, parse_trace, probe_config,
+    record_abort, record_result, run_matrix, summarize_events, tolerance_for,
+    update_budgets, validate_self_result,
+)
+from timm_tpu.perfbudget.replay import REPLAY_STEPS, SELF_SCHEMA, _MAX_ABORTS
+
+pytestmark = pytest.mark.perfbudget
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), '..'))
+BENCH = os.path.join(REPO_ROOT, 'bench.py')
+
+
+# ---- 1. tolerance policy (pure, no jax) -------------------------------------
+
+def test_tolerance_policy_directions():
+    budget = {'jaxpr_eqns': 1000, 'trace_ms': 400.0, 'donation_aliases': 100,
+              'donation_ok': True}
+
+    # within band: clean
+    ok = {'jaxpr_eqns': 1040, 'trace_ms': 380.0, 'donation_aliases': 99,
+          'donation_ok': True}
+    assert compare_config(ok, budget, 'cfg') == []
+
+    # regression: band exceeded upward
+    worse = dict(ok, jaxpr_eqns=1200)
+    v = compare_config(worse, budget, 'cfg')
+    assert [x['direction'] for x in v] == ['regression'] and v[0]['metric'] == 'jaxpr_eqns'
+
+    # silent improvement: band exceeded downward must ALSO fail
+    better = dict(ok, jaxpr_eqns=500)
+    v = compare_config(better, budget, 'cfg')
+    assert [x['direction'] for x in v] == ['improvement']
+    assert 'update-budgets' in v[0]['detail']
+
+    # upper-only metric: improvement is free, regression is not
+    assert compare_config(dict(ok, trace_ms=10.0), budget, 'cfg') == []
+    v = compare_config(dict(ok, trace_ms=900.0), budget, 'cfg')
+    assert [x['direction'] for x in v] == ['regression']
+
+    # lower-only metric: losing aliases is a regression, gaining is free
+    v = compare_config(dict(ok, donation_aliases=50), budget, 'cfg')
+    assert [x['direction'] for x in v] == ['regression']
+    assert compare_config(dict(ok, donation_aliases=150), budget, 'cfg') == []
+
+    # bool mismatch + silently-dropped metric
+    v = compare_config(dict(ok, donation_ok=False), budget, 'cfg')
+    assert [x['direction'] for x in v] == ['mismatch']
+    dropped = {k: v for k, v in ok.items() if k != 'donation_ok'}
+    v = compare_config(dropped, budget, 'cfg')
+    assert [x['direction'] for x in v] == ['missing']
+
+    # un-probed budgeted config
+    v = compare_budgets({}, {'configs': {'cfg': budget}})
+    assert [x['direction'] for x in v] == ['missing'] and v[0]['metric'] == '*'
+    assert 'violation' in format_violations(v)
+
+    assert tolerance_for('flops') == ('band', 0.05)
+    assert tolerance_for('never_seen_metric') == ('band', 0.10)
+
+
+def test_shared_check_helpers():
+    check_counter('c', 2, 2)
+    with pytest.raises(AssertionError, match='expected exactly'):
+        check_counter('c', 3, 2)
+    check_counter_min('c', 5, 5)
+    with pytest.raises(AssertionError, match='>='):
+        check_counter_min('c', 4, 5)
+    check_ratio_max('r', 199, 100, 2.0)
+    with pytest.raises(AssertionError, match='>= 2'):
+        check_ratio_max('r', 200, 100, 2.0)
+    check_ratio_min('r', 201, 100, 2.0)
+    with pytest.raises(AssertionError, match='<= 2'):
+        check_ratio_min('r', 200, 100, 2.0)
+    check_upper('u', 1.0, 1.0)
+    with pytest.raises(AssertionError, match='> budget'):
+        check_upper('u', 1.1, 1.0, unit='ms')
+
+
+def test_improvement_requires_explicit_rebaseline(tmp_path):
+    """The --update-budgets workflow: a genuine win fails comparison until
+    the budgets file is regenerated, after which it passes."""
+    budgets = load_budgets()
+    base = dict(budgets['configs']['base'])
+    improved = dict(base, jaxpr_eqns=base['jaxpr_eqns'] // 2)
+
+    v = compare_config(improved, base, 'base')
+    assert [x['direction'] for x in v] == ['improvement']
+
+    path = str(tmp_path / 'budgets.json')
+    doc = update_budgets({'base': improved}, path=path, note='test rebaseline')
+    assert doc['schema'] == 'perf_budgets/v1'
+    reloaded = load_budgets(path)
+    assert compare_budgets({'base': improved}, reloaded) == []
+
+
+# ---- 2. live probe vs seed budgets ------------------------------------------
+
+@pytest.fixture(scope='module')
+def seed_budgets():
+    return load_budgets()
+
+
+def test_seed_budgets_pass_on_live_code(seed_budgets):
+    """The cheap half of the matrix (trace-only + disk-cached compiles),
+    probed in-process, stays within the checked-in budgets. The full matrix
+    is the CLI (`python -m timm_tpu.perfbudget`); scan_depth12's budget is
+    exercised by the injected-regression test below.
+
+    trace_ms is excluded HERE only: for the small configs it is sensitive to
+    how much tracing already warmed the process (the seed CLI probes the full
+    matrix in order; this subset doesn't), and the 1.3x tolerance is sized
+    for the consistent-context CLI run. The trace-time budget still has
+    tier-1 teeth via the scan_depth12 injection test below, where the signal
+    (~1.45x) dwarfs warmth effects."""
+    names = ['base', 'accum4', 'serve_test_vit']
+    measured = run_matrix(names=names)
+    violations = [v for v in compare_budgets(measured, seed_budgets, configs=names)
+                  if v['metric'] != 'trace_ms']
+    assert not violations, format_violations(violations)
+
+
+def test_injected_blockscan_regression_trips_budgets(seed_budgets):
+    """Acceptance: turning block_scan OFF for the depth-12 config must trip
+    BOTH the jaxpr-equation and the trace-time budgets (the O(1)-in-depth
+    contract), proving the suite catches the regression it was built for.
+
+    jaxpr_eqns is deterministic, so it compares against the checked-in seed.
+    The trace_ms baseline is re-probed in THIS process instead: trace wall
+    time shifts with how warm the interpreter is, so the only apples-to-apples
+    comparison is scan-on vs scan-off under identical warmth — exactly what a
+    regression lands as. The budget machinery (kind/tolerance) is unchanged."""
+    scan_cfg = next(c for c in DEFAULT_MATRIX if c.name == 'scan_depth12')
+
+    def probe(block_scan):
+        return probe_config(ProbeConfig(
+            name='scan_depth12', model=scan_cfg.model,
+            model_kwargs=scan_cfg.model_kwargs, batch_size=scan_cfg.batch_size,
+            block_scan=block_scan, collect='trace'))
+
+    probe(True)  # discard: the first probe pays one-time warm-up costs
+    baseline, measured = None, None
+    for _ in range(2):  # interleaved so drift hits both sides equally
+        b, m = probe(True), probe(False)
+        if baseline is None or b['trace_ms'] < baseline['trace_ms']:
+            baseline = b
+        if measured is None or m['trace_ms'] < measured['trace_ms']:
+            measured = m
+    print(f'scan trace_ms={baseline["trace_ms"]} '
+          f'loop trace_ms={measured["trace_ms"]}')  # shown iff the test fails
+    budget = dict(seed_budgets['configs']['scan_depth12'])
+    budget['trace_ms'] = baseline['trace_ms']
+    violations = compare_config(measured, budget,
+                                'scan_depth12', metrics=('jaxpr_eqns', 'trace_ms'))
+    tripped = {v['metric'] for v in violations if v['direction'] == 'regression'}
+    assert tripped == {'jaxpr_eqns', 'trace_ms'}, format_violations(violations)
+
+
+def test_run_matrix_rejects_unknown_config():
+    with pytest.raises(ValueError, match='unknown'):
+        run_matrix(names=['no_such_config'])
+
+
+# ---- 3. BENCH_SELF.json v2 document -----------------------------------------
+
+def test_self_doc_roundtrip_abort_history_and_v1_upgrade(tmp_path):
+    path = str(tmp_path / 'BENCH_SELF.json')
+
+    # missing and corrupt files both yield a writable fresh document
+    assert load_self_doc(path)['schema'] == SELF_SCHEMA
+    with open(path, 'w') as f:
+        f.write('{truncated')
+    assert load_self_doc(path)['schema'] == SELF_SCHEMA
+
+    result = {'metric': 'm', 'value': 1.0, 'unit': 'ok', 'vs_baseline': None}
+    record_result(path, result)
+    doc = load_self_doc(path)
+    assert doc['result'] == result and doc['measured_at']
+    assert validate_self_result(doc) == []
+
+    # aborts append without clobbering the result, capped at _MAX_ABORTS
+    for i in range(_MAX_ABORTS + 5):
+        record_abort(path, f'reason {i}', {'model': 'x'})
+    doc = load_self_doc(path)
+    assert doc['result'] == result
+    assert len(doc['aborts']) == _MAX_ABORTS
+    assert doc['aborts'][-1]['reason'] == f'reason {_MAX_ABORTS + 4}'
+    assert all(a['at'] and a['reason'] for a in doc['aborts'])
+    assert validate_self_result(doc) == []
+
+    # pre-v2 files (bare {'measured_at', 'result'}) upgrade losslessly
+    v1 = str(tmp_path / 'v1.json')
+    with open(v1, 'w') as f:
+        json.dump({'measured_at': '2026-01-01T00:00:00Z', 'result': result}, f)
+    doc = load_self_doc(v1)
+    assert doc['schema'] == SELF_SCHEMA and doc['result'] == result
+    assert doc['measured_at'] == '2026-01-01T00:00:00Z' and doc['aborts'] == []
+
+    # validator actually rejects malformed documents
+    assert validate_self_result({'schema': 'bogus'})
+    bad = load_self_doc(path)
+    bad['aborts'] = [{'reason': 'no timestamp'}]
+    assert validate_self_result(bad)
+
+
+# ---- 4. bench.py integration (subprocess) -----------------------------------
+
+def _bench_env(tmp_path, **extra):
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               TIMM_TPU_BENCH_SELF=str(tmp_path / 'BENCH_SELF.json'))
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _last_json(stdout):
+    return json.loads(stdout.strip().splitlines()[-1])
+
+
+def test_replay_dry_run_completes_full_checklist(tmp_path):
+    """Acceptance: `bench.py --replay --dry-run` runs the ENTIRE queued
+    PERF.md checklist unattended and leaves a schema-valid BENCH_SELF.json
+    with a record for every step."""
+    env = _bench_env(tmp_path)
+    r = subprocess.run([sys.executable, BENCH, '--replay', '--dry-run'],
+                       env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    out = _last_json(r.stdout)
+    assert out['unit'] == 'checklist steps ok'
+
+    doc = load_self_doc(env['TIMM_TPU_BENCH_SELF'])
+    assert validate_self_result(doc) == [], validate_self_result(doc)
+    replay = doc['replay']
+    assert replay['dry_run'] is True and replay['failed'] == 0
+    ran = {s['id']: s['status'] for s in replay['steps']}
+    assert set(ran) == {s['id'] for s in REPLAY_STEPS}
+    assert set(ran.values()) == {'ok'}, ran
+    assert out['value'] == float(replay['completed']) == float(len(REPLAY_STEPS))
+    # the profiler step actually parsed device ops out of its own trace
+    prof = next(s for s in replay['steps'] if s['id'] == 'profile')
+    assert prof['result']['total_events'] > 0
+
+
+def test_replay_steps_subset_and_unknown_id(tmp_path):
+    env = _bench_env(tmp_path)
+    r = subprocess.run([sys.executable, BENCH, '--replay', '--dry-run',
+                        '--replay-steps', 'serve_drill'],
+                       env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    doc = load_self_doc(env['TIMM_TPU_BENCH_SELF'])
+    assert [s['id'] for s in doc['replay']['steps']] == ['serve_drill']
+    assert doc['replay']['steps'][0]['status'] == 'ok'
+
+    r = subprocess.run([sys.executable, BENCH, '--replay', '--dry-run',
+                        '--replay-steps', 'bogus_step'],
+                       env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode != 0
+
+
+def test_aborted_round_leaves_structured_record(tmp_path):
+    """Satellite fix: a round whose probe fails no longer leaves an empty
+    file — it appends an abort record, PRESERVES the prior self-measured
+    result, and replays it clearly labelled with exit code 3."""
+    self_path = str(tmp_path / 'BENCH_SELF.json')
+    prior = {'metric': 'vit_tiny_patch16_224 train img/s/chip', 'value': 321.0,
+             'unit': 'img/s/chip', 'vs_baseline': None}
+    record_result(self_path, prior)
+
+    env = _bench_env(tmp_path, TIMM_TPU_BENCH_FORCE_PROBE_FAIL='1',
+                     BENCH_TOTAL_BUDGET='40', TIMM_TPU_BENCH_PROBE_TIMEOUT='5')
+    r = subprocess.run([sys.executable, BENCH, '--fast', '--save-self'],
+                       env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 3, (r.returncode, r.stdout[-2000:], r.stderr[-1000:])
+    out = _last_json(r.stdout)
+    assert out['replay'] is True and out['value'] == 321.0
+    assert 'REPLAY' in out['metric']
+
+    doc = load_self_doc(self_path)
+    assert doc['result'] == prior, 'abort clobbered the prior result'
+    assert len(doc['aborts']) == 1
+    abort = doc['aborts'][0]
+    assert 'probe failed' in abort['reason'] and abort['at']
+    assert abort['model'] == 'vit_tiny_patch16_224'
+    assert validate_self_result(doc) == []
+
+
+def test_abort_only_self_file_refuses_replay(tmp_path):
+    """A v2 file holding only abort records has nothing honest to replay:
+    the fallback must exit 2 with the 'no BENCH_SELF to replay' line, not
+    fabricate a result."""
+    self_path = str(tmp_path / 'BENCH_SELF.json')
+    record_abort(self_path, 'earlier abort', {})
+
+    env = _bench_env(tmp_path, TIMM_TPU_BENCH_FORCE_PROBE_FAIL='1',
+                     BENCH_TOTAL_BUDGET='40', TIMM_TPU_BENCH_PROBE_TIMEOUT='5')
+    r = subprocess.run([sys.executable, BENCH, '--fast'],
+                       env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 2
+    assert 'no BENCH_SELF.json to replay' in _last_json(r.stdout)['metric']
+
+
+# ---- 5. profiler parsing (synthetic trace, deterministic) -------------------
+
+def _write_trace(tmp_path, events):
+    run_dir = tmp_path / 'plugins' / 'profile' / 'run1'
+    run_dir.mkdir(parents=True)
+    path = run_dir / 'host.trace.json.gz'
+    with gzip.open(path, 'wt') as f:
+        json.dump({'traceEvents': events}, f)
+    return str(tmp_path)
+
+
+def test_profiler_classifies_mxu_vs_other(tmp_path):
+    trace_dir = _write_trace(tmp_path, [
+        {'ph': 'M', 'name': 'thread_name', 'pid': 1, 'tid': 1,
+         'args': {'name': 'tf_XLAEigen/1'}},
+        {'ph': 'M', 'name': 'thread_name', 'pid': 1, 'tid': 2,
+         'args': {'name': 'python'}},
+        {'ph': 'M', 'name': 'thread_name', 'pid': 1, 'tid': 3,
+         'args': {'name': 'main'}},
+        # device ops: one MXU-class (dot), one not (fusion)
+        {'ph': 'X', 'name': 'dot.3', 'pid': 1, 'tid': 1, 'ts': 0, 'dur': 100},
+        {'ph': 'X', 'name': 'fusion.7', 'pid': 1, 'tid': 1, 'ts': 100, 'dur': 50},
+        # noise that must NOT count: python frame, compile event, class name
+        {'ph': 'X', 'name': 'loss_fn', 'pid': 1, 'tid': 2, 'ts': 0, 'dur': 999},
+        {'ph': 'X', 'name': 'backend_compile', 'pid': 1, 'tid': 3, 'ts': 0, 'dur': 500},
+        {'ph': 'X', 'name': 'TfrtCpuClient::Compile', 'pid': 1, 'tid': 3, 'ts': 0, 'dur': 500},
+    ])
+    path = latest_trace_file(trace_dir)
+    assert path and path.endswith('.trace.json.gz')
+    ops = parse_trace(path)
+    assert sorted(ev['name'] for ev in ops) == ['dot.3', 'fusion.7']
+    s = summarize_events(ops)
+    assert s['total_events'] == 2
+    assert s['mxu_us'] == 100.0 and s['non_mxu_us'] == 50.0
+    assert abs(s['mxu_frac'] - 100.0 / 150.0) < 1e-3
+    assert s['top_ops'][0]['op'] == 'dot'
+
+
+def test_profiler_empty_trace_dir(tmp_path):
+    assert latest_trace_file(str(tmp_path)) is None
+    assert summarize_events([]) == {'total_events': 0, 'mxu_us': 0.0,
+                                    'non_mxu_us': 0.0, 'mxu_frac': 0.0,
+                                    'top_ops': []}

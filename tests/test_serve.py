@@ -242,12 +242,14 @@ def test_executables_survive_weight_eviction():
     must not recompile (the exec cache hit is the reload fast path)."""
     eng = InferenceEngine(buckets=(2,))
     eng.add_model('test_vit', img_size=32)
+    from timm_tpu.perfbudget import check_counter
+
     first = dict(eng.pool.acquire('test_vit').prewarm_stats)
     eng.pool.evict('test_vit')
     second = dict(eng.pool.acquire('test_vit').prewarm_stats)
-    assert first['exec_cache_hits'] == 0
-    assert second['exec_cache_hits'] == len(eng.buckets)
-    assert second['fresh_compiles'] == 0
+    check_counter('first admit exec_cache_hits', first['exec_cache_hits'], 0)
+    check_counter('re-admit exec_cache_hits', second['exec_cache_hits'], len(eng.buckets))
+    check_counter('re-admit fresh_compiles', second['fresh_compiles'], 0)
 
 
 # ---- 6. AOT warmup × persistent compile cache (two cold processes) -----------
@@ -277,12 +279,15 @@ def test_aot_warmup_hits_compile_cache_on_second_startup(tmp_path):
         line = [l for l in r.stdout.splitlines() if l.startswith('PREWARM ')][-1]
         return json.loads(line[len('PREWARM '):])
 
+    from timm_tpu.perfbudget import check_counter, check_counter_min
+
     cold = startup()
-    assert cold['programs'] == 2 and cold['fresh_compiles'] == 2, cold
+    check_counter('cold startup programs', cold['programs'], 2)
+    check_counter('cold startup fresh_compiles', cold['fresh_compiles'], 2)
     assert os.listdir(cache_dir), 'cold startup persisted no executables'
     warm = startup()
-    assert warm['fresh_compiles'] == 0, f'warm startup recompiled: {warm}'
-    assert warm['cache_hits'] >= warm['programs'], warm
+    check_counter('warm startup fresh_compiles', warm['fresh_compiles'], 0)
+    check_counter_min('warm startup cache_hits', warm['cache_hits'], warm['programs'])
 
 
 # ---- 7. sharded serving (8-device subprocess drill) --------------------------

@@ -288,6 +288,37 @@ def test_task_jits_declare_donation_or_reason():
         f'`# no-donate: <reason>` comment:\n' + '\n'.join(violations))
 
 
+@pytest.mark.perfbudget
+def test_donation_asserted_on_compiled_executables(mesh8):
+    """Donation lint on the COMPILED artifacts, not `donate_argnums` presence
+    in source (the regex lint above can't see a donation that XLA dropped).
+
+    Train step: params/opt/EMA outputs match their donated inputs, so the
+    AOT executable's HLO header must carry a real input_output_alias table.
+    Serve engine: the bucket programs' input donation must provably reach
+    lowering — on CPU the logits are smaller than the donated image batch,
+    so the evidence is jax's "not usable" lowering warning (emitted only for
+    declared donors) rather than an alias entry."""
+    from timm_tpu.perfbudget import donation_evidence
+    from timm_tpu.serve import InferenceEngine
+
+    task = _make_task(mesh8, opt='adamw')
+    compiled = task.lower_train_step(_batch(mesh8), lr=0.1)
+    evidence = donation_evidence(compiled)
+    assert evidence['aliases'] > 0, \
+        'train step compiled with an empty input_output_alias table — donation died'
+
+    eng = InferenceEngine(buckets=(2, 4))
+    eng.add_model('test_vit', num_classes=10, img_size=32)
+    assert set(eng.aot_executables('test_vit')) == {2, 4}, \
+        'prewarm left bucket programs without AOT executables'
+    report = eng.donation_report('test_vit')
+    for bucket, rec in report.items():
+        assert rec['declared'], (
+            f'bucket {bucket} input donation never reached lowering '
+            f'(donate_argnums dropped from _bucket_jit?): {rec}')
+
+
 # ---- scanned grad accumulation ----------------------------------------------
 
 def test_scanned_accum_matches_unrolled(mesh8):
@@ -325,11 +356,12 @@ def test_accum_trace_size_o1_in_steps(mesh8):
         task = _make_task(mesh8, grad_accum_steps=accum, grad_accum_scan=scan)
         return count_jaxpr_eqns(task.trace_train_step(batch, lr=0.1))
 
+    from timm_tpu.perfbudget import check_ratio_max, check_ratio_min
+
     scan2, scan8 = eqns(2, True), eqns(8, True)
-    assert scan8 < 2 * scan2, f'scanned trace cost grew with accum steps: {scan2} -> {scan8}'
+    check_ratio_max('scanned trace cost vs accum steps (eqns a8/a2)', scan8, scan2, 2.0)
     unroll8 = eqns(8, False)
-    assert unroll8 > 2 * scan8, \
-        f'expected the unrolled jaxpr to dwarf the scanned one: {unroll8} vs {scan8}'
+    check_ratio_min('unrolled jaxpr vs scanned (eqns unroll8/scan8)', unroll8, scan8, 2.0)
 
 
 # ---- fsdp end-to-end in-process ---------------------------------------------

@@ -373,6 +373,24 @@ class TrainingTask:
             jnp.asarray(lr, jnp.float32), jnp.asarray(ema_decay, jnp.float32))
         return traced.jaxpr
 
+    def lower_train_step(self, batch: Dict[str, Any], lr: float = 0.1, step: int = 0):
+        """AOT-lower-and-compile the jitted train step on `batch` WITHOUT
+        executing it; returns the jax.stages.Compiled. The perfbudget probe
+        reads `cost_analysis()` (FLOPs / bytes accessed) and the HLO
+        `input_output_alias` header (donation legality) off it, and the
+        compile goes through the persistent cache so repeated probes are
+        disk-bound."""
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        self.model.train()
+        _, params, rest = self._split_model()
+        ema_decay = self.ema.get_decay(step) if self.ema is not None else 0.0
+        ema_in = self.ema_params if self.ema_params is not None else ()
+        sent_in = self._sentinel_state if self._sentinel_state is not None else ()
+        return self._train_step.lower(
+            params, rest, self.opt_state, ema_in, sent_in, batch,
+            jnp.asarray(lr, jnp.float32), jnp.asarray(ema_decay, jnp.float32)).compile()
+
     def reset_nonfinite(self):
         """Clear the consecutive-bad-step counters (after a rollback)."""
         if self._sentinel_state is not None:

@@ -175,6 +175,8 @@ def _run_child(args, timeout_s: int) -> dict | None:
     # precision/alignment A/B levers must reach the measurement process
     if args.block_scan:
         cmd += ['--block-scan']
+    if args.device_augment:
+        cmd += ['--device-augment']
     if args.fsdp:
         cmd += ['--fsdp', str(args.fsdp)]
     if args.tp:
@@ -268,6 +270,11 @@ def main():
     parser.add_argument('--block-scan', action='store_true', default=False,
                         help='scan-over-layers block execution: one lax.scan over '
                              'stacked per-layer params (O(1)-in-depth trace/compile)')
+    parser.add_argument('--device-augment', action='store_true', default=False,
+                        help='A/B the on-device data path: the train batch stays raw '
+                             'uint8 with host-sampled augment params, and the jitted '
+                             'normalize + mixup + erase program runs fused ahead of '
+                             'every step (data/device_augment.py)')
     parser.add_argument('--fsdp', type=int, default=0, metavar='N',
                         help='shard params + optimizer state over an N-way fsdp mesh '
                              "axis (ZeRO-style; mesh becomes ('data', 'fsdp')); 0 = off")
@@ -475,9 +482,32 @@ def _dry_run(args) -> int:
         tag += ' [no-donate]'
     rng = np.random.RandomState(0)
     n = max(2, mesh.size)  # batch must divide over the mesh batch axes
-    batch = shard_batch({'x': jnp.asarray(rng.rand(n, img, img, 3), jnp.float32),
-                         't': jnp.asarray(rng.randint(0, model.num_classes, n))}, mesh)
-    x, t = batch['x'], batch['t']
+    if getattr(args, 'device_augment', False):
+        import functools
+
+        from timm_tpu.data.device_augment import augment_image_batch
+        tag += ' [device_augment]'
+        raw = shard_batch({
+            'image': jnp.asarray((rng.rand(n, img, img, 3) * 255).astype(np.uint8)),
+            'target': jnp.asarray(rng.randint(0, model.num_classes, n)),
+            'lam': jnp.full((n,), 0.7, jnp.float32),
+            'use_cutmix': jnp.zeros((n,), bool),
+            'bbox': jnp.zeros((n, 4), jnp.int32)}, mesh)
+        aug_fn = functools.partial(
+            augment_image_batch, mean=(0.5,) * 3, std=(0.5,) * 3,
+            num_classes=model.num_classes, smoothing=0.1)
+        x, y_soft = jax.jit(aug_fn)(raw)  # not donated: x feeds the eval pass too
+
+        def loss_for(m):
+            # soft-target CE mirrors the device-mixup train path
+            return -(y_soft * jax.nn.log_softmax(m(x))).sum(-1).mean()
+    else:
+        batch = shard_batch({'x': jnp.asarray(rng.rand(n, img, img, 3), jnp.float32),
+                             't': jnp.asarray(rng.randint(0, model.num_classes, n))}, mesh)
+        x, t = batch['x'], batch['t']
+
+        def loss_for(m):
+            return cross_entropy(m(x), t)
 
     model.train()
     opt = create_optimizer_v2(model, opt='adamw', lr=1e-3, weight_decay=0.05, **opt_kwargs)
@@ -490,7 +520,7 @@ def _dry_run(args) -> int:
     def train_step(p, o):
         def loss_fn(p):
             m = nnx.merge(graphdef, p, rest)
-            return cross_entropy(m(x), t)
+            return loss_for(m)
         loss, grads = jax.value_and_grad(loss_fn)(p)
         updates, o = opt.update(grads, o, p, lr=1e-3)
         return optax.apply_updates(p, updates), o, loss
@@ -811,6 +841,32 @@ def _measure(args) -> int:
     t = jax.device_put(jnp.asarray(rng.randint(0, model.num_classes, batch_size)),
                        data_sharding(mesh, 1))
 
+    aug_fn = aug_raw = None
+    if args.device_augment:
+        # on-device data path A/B: the batch stays raw uint8 + host-sampled
+        # params, and the augment program runs fused inside the scanned step
+        # so its per-step cost rides the measurement
+        import functools
+
+        from timm_tpu.data.device_augment import augment_image_batch
+        s = args.img_size
+        aug_raw = {
+            'image': jax.device_put(jnp.asarray(
+                rng.randint(0, 256, (batch_size, s, s, 3)).astype(np.uint8)),
+                data_sharding(mesh, 4)),
+            'target': t,
+            'lam': jax.device_put(jnp.asarray(rng.beta(0.8, 0.8, batch_size), jnp.float32),
+                                  data_sharding(mesh, 1)),
+            'use_cutmix': jax.device_put(jnp.zeros((batch_size,), bool),
+                                         data_sharding(mesh, 1)),
+            'bbox': jax.device_put(jnp.zeros((batch_size, 4), jnp.int32),
+                                   data_sharding(mesh, 2)),
+        }
+        aug_fn = functools.partial(
+            augment_image_batch, mean=(0.5,) * 3, std=(0.5,) * 3,
+            num_classes=model.num_classes, smoothing=0.1, out_dtype=jnp.bfloat16)
+        knob_tag += ' [device_augment]'
+
     if args.bench == 'train':
         model.train()
         opt = create_optimizer_v2(model, opt='adamw', lr=1e-3, weight_decay=0.05, **opt_kwargs)
@@ -834,6 +890,10 @@ def _measure(args) -> int:
 
                 def loss_fn(p):
                     m = nnx.merge(graphdef, p, rest)
+                    if aug_fn is not None:
+                        xf, y = aug_fn(x)  # x is the raw uint8 batch dict
+                        return -(y * jax.nn.log_softmax(
+                            m(xf).astype(jnp.float32))).sum(-1).mean()
                     return cross_entropy(m(x), t)
                 loss, grads = jax.value_and_grad(loss_fn)(params)
                 updates, opt_state = opt.update(grads, opt_state, params, lr=1e-3)
@@ -842,9 +902,16 @@ def _measure(args) -> int:
             (params, opt_state), losses = jax.lax.scan(body, (params, opt_state), None, length=K)
             return params, opt_state, losses[-1]
 
+        if aug_fn is not None:
+            x = aug_raw  # the augment program consumes the whole param'd batch
+            x_sh = {'image': data_sharding(mesh, 4), 'target': data_sharding(mesh, 1),
+                    'lam': data_sharding(mesh, 1), 'use_cutmix': data_sharding(mesh, 1),
+                    'bbox': data_sharding(mesh, 2)}
+        else:
+            x_sh = data_sharding(mesh, 4)
         multi_step = jax.jit(
             multi_step, donate_argnums=donate,
-            in_shardings=(param_sh, opt_sh, data_sharding(mesh, 4), data_sharding(mesh, 1)),
+            in_shardings=(param_sh, opt_sh, x_sh, data_sharding(mesh, 1)),
             out_shardings=(param_sh, opt_sh, replicate_sharding(mesh)))
 
         # warm-up compiles + runs once; its returned state feeds the timed
@@ -882,8 +949,9 @@ def _measure(args) -> int:
     mfu = None
     try:
         graphdef_e, state_e = nnx.split(model)
+        x_e = x['image'].astype(jnp.bfloat16) / 255 if isinstance(x, dict) else x
         fwd_flops = jax.jit(lambda s, xx: nnx.merge(graphdef_e, s)(xx)).lower(
-            state_e, x).compile().cost_analysis().get('flops', 0)
+            state_e, x_e).compile().cost_analysis().get('flops', 0)
         kind = jax.devices()[0].device_kind.lower().replace(' ', '').replace('tpu', '')
         peak = next((v for k, v in CHIP_PEAK.items() if k in kind or kind in k), 197e12)
         mfu = (fwd_flops * flops_mult / n_chips) / per_step / peak

@@ -46,6 +46,11 @@ def pytest_configure(config):
         'markers',
         'perfbudget: hardware-independent perf-regression budgets + profiler '
         'harness + bench replay smoke (runs in tier-1)')
+    config.addinivalue_line(
+        'markers',
+        'deviceaug: on-device batch augmentation + NaFlex packed bucketed '
+        'batching — host/device parity, donation, zero-recompile epochs '
+        '(runs in tier-1)')
 
 
 @pytest.fixture(scope='session')

@@ -9,6 +9,10 @@ from .constants import (
 )
 from .dataset import AugMixDataset, ImageDataset
 from .dataset_factory import create_dataset
+from .device_augment import (
+    DeviceAugment, DeviceAugmentStage, NaFlexDeviceAugment,
+    augment_image_batch, augment_image_batch_np, augment_naflex_batch,
+)
 from .loader import StreamingLoader, ThreadedLoader, create_loader
 from .readers_streaming import ReaderImageInTar, ReaderTfds, ReaderWds, assign_shards
 from .mixup import FastCollateMixup, Mixup

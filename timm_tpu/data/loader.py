@@ -64,6 +64,7 @@ class StreamingLoader:
             std=IMAGENET_DEFAULT_STD,
             process_index: int = 0,
             process_count: int = 1,
+            seed: int = 42,
             **kwargs,
     ):
         self.dataset = dataset
@@ -77,12 +78,15 @@ class StreamingLoader:
         self.std = np.asarray(std, np.float32)
         self.random_erasing = RandomErasing(
             probability=re_prob, mode=re_mode, min_count=re_count,
-            num_splits=re_num_splits, mean=self.mean, std=self.std) if re_prob > 0 and is_training else None
+            num_splits=re_num_splits, mean=self.mean, std=self.std,
+            seed=seed) if re_prob > 0 and is_training else None
         self.process_index = process_index
         self.process_count = process_count
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+        if self.random_erasing is not None:
+            self.random_erasing.set_epoch(epoch)  # resume-reproducible stream
         if hasattr(self.dataset, 'set_epoch'):
             self.dataset.set_epoch(epoch)
 
@@ -318,7 +322,8 @@ class ThreadedLoader:
         self.std = np.asarray(std, np.float32)
         self.random_erasing = RandomErasing(
             probability=re_prob, mode=re_mode, min_count=re_count,
-            num_splits=re_num_splits, mean=self.mean, std=self.std) if re_prob > 0 and is_training else None
+            num_splits=re_num_splits, mean=self.mean, std=self.std,
+            seed=seed) if re_prob > 0 and is_training else None
         self.process_index = process_index
         self.process_count = process_count
         self.num_aug_repeats = num_aug_repeats if is_training else 0
@@ -365,6 +370,8 @@ class ThreadedLoader:
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+        if self.random_erasing is not None:
+            self.random_erasing.set_epoch(epoch)  # resume-reproducible stream
 
     def __len__(self):
         n = len(self._local_indices)
@@ -543,6 +550,8 @@ def create_loader(
         persistent_workers: bool = True,
         worker_seeding: str = 'all',
         device_prefetch: int = 0,
+        device_augment: bool = False,
+        mixup=None,
         **kwargs,
 ):
     """(reference loader.py:205). Returns a ThreadedLoader yielding
@@ -552,11 +561,28 @@ def create_loader(
     that keeps up to N batches in flight on device (sharded over the global
     mesh), overlapping host→device transfer with the running step. Leave off
     when the consumer still mutates batches on host (mixup, grad-accum
-    concatenation)."""
+    concatenation).
+
+    ``device_augment=True`` moves RandomErasing, Mixup/CutMix (pass the Mixup
+    sampler via ``mixup=``) and normalize off the host: batches collate as
+    raw uint8, the host samples only the augmentation *parameters*, and one
+    donated jitted program per batch shape does the float math on device
+    (data/device_augment.py). The loader then yields (input, target) device
+    arrays — soft targets when mixup is active."""
     import jax
 
     if num_aug_repeats and not hasattr(dataset, '__getitem__'):
         raise ValueError('--aug-repeats requires a map-style (indexable) dataset')
+    if device_augment:
+        from .mixup import FastCollateMixup
+        if isinstance(collate_fn, FastCollateMixup) or isinstance(mixup, FastCollateMixup):
+            raise ValueError(
+                'device_augment=True already applies mixup on device; a host-side '
+                'FastCollateMixup collate would double-apply it. Pass a plain '
+                'Mixup instance via mixup= (parameter sampling only) instead.')
+        if not is_training:
+            raise ValueError('device_augment=True is a train-path stage '
+                             '(eval batches are not augmented)')
     if collate_fn is not None:
         raise NotImplementedError('custom collate_fn is not supported by ThreadedLoader')
 
@@ -588,13 +614,16 @@ def create_loader(
         crop_border_pixels=crop_border_pixels,
         re_prob=0.0,  # RE applied post-collate by the loader
         separate=num_aug_splits > 0,
+        output_dtype=np.uint8 if device_augment else None,
     )
 
     loader_kwargs = dict(
         batch_size=batch_size,
         is_training=is_training,
         drop_last=drop_last,
-        re_prob=re_prob,
+        # device_augment: host collates raw uint8 and samples erase params
+        # only — the DeviceAugmentStage below owns erase application
+        re_prob=0.0 if device_augment else re_prob,
         re_mode=re_mode,
         re_count=re_count,
         re_num_splits=re_num_splits,
@@ -602,6 +631,7 @@ def create_loader(
         std=std,
         process_index=jax.process_index(),
         process_count=jax.process_count(),
+        seed=seed,
     )
     if not hasattr(dataset, '__getitem__'):
         # iterable (streaming) dataset: the reader owns shard assignment
@@ -610,10 +640,20 @@ def create_loader(
         loader = ThreadedLoader(
             dataset,
             num_workers=num_workers,
-            seed=seed,
             num_aug_repeats=num_aug_repeats,
             **loader_kwargs,
         )
     if device_prefetch:
         loader = DevicePrefetcher(loader, size=device_prefetch)
+    if device_augment:
+        from .device_augment import DeviceAugmentStage
+        import jax.numpy as jnp
+        re_sampler = RandomErasing(
+            probability=re_prob, mode=re_mode, min_count=re_count,
+            num_splits=re_num_splits, mean=np.asarray(mean, np.float32),
+            std=np.asarray(std, np.float32), seed=seed) if re_prob > 0 else None
+        loader = DeviceAugmentStage(
+            loader, mean=mean, std=std, mixup=mixup, random_erasing=re_sampler,
+            re_mode=re_mode, noise_seed=seed,
+            out_dtype=jnp.float16 if fp16 else jnp.float32)
     return loader

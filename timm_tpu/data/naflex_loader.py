@@ -94,9 +94,13 @@ class NaFlexRandomErasing:
         self.mode = mode
         self.rng = rng or random.Random()
 
-    def __call__(self, patches: np.ndarray, coord: np.ndarray):
+    def sample_mask(self, coord: np.ndarray) -> Optional[np.ndarray]:
+        """Device-augment split: draw the erase rectangle only, returning the
+        (N,) token mask (None when the probability gate fails). Fills happen
+        on device: 'pixel' noise from a threaded jax.random key, 'const'
+        zeros (see data/device_augment.py augment_naflex_batch)."""
         if self.rng.random() > self.probability:
-            return patches
+            return None
         gh = int(coord[:, 0].max()) + 1
         gw = int(coord[:, 1].max()) + 1
         area = gh * gw
@@ -105,8 +109,13 @@ class NaFlexRandomErasing:
         ew = max(1, min(gw, int(round(target_area / eh))))
         top = self.rng.randint(0, gh - eh)
         left = self.rng.randint(0, gw - ew)
-        mask = ((coord[:, 0] >= top) & (coord[:, 0] < top + eh) &
+        return ((coord[:, 0] >= top) & (coord[:, 0] < top + eh) &
                 (coord[:, 1] >= left) & (coord[:, 1] < left + ew))
+
+    def __call__(self, patches: np.ndarray, coord: np.ndarray):
+        mask = self.sample_mask(coord)
+        if mask is None:
+            return patches
         patches = patches.copy()
         if self.mode == 'pixel':
             # noise drawn from a generator seeded off self.rng → reproducible
@@ -127,7 +136,8 @@ class NaFlexCollator:
         self.in_chans = in_chans
         self.patch_dim = patch_size * patch_size * in_chans
 
-    def __call__(self, samples: List[Tuple], seq_len: int, patch_size: Optional[int] = None) -> Dict:
+    def __call__(self, samples: List[Tuple], seq_len: int, patch_size: Optional[int] = None,
+                 erase_masks: Optional[List[Optional[np.ndarray]]] = None) -> Dict:
         B = len(samples)
         p_size = patch_size or self.patch_size
         patch_dim = p_size * p_size * self.in_chans
@@ -163,6 +173,15 @@ class NaFlexCollator:
         if has_mix:
             out['target_b'] = targets_b
             out['lam'] = lam
+        if erase_masks is not None:
+            # device-augment split: the fill happens on device, the host only
+            # ships the sampled token masks (padding rows stay False)
+            em = np.zeros((B, seq_len), bool)
+            for i, m in enumerate(erase_masks):
+                if m is not None:
+                    n = min(len(m), seq_len)
+                    em[i, :n] = m[:n]
+            out['erase_mask'] = em
         return out
 
 
@@ -193,7 +212,21 @@ class NaFlexLoader:
             process_index: int = 0,
             process_count: int = 1,
             batch_divisor: int = 1,
+            device_augment: bool = False,
+            bucket_mode: str = 'budget',
     ):
+        if bucket_mode not in ('budget', 'native'):
+            raise ValueError(f"bucket_mode must be 'budget' or 'native', got {bucket_mode!r}")
+        if bucket_mode == 'native':
+            if process_count > 1:
+                raise ValueError(
+                    'bucket_mode="native" assigns batches from per-image sizes, which is '
+                    'data-dependent and cannot keep multi-host SPMD programs in lockstep; '
+                    'use bucket_mode="budget" for multi-process training')
+            if patch_size_choices:
+                raise ValueError(
+                    'bucket_mode="native" uses a fixed patch_size (bucket assignment '
+                    'depends on it); patch_size_choices is only supported in budget mode')
         self.dataset = dataset
         self.tokens_per_batch = tokens_per_batch
         self.seq_lens = tuple(sorted(seq_lens))
@@ -223,6 +256,9 @@ class NaFlexLoader:
         self.process_index = process_index
         self.process_count = process_count
         self.batch_divisor = max(1, batch_divisor)
+        self.device_augment = device_augment
+        self.bucket_mode = bucket_mode
+        self._native_len = None  # exact batch count, known after one native epoch
         self.collator = NaFlexCollator(patch_size)
         # dataset must yield PIL images: disable any tensor transform
         if getattr(dataset, 'transform', None) is not None:
@@ -273,9 +309,57 @@ class NaFlexLoader:
         return batches
 
     def __len__(self):
+        if self.bucket_mode == 'native':
+            if self._native_len is not None:
+                return self._native_len
+            # estimate before the first epoch (bucket assignment is
+            # data-dependent); exact after one full pass
+            divisor = self.process_count * self.batch_divisor
+            bs = calculate_naflex_batch_size(
+                self.tokens_per_batch, self.seq_lens[-1], divisor=divisor)
+            return max(1, len(self.dataset) // bs)
         return len(self._schedule())
 
-    def __iter__(self):
+    def _load_array(self, img) -> np.ndarray:
+        arr = np.asarray(img, np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if not self.device_augment:
+            # device-augment keeps [0,1] floats; the jitted device program
+            # normalizes (mixup commutes with the affine normalize, and erase
+            # runs post-normalize on device just like the host path)
+            arr = (arr - self.mean) / self.std
+        return arr
+
+    def _make_samples(self, arrays, targets, patch_size, mix_rng):
+        """Mixup + patchify + erase for one batch group. Returns (samples,
+        erase_masks) where erase_masks is None unless the device-augment
+        split is active (then it parallels `samples`, entries None when the
+        per-sample probability gate failed)."""
+        do_mix = ((self.mixup_alpha > 0 or self.cutmix_alpha > 0) and len(arrays) > 1
+                  and mix_rng.random() < self.mixup_prob)
+        if do_mix:
+            from .naflex_mixup import mix_batch_variable_size
+            arrays, lams, pair_to = mix_batch_variable_size(
+                arrays, mixup_alpha=self.mixup_alpha, cutmix_alpha=self.cutmix_alpha,
+                switch_prob=self.mixup_switch_prob, rng=mix_rng)
+        sample_masks = self.device_augment and self.random_erasing is not None
+        erase_masks = [] if sample_masks else None
+        samples = []
+        for i, arr in enumerate(arrays):
+            p, c = patchify_np(arr, patch_size)
+            if sample_masks:
+                erase_masks.append(self.random_erasing.sample_mask(c))
+            elif self.random_erasing is not None:
+                p = self.random_erasing(p, c)
+            if do_mix:
+                t_b = targets[pair_to[i]] if i in pair_to else targets[i]
+                samples.append((p, c, targets[i], t_b, lams[i]))
+            else:
+                samples.append((p, c, targets[i]))
+        return samples, erase_masks
+
+    def _iter_budget(self):
         mix_rng = random.Random(self.seed * 31 + self.epoch)
         for seq_len, patch_size, bs, group in self._schedule():
             arrays, targets = [], []
@@ -284,37 +368,66 @@ class NaFlexLoader:
                 if self.hflip is not None:
                     img = self.hflip(img)
                 img = resize_to_seq_len(img, seq_len, patch_size, self.interpolation)
-                arr = np.asarray(img, np.float32) / 255.0
-                if arr.ndim == 2:
-                    arr = arr[:, :, None]
-                arr = (arr - self.mean) / self.std
-                arrays.append(arr)
+                arrays.append(self._load_array(img))
                 targets.append(target)
-
-            do_mix = ((self.mixup_alpha > 0 or self.cutmix_alpha > 0) and len(arrays) > 1
-                      and mix_rng.random() < self.mixup_prob)
-            if do_mix:
-                from .naflex_mixup import mix_batch_variable_size
-                arrays, lams, pair_to = mix_batch_variable_size(
-                    arrays, mixup_alpha=self.mixup_alpha, cutmix_alpha=self.cutmix_alpha,
-                    switch_prob=self.mixup_switch_prob, rng=mix_rng)
-                samples = []
-                for i, arr in enumerate(arrays):
-                    p, c = patchify_np(arr, patch_size)
-                    if self.random_erasing is not None:
-                        p = self.random_erasing(p, c)
-                    t_b = targets[pair_to[i]] if i in pair_to else targets[i]
-                    samples.append((p, c, targets[i], t_b, lams[i]))
-            else:
-                samples = []
-                for arr, t in zip(arrays, targets):
-                    p, c = patchify_np(arr, patch_size)
-                    if self.random_erasing is not None:
-                        p = self.random_erasing(p, c)
-                    samples.append((p, c, t))
+            samples, erase_masks = self._make_samples(arrays, targets, patch_size, mix_rng)
             yield self.collator(
                 samples, seq_len,
-                patch_size=patch_size if self.patch_size_choices else None)
+                patch_size=patch_size if self.patch_size_choices else None,
+                erase_masks=erase_masks)
+
+    def _iter_native(self):
+        """Smallest-fit bucketing (reuses serve/bucketing.py semantics): each
+        image goes to the smallest ladder bucket holding its NATIVE grid's
+        token count, instead of a randomly scheduled seq_len. Batches are
+        emitted whenever a bucket's buffer fills; training drops ragged
+        leftovers, eval wrap-pads them so shapes stay static."""
+        from ..serve.bucketing import select_bucket
+        mix_rng = random.Random(self.seed * 31 + self.epoch)
+        rng = random.Random(self.seed + self.epoch)
+        indices = list(range(len(self.dataset)))
+        if self.is_training:
+            rng.shuffle(indices)
+        p = self.patch_size
+        divisor = self.process_count * self.batch_divisor
+        bucket_bs = {s: calculate_naflex_batch_size(self.tokens_per_batch, s, divisor=divisor)
+                     for s in self.seq_lens}
+        buffers = {s: [] for s in self.seq_lens}
+        max_bucket = self.seq_lens[-1]
+        count = 0
+
+        def emit(seq_len, buf):
+            arrays = [a for a, _ in buf]
+            targets = [t for _, t in buf]
+            samples, erase_masks = self._make_samples(arrays, targets, p, mix_rng)
+            return self.collator(samples, seq_len, erase_masks=erase_masks)
+
+        for idx in indices:
+            img, target = self.dataset[idx]
+            if self.hflip is not None:
+                img = self.hflip(img)
+            w, h = img.size
+            tokens = max(1, round(h / p)) * max(1, round(w / p))
+            bucket = select_bucket(min(tokens, max_bucket), self.seq_lens)
+            img = resize_to_seq_len(img, bucket, p, self.interpolation)
+            buffers[bucket].append((self._load_array(img), target))
+            if len(buffers[bucket]) == bucket_bs[bucket]:
+                yield emit(bucket, buffers[bucket])
+                buffers[bucket] = []
+                count += 1
+        if not self.is_training:
+            for s in self.seq_lens:
+                buf = buffers[s]
+                if buf:
+                    reps = -(-bucket_bs[s] // len(buf))
+                    yield emit(s, (buf * reps)[:bucket_bs[s]])
+                    count += 1
+        self._native_len = count
+
+    def __iter__(self):
+        if self.bucket_mode == 'native':
+            return self._iter_native()
+        return self._iter_budget()
 
 
 def create_naflex_loader(
@@ -338,17 +451,25 @@ def create_naflex_loader(
         re_mode: str = 'pixel',
         seed: int = 42,
         grad_accum_steps: int = 1,
+        device_augment: bool = False,
+        bucket_mode: str = 'budget',
+        device_prefetch: int = 0,
         **kwargs,
 ):
     """(reference naflex_loader.py:225).
 
     With grad accumulation the token budget scales by the accum steps so the
     jitted step's microbatches are each `batch_size` — the effective update
-    batch matches the tuple pipeline's global batch (batch_size * accum)."""
+    batch matches the tuple pipeline's global batch (batch_size * accum).
+
+    device_augment=True moves normalize + random-erase fill into a donated
+    jitted on-device program (one per bucket shape); the host ships [0,1]
+    patches plus sampled erase-token masks. device_prefetch>0 additionally
+    wraps the loader in a DevicePrefetcher so transfers overlap the step."""
     import jax
     tokens_per_batch = batch_size * max(1, grad_accum_steps) * max_seq_len
     seq_lens = train_seq_lens if is_training else (max_seq_len,)
-    return NaFlexLoader(
+    loader = NaFlexLoader(
         dataset,
         tokens_per_batch=tokens_per_batch,
         seq_lens=seq_lens,
@@ -370,4 +491,14 @@ def create_naflex_loader(
         process_index=jax.process_index(),
         process_count=jax.process_count(),
         batch_divisor=max(1, grad_accum_steps),
+        device_augment=device_augment,
+        bucket_mode=bucket_mode,
     )
+    if device_prefetch:
+        from .loader import DevicePrefetcher
+        loader = DevicePrefetcher(loader, size=device_prefetch)
+    if device_augment:
+        from .device_augment import NaFlexDeviceAugment
+        loader = NaFlexDeviceAugment(
+            loader, mean=mean, std=std, re_mode=re_mode, noise_seed=seed)
+    return loader

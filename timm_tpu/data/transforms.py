@@ -57,7 +57,11 @@ class Compose:
 
 
 class ToNumpy:
-    """PIL → float32 HWC ndarray in [0,1] (normalization is on-device)."""
+    """PIL → float32 HWC ndarray in [0,1] (normalization is on-device).
+
+    With dtype=np.uint8 the raw bytes pass through untouched — the
+    device-augment path transfers uint8 and does the /255 + float math in the
+    jitted on-device program (see data/device_augment.py)."""
 
     def __init__(self, dtype=np.float32):
         self.dtype = dtype
@@ -66,6 +70,8 @@ class ToNumpy:
         arr = np.asarray(img)
         if arr.ndim == 2:
             arr = arr[:, :, None]
+        if self.dtype == np.uint8:
+            return arr.astype(np.uint8)
         if arr.dtype == np.uint8:
             arr = arr.astype(self.dtype) / 255.0
         return arr.astype(self.dtype)

@@ -18,6 +18,7 @@ __all__ = ['create_transform', 'transforms_imagenet_train', 'transforms_imagenet
 def transforms_noaug_train(
         img_size=224,
         interpolation='bilinear',
+        output_dtype=None,
         **kwargs,
 ):
     if interpolation == 'random':
@@ -25,7 +26,7 @@ def transforms_noaug_train(
     return Compose([
         Resize(img_size if isinstance(img_size, int) else max(img_size), interpolation=interpolation),
         CenterCrop(img_size),
-        ToNumpy(),
+        ToNumpy(output_dtype) if output_dtype is not None else ToNumpy(),
     ])
 
 
@@ -48,9 +49,12 @@ def transforms_imagenet_train(
         re_count: int = 1,
         re_num_splits: int = 0,
         separate: bool = False,
+        output_dtype=None,
         **kwargs,
 ):
-    """Train pipeline (reference transforms_factory.py:65)."""
+    """Train pipeline (reference transforms_factory.py:65). `output_dtype`
+    overrides the ToNumpy dtype — np.uint8 keeps raw bytes for the
+    device-augment path."""
     scale = tuple(scale or (0.08, 1.0))
     ratio = tuple(ratio or (3. / 4., 4. / 3.))
     primary_tfl = [RandomResizedCropAndInterpolation(img_size, scale=scale, ratio=ratio, interpolation=interpolation)]
@@ -88,7 +92,7 @@ def transforms_imagenet_train(
     if gaussian_blur_prob:
         secondary_tfl.append(RandomGaussianBlur(p=gaussian_blur_prob))
 
-    final_tfl = [ToNumpy()]
+    final_tfl = [ToNumpy(output_dtype) if output_dtype is not None else ToNumpy()]
     # NOTE: RandomErasing runs post-collate on the batch (see loader.py) to
     # mirror the reference's device-side erasing placement.
     if separate:
@@ -102,6 +106,7 @@ def transforms_imagenet_eval(
         crop_mode: Optional[str] = None,
         crop_border_pixels: Optional[int] = None,
         interpolation: str = 'bilinear',
+        output_dtype=None,
         **kwargs,
 ):
     """Eval pipeline w/ crop modes (reference transforms_factory.py:273)."""
@@ -126,7 +131,7 @@ def transforms_imagenet_eval(
         tfl += [ResizeKeepRatio(img_size, longest=1.0, interpolation=interpolation), CenterCropOrPad(img_size)]
     else:  # center
         tfl += [Resize(scale_size, interpolation=interpolation), CenterCrop(img_size)]
-    tfl.append(ToNumpy())
+    tfl.append(ToNumpy(output_dtype) if output_dtype is not None else ToNumpy())
     return Compose(tfl)
 
 
@@ -155,6 +160,7 @@ def create_transform(
         crop_mode=None,
         crop_border_pixels=None,
         separate: bool = False,
+        output_dtype=None,
         **kwargs,
 ):
     """(reference transforms_factory.py:379)."""
@@ -166,7 +172,8 @@ def create_transform(
         img_size = input_size
 
     if is_training and no_aug:
-        return transforms_noaug_train(img_size, interpolation=interpolation)
+        return transforms_noaug_train(img_size, interpolation=interpolation,
+                                      output_dtype=output_dtype)
     if is_training:
         return transforms_imagenet_train(
             img_size,
@@ -187,6 +194,7 @@ def create_transform(
             re_count=re_count,
             re_num_splits=re_num_splits,
             separate=separate,
+            output_dtype=output_dtype,
         )
     return transforms_imagenet_eval(
         img_size,
@@ -194,4 +202,5 @@ def create_transform(
         crop_mode=crop_mode,
         crop_border_pixels=crop_border_pixels,
         interpolation=interpolation,
+        output_dtype=output_dtype,
     )

@@ -42,6 +42,7 @@ def create_attention_mask(patch_valid, num_prefix_tokens: int = 0, symmetric: bo
 
     Returns (B, 1, L, L) bool when symmetric else key-only (B, 1, 1, L).
     """
+    patch_valid = patch_valid.astype(jnp.bool_)  # tolerate uint8/int masks post-transfer
     B, L = patch_valid.shape
     if num_prefix_tokens:
         prefix = jnp.ones((B, num_prefix_tokens), jnp.bool_)

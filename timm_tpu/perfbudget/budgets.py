@@ -60,6 +60,8 @@ TOLERANCES: Dict[str, tuple] = {
                                                # (~1.45x) without flaking
     'donation_aliases': ('lower', 0.10),
     'donation_ok': ('bool', 0.0),
+    'naflex_donation_aliases': ('lower', 0.10),
+    'naflex_donation_ok': ('bool', 0.0),
     'no_replicated_residual': ('bool', 0.0),
     'serve_programs': ('bool', 0.0),
     'serve_donation_declared': ('bool', 0.0),

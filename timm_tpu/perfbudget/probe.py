@@ -26,7 +26,10 @@ extracts everything XLA will tell us without a TPU:
 Collect modes trim tier-1 cost: ``trace`` never compiles, ``full`` compiles
 the train step, ``fwd`` compiles a forward-only program (the tp residual
 check — same program test_sharding compiles, so the persistent cache is
-shared), ``serve`` drives the engine prewarm path.
+shared), ``serve`` drives the engine prewarm path, ``augment`` compiles the
+on-device data-path programs (fused image augment + donated naflex augment),
+``naflex`` compiles the packed variable-resolution train step at one bucket
+shape.
 """
 from __future__ import annotations
 
@@ -49,8 +52,9 @@ class ProbeConfig:
     block_scan: Optional[bool] = None     # None = model default
     grad_accum: int = 1
     opt: str = 'adamw'
-    collect: str = 'full'                 # 'trace' | 'full' | 'fwd' | 'serve'
+    collect: str = 'full'   # 'trace' | 'full' | 'fwd' | 'serve' | 'augment' | 'naflex'
     buckets: Tuple[int, ...] = (2, 4)     # serve only
+    seq_len: int = 25                     # naflex packed probe only
     # tp 'fwd' residual-shape gate (config-specific HLO shape strings)
     fwd_expect_shard: str = ''
     fwd_forbid_full: str = ''
@@ -90,6 +94,17 @@ DEFAULT_MATRIX: Tuple[ProbeConfig, ...] = (
     ProbeConfig(name='serve_test_vit', model='test_vit',
                 model_kwargs=(('num_classes', 10), ('img_size', 32)),
                 collect='serve', buckets=(2, 4)),
+    # on-device augment programs: the fused uint8->erase->mixup->normalize
+    # image program stays tiny (eqns/flops/bytes), and the naflex variant's
+    # f32 patches donation provably reaches lowering (must-alias in the HLO)
+    ProbeConfig(name='device_augment',
+                model_kwargs=(('num_classes', 10), ('img_size', 32)),
+                batch_size=8, collect='augment'),
+    # NaFlex packed train step: dict-batch program the bucket ladder reuses
+    # per seq_len — eqn/FLOP/donation baseline for one bucket shape
+    ProbeConfig(name='naflex_packed', model='test_naflexvit',
+                model_kwargs=(('num_classes', 10),),
+                batch_size=8, collect='naflex', seq_len=25),
 )
 
 
@@ -256,6 +271,137 @@ def _probe_train(cfg: ProbeConfig) -> Dict:
     return metrics
 
 
+def _probe_augment(cfg: ProbeConfig) -> Dict:
+    """The on-device data-path programs (data/device_augment.py). Two pieces
+    of evidence: the fused image program (uint8 -> erase -> mixup -> normalize
+    -> soft targets) stays a small fixed-size jaxpr with bytes dominated by
+    the batch itself, and the NaFlex variant's float32 patches buffer donation
+    survives to the compiled HLO as a real alias (the uint8 image input can
+    never alias its float output, so the naflex program is where donation is
+    provable)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..data.device_augment import augment_image_batch, augment_naflex_batch
+    from ..parallel import create_mesh, set_global_mesh, shard_batch
+    from ..utils.compile_cache import count_jaxpr_eqns
+
+    mesh = create_mesh(fsdp=cfg.fsdp, tp=cfg.tp)
+    set_global_mesh(mesh)
+    rng = np.random.RandomState(0)
+    B = cfg.batch_size
+    s = int(cfg.kwargs().get('img_size', 32))
+    num_classes = int(cfg.kwargs().get('num_classes', 10))
+    raw = shard_batch({
+        'image': jnp.asarray(rng.randint(0, 256, (B, s, s, 3)), jnp.uint8),
+        'target': jnp.asarray(rng.randint(0, num_classes, B)),
+        'lam': jnp.asarray(rng.beta(0.8, 0.8, B), jnp.float32),
+        'use_cutmix': jnp.zeros((B,), bool),
+        'bbox': jnp.zeros((B, 4), jnp.int32),
+        'erase_box': jnp.zeros((B, 1, 4), jnp.int32),
+    }, mesh)
+    fn = functools.partial(augment_image_batch, mean=(0.5,) * 3, std=(0.5,) * 3,
+                           num_classes=num_classes, smoothing=0.1)
+
+    metrics: Dict = {}
+    t0 = time.perf_counter()
+    closed = jax.make_jaxpr(fn)(raw)
+    metrics['trace_ms'] = round((time.perf_counter() - t0) * 1e3, 3)
+    metrics['jaxpr_eqns'] = count_jaxpr_eqns(closed)
+    compiled = jax.jit(fn).lower(raw).compile()
+    ca = _cost_analysis(compiled)
+    if 'flops' in ca:
+        metrics['flops'] = float(ca['flops'])
+    if 'bytes accessed' in ca:
+        metrics['bytes_accessed'] = float(ca['bytes accessed'])
+
+    L, pd = 25, 4 * 4 * 3
+    nf = shard_batch({
+        'patches': jnp.asarray(rng.rand(B, L, pd), jnp.float32),
+        'patch_coord': jnp.asarray(rng.randint(0, 5, (B, L, 2)), jnp.int32),
+        'patch_valid': jnp.ones((B, L), bool),
+        'target': jnp.asarray(rng.randint(0, num_classes, B)),
+        'erase_mask': jnp.zeros((B, L), bool),
+    }, mesh)
+    nf_fn = functools.partial(augment_naflex_batch, mean=(0.5,) * 3, std=(0.5,) * 3)
+    nf_compiled = jax.jit(nf_fn, donate_argnums=(0,)).lower(nf).compile()
+    ev = donation_evidence(nf_compiled)
+    metrics['naflex_donation_aliases'] = ev['aliases']
+    # the (B, L, D) float patches round-trip f32 -> f32 at unchanged shape:
+    # the donation MUST alias; zero aliases means it silently died
+    metrics['naflex_donation_ok'] = ev['aliases'] > 0
+    return metrics
+
+
+def _probe_naflex(cfg: ProbeConfig) -> Dict:
+    """The packed variable-resolution train step (NaFlexClassificationTask on
+    a {patches, patch_coord, patch_valid, target} dict batch) at one bucket
+    shape: trace/eqn cost, XLA flops/bytes, and state donation — the program
+    every bucket in the seq-len ladder re-instantiates per shape."""
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    import timm_tpu
+    from ..optim import create_optimizer_v2
+    from ..parallel import (
+        create_mesh, param_bytes_per_device, set_global_mesh, shard_batch,
+    )
+    from ..task import NaFlexClassificationTask
+    from ..utils.compile_cache import count_jaxpr_eqns
+
+    mesh = create_mesh(fsdp=cfg.fsdp, tp=cfg.tp)
+    set_global_mesh(mesh)
+    model = timm_tpu.create_model(cfg.model, **cfg.kwargs())
+    model.train()
+    p = getattr(model.embeds, 'patch_size', 16)
+    num_classes = int(cfg.kwargs().get('num_classes', 1000))
+
+    rng = np.random.RandomState(0)
+    B, L = cfg.batch_size, cfg.seq_len
+    batch = shard_batch({
+        'patches': jnp.asarray(rng.rand(B, L, p * p * 3), jnp.float32),
+        'patch_coord': jnp.asarray(rng.randint(0, 5, (B, L, 2)), jnp.int32),
+        'patch_valid': jnp.asarray(np.arange(L)[None, :]
+                                   < rng.randint(L // 2, L + 1, (B, 1))),
+        'target': jnp.asarray(rng.randint(0, num_classes, B)),
+    }, mesh)
+
+    def build_task():
+        return NaFlexClassificationTask(
+            model, optimizer=create_optimizer_v2(model, opt=cfg.opt, lr=0.1),
+            mesh=mesh, grad_accum_steps=cfg.grad_accum)
+
+    task = build_task()
+    metrics: Dict = {}
+    trace_times = []
+    for t in (task, build_task()):
+        t0 = time.perf_counter()
+        jaxpr = t.trace_train_step(batch, lr=0.1)
+        trace_times.append((time.perf_counter() - t0) * 1e3)
+    metrics['trace_ms'] = round(min(trace_times), 3)
+    metrics['jaxpr_eqns'] = count_jaxpr_eqns(jaxpr)
+
+    rep, shard = param_bytes_per_device(
+        nnx.state(task.model, nnx.Param), mesh, task.partition_rules)
+    metrics['param_bytes_replicated'] = int(rep)
+    metrics['param_bytes_sharded'] = int(shard)
+
+    compiled = task.lower_train_step(batch, lr=0.1)
+    ca = _cost_analysis(compiled)
+    if 'flops' in ca:
+        metrics['flops'] = float(ca['flops'])
+    if 'bytes accessed' in ca:
+        metrics['bytes_accessed'] = float(ca['bytes accessed'])
+    ev = donation_evidence(compiled)
+    metrics['donation_aliases'] = ev['aliases']
+    metrics['donation_ok'] = ev['aliases'] > 0
+    return metrics
+
+
 def _probe_serve(cfg: ProbeConfig) -> Dict:
     from ..serve import InferenceEngine
 
@@ -289,6 +435,10 @@ def probe_config(cfg: ProbeConfig) -> Dict:
     try:
         if cfg.collect == 'serve':
             return _probe_serve(cfg)
+        if cfg.collect == 'augment':
+            return _probe_augment(cfg)
+        if cfg.collect == 'naflex':
+            return _probe_naflex(cfg)
         return _probe_train(cfg)
     finally:
         mesh_mod._GLOBAL_MESH = saved

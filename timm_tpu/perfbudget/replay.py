@@ -193,6 +193,18 @@ REPLAY_STEPS: Tuple[Dict, ...] = (
     dict(id='serve_drill', item=None, kind='serve',
          title='serving drill: continuous batching vs per-request at equal load',
          dry=dict(num_requests=128), live=dict(num_requests=1024)),
+    dict(id='device_augment', item=None, kind='train',
+         title='on-device data path A/B: raw uint8 batch + jitted augment program '
+               'fused into the step vs host-prepped floats (baseline step)',
+         dry=dict(_TINY, device_augment=True),
+         live=dict(_VITB, device_augment=True)),
+    dict(id='naflex_bucketed', item=5, kind='naflex',
+         title='NaFlex packed variable-resolution batches: zero fresh compiles over '
+               'the seq-len bucket ladder after warmup (the flash masked-N>=576 '
+               'experiment rides the same bucketed shapes)',
+         dry=dict(model='test_naflexvit', seq_lens=(16, 25, 36), batch=4),
+         live=dict(model='naflexvit_base_patch16_gap', seq_lens=(576, 784, 1024),
+                   batch=16, pallas=True)),
 )
 
 
@@ -244,15 +256,38 @@ def _build_tiny_step(spec: Dict):
     rng = np.random.RandomState(0)
     n = max(int(spec['batch']), mesh.size)
     s = spec['img_size']
-    batch = shard_batch(
-        {'x': jnp.asarray(rng.rand(n, s, s, 3), jnp.float32),
-         't': jnp.asarray(rng.randint(0, model.num_classes, n))}, mesh)
-    x, t = batch['x'], batch['t']
+    if spec.get('device_augment'):
+        # on-device data path: raw uint8 batch + host-sampled params; the
+        # jitted augment program runs fused inside the train step so its
+        # per-step cost rides the A/B measurement
+        import functools
+
+        from ..data.device_augment import augment_image_batch
+        raw = shard_batch({
+            'image': jnp.asarray((rng.rand(n, s, s, 3) * 255).astype(np.uint8)),
+            'target': jnp.asarray(rng.randint(0, model.num_classes, n)),
+            'lam': jnp.asarray(rng.beta(0.8, 0.8, n), jnp.float32),
+            'use_cutmix': jnp.zeros((n,), bool),
+            'bbox': jnp.zeros((n, 4), jnp.int32)}, mesh)
+        aug = functools.partial(augment_image_batch, mean=(0.5,) * 3, std=(0.5,) * 3,
+                                num_classes=model.num_classes, smoothing=0.1)
+
+        def batch_loss(m):
+            xf, y = aug(raw)
+            return -(y * jax.nn.log_softmax(m(xf))).sum(-1).mean()
+    else:
+        batch = shard_batch(
+            {'x': jnp.asarray(rng.rand(n, s, s, 3), jnp.float32),
+             't': jnp.asarray(rng.randint(0, model.num_classes, n))}, mesh)
+        x, t = batch['x'], batch['t']
+
+        def batch_loss(m):
+            return cross_entropy(m(x), t)
 
     def train_step(p, o):
         def loss_fn(p):
             m = nnx.merge(graphdef, p, rest)
-            return cross_entropy(m(x), t)
+            return batch_loss(m)
         loss, grads = jax.value_and_grad(loss_fn)(p)
         updates, o = opt.update(grads, o, p, lr=1e-3)
         return optax.apply_updates(p, updates), o, loss
@@ -271,6 +306,8 @@ def _build_tiny_step(spec: Dict):
     meta = {'model': spec['model'], 'batch': n,
             'mesh': 'x'.join(str(mesh.shape[a]) for a in mesh.axis_names),
             'donate': not spec.get('no_donate', False)}
+    if spec.get('device_augment'):
+        meta['device_augment'] = True
     for knob in ('pad_tokens', 'softmax_dtype', 'norm_dtype', 'mu_dtype'):
         if spec.get(knob) is not None:
             meta[knob] = spec[knob]
@@ -369,6 +406,96 @@ def _run_profile(spec: Dict, trace_dir: Optional[str]) -> Dict:
     return summary
 
 
+def _run_naflex(spec: Dict) -> Dict:
+    """ISSUE-10 acceptance drill: donated NaFlex train steps over the declared
+    seq-len bucket ladder, with the on-device augment program (normalize +
+    token erase) ahead of each step. Epoch 1 warms one program per
+    bucket; epoch 2 re-runs every bucket under compile-cache event collection
+    and must observe ZERO fresh XLA compiles. The live spec additionally
+    records the Pallas flash-attention gate state, since the masked-N>=576
+    win-or-delete decision rides these same bucketed shapes."""
+    import functools
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import timm_tpu
+    from ..data.device_augment import augment_naflex_batch, batch_donate_argnums
+    from ..optim import create_optimizer_v2
+    from ..parallel import create_mesh, set_global_mesh
+    from ..task import NaFlexClassificationTask
+    from ..utils.compile_cache import cache_event_total, collect_cache_events
+
+    set_global_mesh(create_mesh(devices=jax.devices()[:1]))
+    model = timm_tpu.create_model(spec['model'], **spec.get('model_kwargs', {}))
+    p = getattr(model.embeds, 'patch_size', 16)
+    model.train()
+    opt = create_optimizer_v2(model, opt='adamw', lr=1e-3, weight_decay=0.05)
+    task = NaFlexClassificationTask(model, optimizer=opt)
+
+    B = int(spec['batch'])
+    buckets = tuple(spec['seq_lens'])
+    # batch_donate_argnums: donated on accelerators, not on CPU — a donated
+    # augment program deserialized from the persistent compile cache returns
+    # corrupted buffers on XLA:CPU (fresh compiles are fine, so the poison
+    # only bites the SECOND warm-cache process).
+    aug = jax.jit(functools.partial(augment_naflex_batch, mean=(0.5,) * 3,
+                                    std=(0.5,) * 3, re_mode='const'),
+                  donate_argnums=batch_donate_argnums())
+
+    def make_batch(seq_len, step):
+        rng = np.random.RandomState(1000 * seq_len + step)
+        gw = max(1, int(math.isqrt(seq_len)))
+        gh = seq_len // gw
+        n_tok = gh * gw  # natural grid <= bucket: padded slots stay invalid
+        yy, xx = np.meshgrid(np.arange(gh), np.arange(gw), indexing='ij')
+        patches = np.zeros((B, seq_len, p * p * 3), np.float32)
+        coord = np.zeros((B, seq_len, 2), np.int32)
+        valid = np.zeros((B, seq_len), bool)
+        patches[:, :n_tok] = rng.rand(B, n_tok, p * p * 3)
+        coord[:, :n_tok] = np.stack([yy, xx], -1).reshape(n_tok, 2)
+        valid[:, :n_tok] = True
+        erase = np.zeros((B, seq_len), bool)
+        erase[:, :max(1, n_tok // 8)] = True
+        return aug({'patches': jnp.asarray(patches),
+                    'patch_coord': jnp.asarray(coord),
+                    'patch_valid': jnp.asarray(valid),
+                    'target': jnp.asarray(rng.randint(0, model.num_classes, B)),
+                    'erase_mask': jnp.asarray(erase)})
+
+    losses = []
+
+    def run_epoch():
+        for sl in buckets:
+            metrics = task.train_step(make_batch(sl, len(losses)), lr=1e-3)
+            losses.append(float(metrics['loss']))
+
+    run_epoch()  # warmup epoch: one augment + one step program per bucket
+    t0 = time.perf_counter()
+    with collect_cache_events() as counts:
+        run_epoch()
+    dt = time.perf_counter() - t0
+    misses = cache_event_total(counts, 'cache_misses')
+    hits = cache_event_total(counts, 'cache_hits')
+    finite = all(math.isfinite(v) for v in losses)
+    out = {'status': 'ok' if (finite and misses == 0) else 'failed',
+           'buckets': list(buckets), 'batch': B, 'patch_size': p,
+           'warm_epoch_cache_misses': misses, 'warm_epoch_cache_hits': hits,
+           'zero_recompile': misses == 0, 'loss_finite': finite,
+           'warm_epoch_s': round(dt, 3)}
+    if spec.get('pallas'):
+        try:
+            from ..kernels import flash_attention  # noqa: F401
+            out['pallas_kernel_importable'] = True
+        except Exception:
+            out['pallas_kernel_importable'] = False
+        out['pallas_env_gate'] = os.environ.get('TIMM_TPU_PALLAS_ATTN', '')
+        out['live_needs'] = 'TIMM_TPU_PALLAS_ATTN=1 at masked N in {576, 784, 1024}'
+    return out
+
+
 def _run_serve(spec: Dict) -> Dict:
     import jax
 
@@ -401,6 +528,8 @@ def _run_step(step: Dict, dry_run: bool, trace_dir: Optional[str]) -> Dict:
         return _run_profile(spec, trace_dir)
     if step['kind'] == 'serve':
         return _run_serve(spec)
+    if step['kind'] == 'naflex':
+        return _run_naflex(spec)
     raise ValueError(f"unknown replay step kind {step['kind']!r}")
 
 

@@ -64,6 +64,16 @@ def make_parser():
     group.add_argument('--device-prefetch', type=int, default=0, metavar='N',
                        help='keep N batches in flight on device (async host->device '
                             'transfer overlapped with the step); 0 disables')
+    group.add_argument('--device-augment', action='store_true', default=False,
+                       help='run normalize + mixup/cutmix + random-erase as one donated '
+                            'jitted on-device program per batch shape; the host collates '
+                            'raw uint8 (or [0,1] NaFlex patches) and only samples augment '
+                            'parameters. Requires --grad-accum-steps 1 and a real dataset')
+    group.add_argument('--naflex-bucket-mode', type=str, default='budget',
+                       choices=('budget', 'native'),
+                       help='NaFlex seq-len assignment: "budget" schedules random ladder '
+                            'buckets per batch; "native" puts each image in the smallest '
+                            'bucket holding its natural grid (single-process only)')
     group.add_argument('--fsdp', type=int, default=0, metavar='N',
                        help="shard params + optimizer state over an N-way 'fsdp' mesh axis "
                             '(ZeRO-style; batch still shards over all devices). N must '
@@ -365,6 +375,20 @@ def main():
         norm_mean = norm_std = None
     else:
         task_cls = ClassificationTask
+    if args.device_augment:
+        if args.grad_accum_steps != 1:
+            raise ValueError(
+                '--device-augment yields device-resident batches; the host-side '
+                'micro-batch concatenation of --grad-accum-steps > 1 would bounce '
+                'them back to host. Use --grad-accum-steps 1')
+        if num_aug_splits > 1:
+            raise ValueError('--device-augment does not compose with --aug-splits '
+                             '(split-batch augmentation collates on host)')
+        if not args.naflex_loader and (args.synthetic_data or not args.data_dir):
+            raise ValueError('--device-augment needs a real dataset pipeline; '
+                             'pass --data-dir (synthetic batches are already device floats)')
+        # the on-device augment stage normalizes; the task must not re-normalize
+        norm_mean = norm_std = None
     task_kwargs = {}
     if args.naflex_loader and (args.mixup > 0 or args.cutmix > 0):
         # smoothing folds into the soft mixed targets (reference mixup_target)
@@ -445,7 +469,10 @@ def main():
             mixup_alpha=args.mixup, cutmix_alpha=args.cutmix,
             mixup_prob=args.mixup_prob, mixup_switch_prob=args.mixup_switch_prob,
             re_prob=args.reprob, re_mode='pixel' if args.remode == 'pixel' else 'const',
-            seed=args.seed, grad_accum_steps=args.grad_accum_steps)
+            seed=args.seed, grad_accum_steps=args.grad_accum_steps,
+            device_augment=args.device_augment,
+            bucket_mode=args.naflex_bucket_mode,
+            device_prefetch=args.device_prefetch if args.device_augment else 0)
         loader_eval = create_naflex_loader(
             dataset_eval, patch_size=patch_size,
             max_seq_len=args.naflex_max_seq_len,
@@ -475,6 +502,15 @@ def main():
                     'streaming schemes (wds/tfds/hfids) are not supported')
             from timm_tpu.data.dataset import AugMixDataset
             dataset_train = AugMixDataset(dataset_train, num_splits=num_aug_splits)
+        train_mixup = None
+        if args.device_augment and (args.mixup > 0 or args.cutmix > 0):
+            # parameter sampler only — the pixel/target math runs in the
+            # loader's jitted on-device program (data/device_augment.py)
+            from timm_tpu.data.mixup import Mixup
+            train_mixup = Mixup(
+                mixup_alpha=args.mixup, cutmix_alpha=args.cutmix, cutmix_minmax=args.cutmix_minmax,
+                prob=args.mixup_prob, switch_prob=args.mixup_switch_prob, mode=args.mixup_mode,
+                label_smoothing=args.smoothing, num_classes=args.num_classes, seed=args.seed)
         loader_train = create_loader(
             dataset_train,
             input_size=data_config['input_size'],
@@ -496,6 +532,9 @@ def main():
             std=data_config['std'],
             num_workers=args.workers,
             seed=args.seed,
+            device_augment=args.device_augment,
+            mixup=train_mixup,
+            device_prefetch=args.device_prefetch if args.device_augment else 0,
         )
         loader_eval = create_loader(
             dataset_eval,
@@ -508,7 +547,8 @@ def main():
             num_workers=args.workers,
             crop_pct=data_config['crop_pct'],
         )
-        mixup_fn = 'auto'
+        # device_augment folds mixup into the loader's on-device program
+        mixup_fn = None if args.device_augment else 'auto'
 
     # mixup applies to any (input, target)-tuple loader; naflex handles its own
     if mixup_fn == 'auto':
@@ -523,7 +563,11 @@ def main():
     if args.device_prefetch:
         from timm_tpu.data.loader import DevicePrefetcher
         loader_eval = DevicePrefetcher(loader_eval, size=args.device_prefetch)
-        if mixup_fn is None and args.grad_accum_steps == 1:
+        if args.device_augment:
+            # create_loader / create_naflex_loader already prefetch inside
+            # the device-augment stack; batches here are device-resident
+            pass
+        elif mixup_fn is None and args.grad_accum_steps == 1:
             loader_train = DevicePrefetcher(loader_train, size=args.device_prefetch)
         else:
             # mixup / grad-accum concatenation still mutate batches on host;
@@ -645,8 +689,13 @@ def main():
             raise SystemExit(0)
         if hasattr(loader_train, 'set_epoch'):
             loader_train.set_epoch(epoch)  # fresh shuffle/schedule (ref train.py:478)
-        if args.mixup_off_epoch and epoch >= args.mixup_off_epoch and mixup_fn is not None:
-            mixup_fn.mixup_enabled = False  # ref train.py disable-mixup schedule
+        if args.mixup_off_epoch and epoch >= args.mixup_off_epoch:
+            if mixup_fn is not None:
+                mixup_fn.mixup_enabled = False  # ref train.py disable-mixup schedule
+            elif getattr(loader_train, 'mixup', None) is not None:
+                # device-augment stage: same schedule; the sampler emits
+                # identity params (lam=1) so the jitted program is unchanged
+                loader_train.mixup.mixup_enabled = False
         try:
             train_metrics = train_one_epoch(
                 epoch, task, loader_train, args, lr_scheduler, mesh, shard_batch,

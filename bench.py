@@ -191,6 +191,8 @@ def _run_child(args, timeout_s: int) -> dict | None:
         cmd += ['--norm-dtype', args.norm_dtype]
     if args.mu_dtype:
         cmd += ['--mu-dtype', args.mu_dtype]
+    if args.quantize:
+        cmd += ['--quantize', args.quantize]
     t0 = time.time()
     out_f = tempfile.NamedTemporaryFile('w+', suffix='.out', delete=False)
     err_f = tempfile.NamedTemporaryFile('w+', suffix='.err', delete=False)
@@ -267,6 +269,11 @@ def main():
     parser.add_argument('--mu-dtype', default='',
                         help="optimizer first-moment dtype: 'bfloat16' halves m HBM "
                              "traffic (v stays fp32), '' = fp32")
+    parser.add_argument('--quantize', default='', choices=['', 'int8'],
+                        help="serve-path weight quantization A/B: 'int8' runs the "
+                             'measurement (--bench infer) against weight-only int8 '
+                             'params with dequant fused at use; also smoked by '
+                             "--dry-run. '' = dense weights")
     parser.add_argument('--block-scan', action='store_true', default=False,
                         help='scan-over-layers block execution: one lax.scan over '
                              'stacked per-layer params (O(1)-in-depth trace/compile)')
@@ -331,6 +338,11 @@ def main():
     parser.add_argument('--save-self', action='store_true',
                         help='on success, record result to BENCH_SELF.json')
     args = parser.parse_args()
+    if (args.quantize and args.bench == 'train'
+            and not (args.dry_run or args.serve or args.replay
+                     or args.profile or args.compile_report)):
+        parser.error('--quantize int8 quantizes weights for the serve path; '
+                     'measure it with --bench infer (or smoke with --dry-run)')
     if args.fast:
         args.model = 'vit_tiny_patch16_224'
         args.steps = 5
@@ -533,6 +545,21 @@ def _dry_run(args) -> int:
     model.eval()
     logits = model(x)
     ok = bool(jnp.isfinite(loss)) and bool(jnp.isfinite(logits).all())
+    quant_note = ''
+    if getattr(args, 'quantize', ''):
+        # int8 arm: quantize the just-trained eval state and run the same
+        # batch through the dequant-at-use program; the gate is "stays finite
+        # and tracks the fp32 logits", the tight tolerance lives in tier-1
+        from timm_tpu.quantize import dequantize_tree, quantize_tree
+        tag += ' [quant=int8]'
+        gd_e, st_e = nnx.split(model)
+        qstate = quantize_tree(st_e)
+        qlogits = jax.jit(
+            lambda q, xx: nnx.merge(gd_e, dequantize_tree(q))(xx))(qstate, x)
+        qdiff = float(jnp.max(jnp.abs(qlogits.astype(jnp.float32)
+                                      - logits.astype(jnp.float32))))
+        ok = ok and bool(jnp.isfinite(qlogits).all())
+        quant_note = f', int8 logits max|d|={qdiff:.4f}'
     fault_note = ''
     if getattr(args, 'fault_inject', ''):
         # exercise the injection hooks + their recovery paths without a slow
@@ -545,7 +572,7 @@ def _dry_run(args) -> int:
                       f' ({len(drill["checks"])} checks)')
     print(json.dumps({
         'metric': f'dry-run {args.model}{tag}: 1 train step + 1 infer step on '
-                  f'{jax.default_backend()}, loss finite={ok}{fault_note}',
+                  f'{jax.default_backend()}, loss finite={ok}{quant_note}{fault_note}',
         'value': 1.0 if ok else 0.0, 'unit': 'ok', 'vs_baseline': None}), flush=True)
     return 0 if ok else 2
 
@@ -927,11 +954,19 @@ def _measure(args) -> int:
     else:
         model.eval()
         graphdef, state = nnx.split(model)
+        if args.quantize:
+            # serve-path A/B: the program's weight inputs become the int8
+            # qvalues + scales; dequant runs at use inside every scanned
+            # forward, so HBM holds (and streams) the ~0.27x footprint
+            from timm_tpu.quantize import dequantize_tree, quantize_tree
+            state = quantize_tree(state)
+            knob_tag += ' [quant=int8]'
 
         @jax.jit
         def multi_fwd(state, x):
             def body(carry, _):
-                out = nnx.merge(graphdef, state)(x + carry * 0)
+                m_state = dequantize_tree(state) if args.quantize else state
+                out = nnx.merge(graphdef, m_state)(x + carry * 0)
                 return out.mean().astype(jnp.bfloat16), ()
             final, _ = jax.lax.scan(body, jnp.zeros((), jnp.bfloat16), None, length=K)
             return final

@@ -51,6 +51,11 @@ def pytest_configure(config):
         'deviceaug: on-device batch augmentation + NaFlex packed bucketed '
         'batching — host/device parity, donation, zero-recompile epochs '
         '(runs in tier-1)')
+    config.addinivalue_line(
+        'markers',
+        'quant: int8 post-training weight-only quantization — round-trip '
+        'bounds, golden-fixture logits tolerance, scale-spec inheritance, '
+        'quantized serve parity, distill smoke (runs in tier-1)')
 
 
 @pytest.fixture(scope='session')

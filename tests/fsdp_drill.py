@@ -297,7 +297,86 @@ def serve8(workdir):
     assert diff <= 1e-5, f'sharded serving logits diverged: {diff}'
 
 
+def quant_save8(workdir):
+    """Weight-only int8 under a real ('data','fsdp') mesh: the quantized
+    pytree places via build_quant_shardings (scales riding their kernels'
+    specs), the int8 checkpoint saves mesh-shape-agnostically, and a
+    quantized engine on the SAME mesh serves from it — logits recorded for
+    the 1-device reload drill."""
+    assert len(jax.devices()) == 8, jax.devices()
+    from timm_tpu.parallel import build_quant_shardings, set_global_mesh
+    from timm_tpu.quantize import quantize_tree, quantized_paths, save_quantized, tree_bytes
+    from timm_tpu.serve import InferenceEngine
+
+    serve_model, img = 'test_vit', 32
+    mesh = create_mesh(fsdp=4)
+    set_global_mesh(mesh)
+    model = timm_tpu.create_model(serve_model, img_size=img)
+    model.eval()
+    _, state = nnx.split(model)
+    qstate = quantize_tree(state)
+    placed = jax.device_put(qstate, build_quant_shardings(qstate, mesh))
+    qvalues_sharded = any(
+        'fsdp' in tuple(getattr(getattr(l, 'sharding', None), 'spec', ()) or ())
+        for l in jax.tree.leaves(placed['qvalues']))
+    ckpt = os.path.join(workdir, 'quant_ckpt.npz')
+    save_quantized(placed, ckpt)
+
+    rng = np.random.RandomState(0)
+    imgs = rng.standard_normal((8, img, img, 3)).astype(np.float32)
+    eng = InferenceEngine(buckets=(8,), max_wait_ms=2000.0, mesh=mesh)
+    eng.add_model(serve_model, img_size=img, quantize='int8', quantized_checkpoint=ckpt)
+    eng.start()
+    try:
+        futs = [eng.submit(im) for im in imgs]
+        rows = np.stack([f.result(timeout=300.0) for f in futs])
+    finally:
+        eng.shutdown(drain=True)
+    np.save(os.path.join(workdir, 'logits_quant8.npy'), rows)
+    res = eng.pool.acquire(serve_model)
+    print(json.dumps({
+        'devices': len(jax.devices()),
+        'mesh': [int(mesh.shape[a]) for a in mesh.axis_names],
+        'num_quantized': len(quantized_paths(placed)),
+        'qvalues_sharded_over_fsdp': bool(qvalues_sharded),
+        'quantize': res.quantize,
+        'param_bytes': int(res.param_bytes),
+        'dense_bytes': int(tree_bytes(state)),
+    }))
+
+
+def quant_load1(workdir):
+    """1 device: the int8 checkpoint saved on 8 devices loads into a
+    single-device quantized engine and serves identical logits (the dequant
+    math is deterministic; only matmul reduction order can differ)."""
+    assert len(jax.devices()) == 1, jax.devices()
+    from timm_tpu.serve import InferenceEngine
+
+    serve_model, img = 'test_vit', 32
+    ckpt = os.path.join(workdir, 'quant_ckpt.npz')
+    rng = np.random.RandomState(0)
+    imgs = rng.standard_normal((8, img, img, 3)).astype(np.float32)
+    eng = InferenceEngine(buckets=(8,), max_wait_ms=2000.0)
+    eng.add_model(serve_model, img_size=img, quantize='int8', quantized_checkpoint=ckpt)
+    eng.start()
+    try:
+        futs = [eng.submit(im) for im in imgs]
+        rows = np.stack([f.result(timeout=300.0) for f in futs])
+    finally:
+        eng.shutdown(drain=True)
+    saved = np.load(os.path.join(workdir, 'logits_quant8.npy'))
+    diff = float(np.abs(rows - saved).max())
+    res = eng.pool.acquire(serve_model)
+    print(json.dumps({
+        'devices': len(jax.devices()),
+        'quantize': res.quantize,
+        'param_bytes': int(res.param_bytes),
+        'logits_max_diff': diff,
+    }))
+    assert diff <= 1e-5, f'quantized cross-mesh serving diverged: {diff}'
+
+
 if __name__ == '__main__':
     mode, workdir = sys.argv[1], sys.argv[2]
     {'parity8': parity8, 'load1': load1, 'parity_tp': parity_tp, 'load1_tp': load1_tp,
-     'serve8': serve8}[mode](workdir)
+     'serve8': serve8, 'quant_save8': quant_save8, 'quant_load1': quant_load1}[mode](workdir)

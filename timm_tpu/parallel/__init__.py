@@ -9,7 +9,8 @@ from .distributed import (
 from .sharding import (
     PartitionRule, abstract_init_sharded, activation_bytes_per_device, build_opt_shardings,
     build_param_shardings, create_sharded_model, default_partition_rules, fsdp_size,
-    inherit_param_specs, match_rule, param_bytes_per_device, path_specs, replicated_like,
+    build_quant_shardings, inherit_param_specs, match_rule, param_bytes_per_device,
+    path_specs, quant_path_specs, quant_scale_spec, replicated_like,
     shard_pytree, spec_for_param, tp_size,
 )
 from .constraints import shard_activation

@@ -40,6 +40,7 @@ __all__ = [
     'PartitionRule', 'default_partition_rules', 'match_rule',
     'spec_for_param', 'build_param_shardings', 'path_specs',
     'inherit_param_specs', 'build_opt_shardings',
+    'quant_scale_spec', 'quant_path_specs', 'build_quant_shardings',
     'shard_pytree', 'abstract_init_sharded', 'create_sharded_model',
     'replicated_like', 'fsdp_size', 'tp_size', 'param_bytes_per_device',
     'activation_bytes_per_device',
@@ -338,6 +339,71 @@ def inherit_param_specs(
             spec = P()
         out.append(NamedSharding(mesh, spec))
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def quant_scale_spec(kernel_spec: P, scale_shape: Sequence[int], mesh: Mesh) -> P:
+    """Spec for a per-output-channel scale vector: it shards with the LAST
+    axis of its kernel's spec (the output-channel dim it indexes), so a
+    tensor-parallel column kernel keeps its dequant ``q * scale`` entirely
+    shard-local — no collectives enter the serve program. Any mismatch
+    (kernel replicated, scale not divisible) falls back to replicated, which
+    is always legal for a vector this small."""
+    if not kernel_spec or len(kernel_spec) == 0:
+        return P()
+    last = kernel_spec[-1]
+    if last is None or not scale_shape:
+        return P()
+    axes = last if isinstance(last, tuple) else (last,)
+    size = 1
+    for ax in axes:
+        size *= int(mesh.shape[ax])
+    if int(scale_shape[0]) % size != 0:
+        return P()
+    return P(last)
+
+
+def quant_path_specs(
+        qstate,
+        mesh: Mesh,
+        rules: Optional[Sequence[PartitionRule]] = None,
+        min_shard_size: int = MIN_SHARD_SIZE,
+) -> Dict[str, P]:
+    """{path: spec} for a quantized ``{'qvalues', 'scales'}`` pytree.
+
+    The int8 qvalue leaves resolve through the SAME rule table as their
+    dense originals (their stripped paths are identical, and the rules are
+    shape-based, not dtype-based), so fsdp/tp placement is unchanged by
+    quantization. Scales inherit by path exactly like m/v/EMA inherit from
+    params — see ``quant_scale_spec``.
+    """
+    from ..quantize.int8 import QUANT_QVALUES, QUANT_SCALES
+    qvalues, scales = qstate[QUANT_QVALUES], qstate[QUANT_SCALES]
+    flat, _ = jax.tree_util.tree_flatten_with_path(qvalues)
+    specs: Dict[str, P] = {}
+    kernel_specs: Dict[str, P] = {}
+    for kp, leaf in flat:
+        path = _kp_str(kp)
+        spec = spec_for_param(path, getattr(leaf, 'shape', ()), mesh, rules, min_shard_size)
+        specs[f'{QUANT_QVALUES}.{path}'] = spec
+        kernel_specs[path] = spec
+    for path, scale in scales.items():
+        specs[f'{QUANT_SCALES}.{path}'] = quant_scale_spec(
+            kernel_specs.get(path, P()), getattr(scale, 'shape', ()), mesh)
+    return specs
+
+
+def build_quant_shardings(
+        qstate,
+        mesh: Mesh,
+        rules: Optional[Sequence[PartitionRule]] = None,
+        min_shard_size: int = MIN_SHARD_SIZE,
+):
+    """NamedSharding tree with the quantized pytree's structure (the quant
+    analogue of ``build_param_shardings``)."""
+    specs = quant_path_specs(qstate, mesh, rules, min_shard_size)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(qstate)
+    shardings = [NamedSharding(mesh, specs[_kp_str(kp)]) for kp, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, shardings)
 
 
 def build_opt_shardings(optimizer, params, mesh: Mesh,

@@ -65,6 +65,22 @@ TOLERANCES: Dict[str, tuple] = {
     'no_replicated_residual': ('bool', 0.0),
     'serve_programs': ('bool', 0.0),
     'serve_donation_declared': ('bool', 0.0),
+    # int8 serve-path quantization (the quant probe): per-device bytes and
+    # compiled argument-buffer bytes are deterministic shape/dtype sums
+    # (tight band); the cost-model aggregates keep the loose estimate band.
+    # `quant_bytes_accessed_ratio` divides the compiled int8 programs'
+    # argument bytes by the fp32 twins' — the per-step HBM weight-read
+    # traffic — and must sit well under the 0.55x gate (quant_halves_hbm)
+    'param_bytes_fp32': ('band', 0.02),
+    'param_bytes_int8': ('band', 0.02),
+    'quant_param_bytes_ratio': ('band', 0.02),
+    'bytes_accessed_fp32': ('band', 0.50),
+    'hbm_bytes_accessed_fp32': ('band', 0.02),
+    'hbm_bytes_accessed_int8': ('band', 0.02),
+    'quant_bytes_accessed_ratio': ('band', 0.02),
+    'quant_halves_hbm': ('bool', 0.0),         # both ratios <= 0.55x fp32
+    'quant_sharding_ok': ('bool', 0.0),
+    'quant_scales_sharded': ('lower', 0.10),
 }
 _DEFAULT_TOL = ('band', 0.10)
 

@@ -1,0 +1,27 @@
+"""Post-training weight-only int8 quantization for the serve path.
+
+``quantize_tree`` is a pure pytree -> pytree transform over any model's
+``nnx.State``: every eligible kernel is replaced by an int8 tensor plus a
+per-output-channel symmetric scale, everything else (biases, norms, small
+embeddings) stays in its original dtype. ``dequantize_tree`` is the
+jit-traceable inverse used *inside* the serve/eval program, so XLA keeps the
+int8 weights in HBM (they are program inputs) and the fp32/bf16 copies are
+fused transients of the matmul epilogue — the HBM residency and bandwidth of
+weights halve while activations stay full precision.
+
+Scales ride the existing GSPMD partition rules: see
+``parallel.sharding.build_quant_shardings`` (each scale inherits the model
+axis of its kernel's last dim, so fsdp/tp placement is unchanged application
+code and dequant stays collective-free).
+"""
+from .int8 import (
+    QUANT_QVALUES, QUANT_SCALES, default_quant_predicate, dequantize_tree,
+    is_quantized, load_quantized, quantization_stats, quantize_tree,
+    quantized_paths, save_quantized, tree_bytes,
+)
+
+__all__ = [
+    'QUANT_QVALUES', 'QUANT_SCALES', 'default_quant_predicate',
+    'dequantize_tree', 'is_quantized', 'load_quantized', 'quantization_stats',
+    'quantize_tree', 'quantized_paths', 'save_quantized', 'tree_bytes',
+]

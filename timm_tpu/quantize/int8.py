@@ -1,0 +1,186 @@
+"""Weight-only int8 PTQ: per-output-channel symmetric scales, dequant-at-use.
+
+The quantized representation is a plain dict pytree
+
+    {'qvalues': <nnx.State with eligible kernels replaced by int8 arrays>,
+     'scales':  {param_path: per-output-channel scale, original dtype}}
+
+chosen so that (a) the flattened leaf paths still end in ``.kernel`` /
+``.bias`` / … exactly like the dense state — every existing regex partition
+rule and the per-device byte accounting keep working unmodified — and (b)
+the whole thing passes through ``jax.jit`` as one argument (string-keyed
+dicts are static structure; only the arrays are traced).
+
+Quantization math (per eligible kernel ``w`` of shape ``(..., out)``):
+
+    scale = max(|w|, axis=all-but-last) / 127        # one scale per output channel
+    q     = clip(round(w / scale), -127, 127).int8   # symmetric, zero-point-free
+    w'    = q.astype(scale.dtype) * scale            # dequant-at-use, inside jit
+
+which bounds the elementwise error by ``scale / 2`` (the absmax itself maps
+to exactly +/-127, so clipping never bites). The scale keeps the original
+param dtype so dequantization restores it without auxiliary metadata.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+QUANT_QVALUES = 'qvalues'
+QUANT_SCALES = 'scales'
+
+# Kernels below this element count stay dense: the scale + int8 overhead and
+# the extra dequant op outweigh the bytes saved (mirrors MIN_SHARD_SIZE).
+MIN_QUANT_SIZE = 1024
+
+
+def default_quant_predicate(path: str, leaf) -> bool:
+    """Eligible = a floating matmul kernel of useful size. Biases, norm
+    params, class/pos embeddings and tiny kernels keep their dtype."""
+    shape = getattr(leaf, 'shape', ())
+    dtype = getattr(leaf, 'dtype', None)
+    return (
+        path.endswith('.kernel')
+        and len(shape) >= 2
+        and dtype is not None and np.issubdtype(np.dtype(dtype), np.floating)
+        and int(np.prod(shape)) >= MIN_QUANT_SIZE
+    )
+
+
+def is_quantized(tree) -> bool:
+    return (isinstance(tree, dict)
+            and QUANT_QVALUES in tree and QUANT_SCALES in tree)
+
+
+def _channel_scale(w):
+    import jax.numpy as jnp
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)),
+                   axis=tuple(range(w.ndim - 1)))
+    # a dead (all-zero) output channel gets scale 1 so dequant is exact zero
+    scale = jnp.where(amax > 0, amax, 127.0) / 127.0
+    return scale.astype(w.dtype)
+
+
+def quantize_tree(state, *, predicate: Optional[Callable] = None) -> dict:
+    """Pure pytree -> pytree: dense ``nnx.State`` (or any param tree) to the
+    quantized ``{'qvalues', 'scales'}`` representation. Structure of
+    ``qvalues`` is identical to ``state`` — only eligible leaves change dtype."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.sharding import _kp_str
+
+    predicate = predicate or default_quant_predicate
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    scales: Dict[str, object] = {}
+    qleaves = []
+    for kp, leaf in flat:
+        path = _kp_str(kp)
+        if predicate(path, leaf):
+            scale = _channel_scale(leaf)
+            q = jnp.clip(jnp.round(leaf.astype(jnp.float32)
+                                   / scale.astype(jnp.float32)),
+                         -127, 127).astype(jnp.int8)
+            scales[path] = scale
+            qleaves.append(q)
+        else:
+            qleaves.append(leaf)
+    return {QUANT_QVALUES: jax.tree_util.tree_unflatten(treedef, qleaves),
+            QUANT_SCALES: scales}
+
+
+def dequantize_tree(qstate):
+    """Jit-traceable inverse: int8 leaves become ``q * scale`` in the scale's
+    dtype. Called *inside* the serve/eval program so the dense weights are
+    XLA transients and the int8 tensors are what lives in HBM."""
+    import jax
+
+    from ..parallel.sharding import _kp_str
+
+    qvalues, scales = qstate[QUANT_QVALUES], qstate[QUANT_SCALES]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(qvalues)
+    out = []
+    for kp, leaf in flat:
+        scale = scales.get(_kp_str(kp))
+        out.append(leaf if scale is None else leaf.astype(scale.dtype) * scale)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def quantized_paths(qstate) -> tuple:
+    return tuple(sorted(qstate[QUANT_SCALES]))
+
+
+def tree_bytes(tree) -> int:
+    """Host-side byte count of any pytree from shapes/dtypes (works on
+    abstract leaves too — no device transfer)."""
+    import jax
+    return int(sum(
+        int(np.prod(getattr(l, 'shape', ()) or (1,))) * np.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(tree)))
+
+
+def quantization_stats(state, qstate) -> dict:
+    dense, quant = tree_bytes(state), tree_bytes(qstate)
+    return {
+        'num_quantized': len(qstate[QUANT_SCALES]),
+        'dense_bytes': dense,
+        'quantized_bytes': quant,
+        'bytes_ratio': quant / max(dense, 1),
+    }
+
+
+# -- quantized checkpoints ----------------------------------------------------
+#
+# Flat npz with prefixed keys; mesh-shape-agnostic like the dense checkpoints
+# (arrays are gathered to host on save, re-placed by the loader's caller).
+
+_Q_PREFIX = 'int8.q::'
+_S_PREFIX = 'int8.scale::'
+_D_PREFIX = 'dense::'
+
+
+def save_quantized(qstate, path: str) -> None:
+    import jax
+
+    from ..parallel.sharding import _kp_str
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(qstate[QUANT_QVALUES])
+    scales = qstate[QUANT_SCALES]
+    arrays = {}
+    for kp, leaf in flat:
+        p = _kp_str(kp)
+        prefix = _Q_PREFIX if p in scales else _D_PREFIX
+        arrays[prefix + p] = np.asarray(leaf)
+    for p, s in scales.items():
+        arrays[_S_PREFIX + p] = np.asarray(s)
+    np.savez(path, **arrays)
+
+
+def load_quantized(path: str, template_state) -> dict:
+    """Rebuild a quantized pytree from ``save_quantized`` output using a
+    freshly-built model's dense state as the structure template."""
+    import jax
+
+    from ..parallel.sharding import _kp_str
+
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    scales = {k[len(_S_PREFIX):]: arrays[k]
+              for k in arrays if k.startswith(_S_PREFIX)}
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template_state)
+    leaves = []
+    for kp, leaf in flat:
+        p = _kp_str(kp)
+        key = (_Q_PREFIX + p) if p in scales else (_D_PREFIX + p)
+        if key not in arrays:
+            raise KeyError(f'quantized checkpoint {path!r} is missing {key!r} '
+                           f'(model/checkpoint mismatch)')
+        a = arrays[key]
+        if tuple(a.shape) != tuple(getattr(leaf, 'shape', ())):
+            raise ValueError(
+                f'quantized checkpoint {path!r}: shape mismatch at {p!r} '
+                f'({a.shape} vs model {getattr(leaf, "shape", ())})')
+        leaves.append(a)
+    return {QUANT_QVALUES: jax.tree_util.tree_unflatten(treedef, leaves),
+            QUANT_SCALES: scales}

@@ -132,11 +132,18 @@ class InferenceEngine:
 
     def add_model(self, name: str, factory=None, checkpoint: Optional[str] = None,
                   input_size: Optional[Tuple[int, int, int]] = None,
-                  prewarm: bool = True, **model_kwargs) -> None:
+                  prewarm: bool = True, quantize: Optional[str] = None,
+                  quantized_checkpoint: Optional[str] = None,
+                  **model_kwargs) -> None:
         """Register ``name`` with the residency pool. ``factory`` overrides
         the default ``timm_tpu.create_model(name, **model_kwargs)`` (+
         optional verified checkpoint load). ``prewarm=True`` loads and
-        AOT-compiles every bucket now; otherwise the first request pays it."""
+        AOT-compiles every bucket now; otherwise the first request pays it.
+        ``quantize='int8'`` serves post-training weight-only int8: the LRU
+        budget is charged the ~0.27x footprint and every bucket program
+        compiles against the int8 pytree with dequant fused at use
+        (``quantized_checkpoint`` loads saved qvalues/scales instead of
+        re-quantizing the factory's weights)."""
         if factory is None:
             def factory():
                 import timm_tpu
@@ -161,7 +168,9 @@ class InferenceEngine:
             model.eval()
             return model
 
-        self.pool.register(name, serving_factory, input_size=input_size)
+        self.pool.register(name, serving_factory, input_size=input_size,
+                           quantize=quantize,
+                           quantized_checkpoint=quantized_checkpoint)
         if prewarm:
             self.pool.acquire(name)
 
@@ -206,8 +215,17 @@ class InferenceEngine:
 
         graphdef = res.graphdef
 
-        def infer(state, x):
-            return nnx.merge(graphdef, state)(x).astype(jnp.float32)
+        if res.quantize:
+            from ..quantize import dequantize_tree
+
+            def infer(state, x):
+                # dequant INSIDE the program: the int8 qvalues/scales are the
+                # program inputs (what HBM holds between steps); the dense
+                # weights are fused transients of the matmul epilogue
+                return nnx.merge(graphdef, dequantize_tree(state))(x).astype(jnp.float32)
+        else:
+            def infer(state, x):
+                return nnx.merge(graphdef, state)(x).astype(jnp.float32)
 
         # donate the input buffer: each step uploads a fresh batch, XLA may
         # reuse it as scratch instead of holding both copies in HBM. When the

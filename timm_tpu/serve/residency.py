@@ -32,12 +32,14 @@ class ResidentModel:
     """One loaded model: split graphdef/state on the mesh + the per-bucket
     compiled executables the engine attaches at prewarm."""
 
-    def __init__(self, name: str, graphdef, state, param_bytes: int, input_size):
+    def __init__(self, name: str, graphdef, state, param_bytes: int, input_size,
+                 quantize: Optional[str] = None):
         self.name = name
         self.graphdef = graphdef
         self.state = state
         self.param_bytes = int(param_bytes)
         self.input_size = input_size  # (H, W, C) the compiled programs expect
+        self.quantize = quantize  # None (dense) or 'int8' ({'qvalues','scales'} state)
         self.compiled: Dict[int, object] = {}  # bucket -> AOT executable
         self.prewarm_stats: Dict[str, float] = {}
         self.last_used = time.perf_counter()
@@ -82,11 +84,22 @@ class ModelPool:
     # -- registration ---------------------------------------------------------
 
     def register(self, name: str, factory: Callable[[], object],
-                 input_size=None):
+                 input_size=None, quantize: Optional[str] = None,
+                 quantized_checkpoint: Optional[str] = None):
         """``input_size`` — (H, W, C) the compiled programs will expect;
-        resolved from the model's default_cfg when omitted."""
+        resolved from the model's default_cfg when omitted. ``quantize='int8'``
+        applies post-training weight-only quantization at load: the resident
+        state becomes the ``{'qvalues','scales'}`` pytree, the LRU budget is
+        charged the real int8 footprint, and the engine's bucket programs
+        compile against the int8 tree (dequant-at-use). A
+        ``quantized_checkpoint`` (from ``quantize.save_quantized``) replaces
+        the on-the-fly transform with saved qvalues/scales."""
+        if quantize not in (None, 'int8'):
+            raise ValueError(f'unsupported quantize mode {quantize!r} (only int8)')
+        if quantized_checkpoint and not quantize:
+            quantize = 'int8'
         with self._lock:
-            self._factories[name] = (factory, input_size)
+            self._factories[name] = (factory, input_size, quantize, quantized_checkpoint)
 
     @property
     def registered(self):
@@ -123,7 +136,7 @@ class ModelPool:
         from ..parallel import build_param_shardings
 
         t0 = time.perf_counter()
-        factory, input_size = self._factories[name]
+        factory, input_size, quantize, quantized_checkpoint = self._factories[name]
         model = factory()
         model.eval()
         if input_size is None:
@@ -132,29 +145,48 @@ class ModelPool:
             input_size = (int(chw[1]), int(chw[2]), int(chw[0]))  # CHW cfg → HWC input
         h, w, c = (int(s) for s in input_size)
         graphdef, state = nnx.split(model)
+        dense_bytes = None
+        if quantize:
+            from ..quantize import load_quantized, quantize_tree
+            dense_bytes = _state_bytes_per_device(state, self.mesh)
+            if quantized_checkpoint:
+                state = load_quantized(quantized_checkpoint, state)
+            else:
+                state = quantize_tree(state)
+        # the budget sees the ACTUAL loaded pytree's dtypes: an int8 model is
+        # charged int8 bytes, not the factory default dtype's
         nbytes = _state_bytes_per_device(state, self.mesh)
-        self._evict_to_fit(nbytes, loading=name)
+        self._evict_to_fit(nbytes, loading=name, dense_bytes=dense_bytes)
         if 'fsdp' in self.mesh.axis_names or 'model' in self.mesh.axis_names:
-            state = jax.device_put(state, build_param_shardings(state, self.mesh))
-        res = ResidentModel(name, graphdef, state, nbytes, (h, w, c))
+            if quantize:
+                from ..parallel import build_quant_shardings
+                state = jax.device_put(state, build_quant_shardings(state, self.mesh))
+            else:
+                state = jax.device_put(state, build_param_shardings(state, self.mesh))
+        res = ResidentModel(name, graphdef, state, nbytes, (h, w, c), quantize=quantize)
         res.prewarm_stats['load_ms'] = (time.perf_counter() - t0) * 1e3
         if self.prewarm_fn is not None:
             self.prewarm_fn(res)
         self._resident[name] = res
         self.stats['loads'] += 1
         _logger.info(
-            f'serve pool: loaded {name} ({nbytes / 1e6:.1f} MB/device, '
+            f'serve pool: loaded {name}{" [int8]" if quantize else ""} '
+            f'({nbytes / 1e6:.1f} MB/device, '
             f'{len(self._resident)} resident, '
             f'{self.resident_bytes() / 1e6:.1f} MB of '
             f'{"unbounded" if self.budget_bytes is None else f"{self.budget_bytes / 1e6:.1f} MB"} budget)')
         return res
 
-    def _evict_to_fit(self, incoming_bytes: int, loading: str):
+    def _evict_to_fit(self, incoming_bytes: int, loading: str,
+                      dense_bytes: Optional[int] = None):
         if self.budget_bytes is None:
             return
         if incoming_bytes > self.budget_bytes:
+            quant_note = ('' if dense_bytes is None else
+                          f', already int8-quantized from {dense_bytes / 1e6:.1f} MB dense')
             _logger.warning(
-                f'serve pool: model {loading!r} alone ({incoming_bytes / 1e6:.1f} MB/device) '
+                f'serve pool: model {loading!r} alone ({incoming_bytes / 1e6:.1f} MB/device'
+                f'{quant_note}) '
                 f'exceeds the HBM budget ({self.budget_bytes / 1e6:.1f} MB); '
                 f'keeping it resident anyway — raise the budget or serve a smaller model')
         while self._resident and \

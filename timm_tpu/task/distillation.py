@@ -12,9 +12,22 @@ import jax.numpy as jnp
 from flax import nnx
 
 from ..loss import LabelSmoothingCrossEntropy
+from ..parallel import build_param_shardings, replicate_sharding
 from .task import TrainingTask
 
 __all__ = ['LogitDistillationTask', 'FeatureDistillationTask']
+
+
+def _split_teacher(teacher: nnx.Module, mesh):
+    """Split the frozen teacher and place it on the task's mesh: weights under
+    the same partition rules as the student's (a big teacher must not end up
+    as a single-device or replicated constant inside the SPMD step), non-param
+    state replicated. Returns (graphdef, state) for nnx.merge at use."""
+    graphdef, params, rest = nnx.split(teacher, nnx.Param, ...)
+    params = jax.device_put(params, build_param_shardings(params, mesh))
+    if jax.tree.leaves(rest):
+        rest = jax.device_put(rest, replicate_sharding(mesh))
+    return graphdef, (params, rest)
 
 
 class LogitDistillationTask(TrainingTask):
@@ -33,7 +46,7 @@ class LogitDistillationTask(TrainingTask):
     ):
         super().__init__(model, optimizer=optimizer, **kwargs)
         teacher.eval()
-        self._teacher_graphdef, self._teacher_state = nnx.split(teacher)
+        self._teacher_graphdef, self._teacher_state = _split_teacher(teacher, self.mesh)
         self.train_loss_fn = train_loss_fn or LabelSmoothingCrossEntropy(0.0)
         self.alpha = distill_alpha
         self.temperature = distill_temperature
@@ -41,7 +54,7 @@ class LogitDistillationTask(TrainingTask):
     def loss_forward(self, model: nnx.Module, batch: Dict[str, Any]):
         x = batch['input']
         output = model(x)
-        teacher = nnx.merge(self._teacher_graphdef, self._teacher_state)
+        teacher = nnx.merge(self._teacher_graphdef, *self._teacher_state)
         teacher_logits = jax.lax.stop_gradient(teacher(x))
 
         base_loss = self.train_loss_fn(output, batch['target'])
@@ -88,7 +101,7 @@ class FeatureDistillationTask(TrainingTask):
             self.prepare_model(model, teacher)
         super().__init__(model, optimizer=optimizer, **kwargs)
         teacher.eval()
-        self._teacher_graphdef, self._teacher_state = nnx.split(teacher)
+        self._teacher_graphdef, self._teacher_state = _split_teacher(teacher, self.mesh)
         self.train_loss_fn = train_loss_fn or LabelSmoothingCrossEntropy(0.0)
         self.alpha = distill_alpha
         self.feat_loss = feat_loss
@@ -97,7 +110,7 @@ class FeatureDistillationTask(TrainingTask):
         x = batch['input']
         feats = model.forward_features(x)
         output = model.forward_head(feats)
-        teacher = nnx.merge(self._teacher_graphdef, self._teacher_state)
+        teacher = nnx.merge(self._teacher_graphdef, *self._teacher_state)
         t_feats = jax.lax.stop_gradient(teacher.forward_features(x))
 
         s_pool = feats.mean(axis=1) if feats.ndim == 3 else feats.mean(axis=(1, 2))

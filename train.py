@@ -61,6 +61,14 @@ def make_parser():
     group.add_argument('--block-scan', action='store_true', default=False,
                        help='run homogeneous transformer block stacks as one lax.scan '
                             'over stacked per-layer params (O(1)-in-depth trace/compile)')
+    group.add_argument('--distill', default='', type=str, metavar='SPEC',
+                       help="knowledge-distillation spec "
+                            "'teacher=NAME[,kind=logit|feature][,alpha=F][,temperature=F]"
+                            "[,feat_loss=cosine|mse][,checkpoint=PATH]': fine-tune the "
+                            'student against a frozen teacher running inside the same '
+                            'jitted donated train step (big-teacher -> small-student on '
+                            'the mesh); the distill-to-serve recipe pairs this with '
+                            'validate.py --quantize int8')
     group.add_argument('--device-prefetch', type=int, default=0, metavar='N',
                        help='keep N batches in flight on device (async host->device '
                             'transfer overlapped with the step); 0 disables')
@@ -233,6 +241,23 @@ def _parse_args():
     return args, args_text
 
 
+def _parse_distill(spec):
+    """'teacher=NAME,kind=logit,alpha=0.5,temperature=2.0' -> dict."""
+    out = dict(kind='logit', alpha=0.5, temperature=1.0, feat_loss='cosine', checkpoint='')
+    for item in filter(None, (s.strip() for s in spec.split(','))):
+        if '=' not in item:
+            raise ValueError(f"--distill: expected key=value, got {item!r}")
+        k, v = item.split('=', 1)
+        if k not in ('teacher', 'kind', 'alpha', 'temperature', 'feat_loss', 'checkpoint'):
+            raise ValueError(f'--distill: unknown key {k!r}')
+        out[k] = float(v) if k in ('alpha', 'temperature') else v
+    if 'teacher' not in out:
+        raise ValueError("--distill requires teacher=MODEL_NAME")
+    if out['kind'] not in ('logit', 'feature'):
+        raise ValueError(f"--distill: kind must be logit|feature, got {out['kind']!r}")
+    return out
+
+
 class SyntheticLoader:
     """Deterministic random image/label batches for smoke runs."""
 
@@ -365,6 +390,34 @@ def main():
         args.lr = args.lr_base * batch_ratio
         _logger.info(f'LR ({args.lr}) from base ({args.lr_base}) * {scale} batch ratio')
 
+    # distillation teacher: built (and, for feature distill, the student's
+    # projection attached) BEFORE the optimizer captures the param tree
+    distill = _parse_distill(args.distill) if args.distill else None
+    teacher = None
+    if distill is not None:
+        if args.naflex_loader:
+            raise ValueError('--distill does not compose with --naflex-loader '
+                             '(the teacher forward expects dense NHWC batches)')
+        from timm_tpu.models import load_checkpoint
+        from timm_tpu.task import FeatureDistillationTask, LogitDistillationTask
+        teacher_kwargs = dict(num_classes=args.num_classes, in_chans=args.in_chans, dtype=dtype)
+        try:
+            teacher = create_model(distill['teacher'], img_size=img_size, **teacher_kwargs)
+        except TypeError as e:
+            if 'img_size' not in str(e):
+                raise
+            teacher = create_model(distill['teacher'], **teacher_kwargs)
+        if distill['checkpoint']:
+            load_checkpoint(teacher, distill['checkpoint'])
+        teacher.eval()
+        if distill['kind'] == 'feature':
+            FeatureDistillationTask.prepare_model(model, teacher)
+        _logger.info(
+            f"Distilling from teacher {distill['teacher']} "
+            f"({distill['kind']}, alpha={distill['alpha']}, "
+            + (f"T={distill['temperature']}" if distill['kind'] == 'logit'
+               else f"feat_loss={distill['feat_loss']}") + ')')
+
     optimizer = create_optimizer_v2(model, **optimizer_kwargs(args))
     norm_mean = data_config['mean']
     norm_std = data_config['std']
@@ -375,6 +428,9 @@ def main():
         norm_mean = norm_std = None
     else:
         task_cls = ClassificationTask
+    if distill is not None:
+        task_cls = (LogitDistillationTask if distill['kind'] == 'logit'
+                    else FeatureDistillationTask)
     if args.device_augment:
         if args.grad_accum_steps != 1:
             raise ValueError(
@@ -393,6 +449,13 @@ def main():
     if args.naflex_loader and (args.mixup > 0 or args.cutmix > 0):
         # smoothing folds into the soft mixed targets (reference mixup_target)
         task_kwargs['mixup_label_smoothing'] = args.smoothing
+    if distill is not None:
+        task_kwargs['teacher'] = teacher
+        task_kwargs['distill_alpha'] = distill['alpha']
+        if distill['kind'] == 'logit':
+            task_kwargs['distill_temperature'] = distill['temperature']
+        else:
+            task_kwargs['feat_loss'] = distill['feat_loss']
     task = task_cls(
         model,
         optimizer=optimizer,

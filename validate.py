@@ -59,6 +59,14 @@ parser.add_argument('--block-scan', action='store_true', default=False,
                     help='scan-over-layers block execution (O(1)-in-depth trace/compile)')
 parser.add_argument('--device-prefetch', type=int, default=0, metavar='N',
                     help='keep N batches in flight on device while the step runs; 0 disables')
+parser.add_argument('--quantize', default='', type=str, choices=['', 'int8'],
+                    help='post-training weight-only quantization of the eval forward '
+                         '(serve-path parity): int8 per-output-channel symmetric scales, '
+                         'dequantized at use inside the jitted step')
+parser.add_argument('--quant-top1-delta', default=0.5, type=float, metavar='PCT',
+                    help='with --quantize: also run the fp32 arm on every batch (same data '
+                         'pass) and fail if quantized top-1 drops more than this many '
+                         'points below fp32; <= 0 skips the fp32 arm and the gate')
 parser.add_argument('--fsdp', type=int, default=0, metavar='N',
                     help="shard model weights over an N-way 'fsdp' mesh axis for eval "
                          '(fits models larger than one chip HBM); 0 disables')
@@ -155,20 +163,44 @@ def validate(args):
     mean = jnp.asarray(data_config['mean'], jnp.float32).reshape(1, 1, 1, -1)
     std = jnp.asarray(data_config['std'], jnp.float32).reshape(1, 1, 1, -1)
 
-    @jax.jit
-    def eval_step(state, x, target, valid):
-        x = (x - mean) / std
-        if dtype is not None:
-            x = x.astype(dtype)
-        logits = nnx.merge(graphdef, state)(x).astype(jnp.float32)
-        logprobs = jax.nn.log_softmax(logits, axis=-1)
-        w = valid.astype(jnp.float32)
-        denom = jnp.maximum(w.sum(), 1.0)
-        loss = -(jnp.take_along_axis(logprobs, target[:, None], axis=-1)[:, 0] * w).sum() / denom
-        top = jnp.argsort(logits, axis=-1)[:, -5:]
-        acc1 = ((top[:, -1] == target) * w).sum() / denom * 100.0
-        acc5 = ((top == target[:, None]).any(axis=-1) * w).sum() / denom * 100.0
-        return loss, acc1, acc5, top[:, ::-1]  # top-5 preds, best first
+    def make_eval_step(to_dense):
+        @jax.jit
+        def eval_step(state, x, target, valid):
+            x = (x - mean) / std
+            if dtype is not None:
+                x = x.astype(dtype)
+            logits = nnx.merge(graphdef, to_dense(state))(x).astype(jnp.float32)
+            logprobs = jax.nn.log_softmax(logits, axis=-1)
+            w = valid.astype(jnp.float32)
+            denom = jnp.maximum(w.sum(), 1.0)
+            loss = -(jnp.take_along_axis(logprobs, target[:, None], axis=-1)[:, 0] * w).sum() / denom
+            top = jnp.argsort(logits, axis=-1)[:, -5:]
+            acc1 = ((top[:, -1] == target) * w).sum() / denom * 100.0
+            acc5 = ((top == target[:, None]).any(axis=-1) * w).sum() / denom * 100.0
+            return loss, acc1, acc5, top[:, ::-1]  # top-5 preds, best first
+        return eval_step
+
+    # quantize-then-validate: the primary arm evaluates the int8 weights
+    # (dequantized at use inside the jit, exactly the serve-path program);
+    # the gate arm reruns fp32 on the SAME batches so the top-1 delta is a
+    # single-pass paired comparison, not two dataset traversals
+    eval_step_fp32 = None
+    if args.quantize:
+        from timm_tpu.quantize import dequantize_tree, quantize_tree
+        eval_state = quantize_tree(state)
+        if 'fsdp' in mesh.axis_names or 'model' in mesh.axis_names:
+            from timm_tpu.parallel import build_quant_shardings
+            eval_state = jax.device_put(
+                eval_state, build_quant_shardings(eval_state, mesh))
+        eval_step = make_eval_step(dequantize_tree)
+        if args.quant_top1_delta > 0:
+            eval_step_fp32 = make_eval_step(lambda s: s)
+        _logger.info(f'Quantized weights to {args.quantize} for eval'
+                     + ('' if eval_step_fp32 is None else
+                        f' (fp32 gate arm on, max top-1 delta {args.quant_top1_delta})'))
+    else:
+        eval_state = state
+        eval_step = make_eval_step(lambda s: s)
 
     # one bucket shape for the whole eval: batch_size rounded up to the mesh
     # shard count. The final partial batch pads up to the SAME shape as every
@@ -178,13 +210,17 @@ def validate(args):
     bucket = batch_bucket(args.batch_size, mesh.size)
 
     loss_m, top1_m, top5_m, time_m = AverageMeter(), AverageMeter(), AverageMeter(), AverageMeter()
+    top1_fp32_m = AverageMeter()
     end = time.time()
     for batch_idx, (x_np, t_np) in enumerate(loader):
         n = x_np.shape[0]
         x_np, t_np, valid_np = pad_rows(np.asarray(x_np), bucket, np.asarray(t_np))
         batch = shard_batch({'x': jnp.asarray(x_np), 't': jnp.asarray(t_np),
                              'v': jnp.asarray(valid_np)}, mesh)
-        loss, acc1, acc5, topk = eval_step(state, batch['x'], batch['t'], batch['v'])
+        loss, acc1, acc5, topk = eval_step(eval_state, batch['x'], batch['t'], batch['v'])
+        if eval_step_fp32 is not None:
+            _, ref1, _, _ = eval_step_fp32(state, batch['x'], batch['t'], batch['v'])
+            top1_fp32_m.update(float(ref1), n)
         if real_labels is not None:
             real_labels.add_result(np.asarray(topk)[:n], is_topk=True)  # drop pad rows
         loss_m.update(float(loss), n)
@@ -213,8 +249,22 @@ def validate(args):
         crop_pct=data_config['crop_pct'],
         interpolation=data_config['interpolation'],
     )
+    if args.quantize:
+        results['quantize'] = args.quantize
     _logger.info(' * Acc@1 {:.3f} ({:.3f}) Acc@5 {:.3f} ({:.3f})'.format(
         results['top1'], results['top1_err'], results['top5'], results['top5_err']))
+    if eval_step_fp32 is not None:
+        delta = top1_fp32_m.avg - top1_m.avg
+        results['top1_fp32'] = round(top1_fp32_m.avg, 4)
+        results['quant_top1_delta'] = round(delta, 4)
+        _logger.info(f' * Quant gate: fp32 Acc@1 {top1_fp32_m.avg:.3f}, '
+                     f'{args.quantize} Acc@1 {top1_m.avg:.3f}, delta {delta:+.4f} '
+                     f'(max allowed {args.quant_top1_delta})')
+        if delta > args.quant_top1_delta:
+            raise RuntimeError(
+                f'quantize-then-validate gate failed: {args.quantize} top-1 '
+                f'{top1_m.avg:.4f} is {delta:.4f} points below fp32 '
+                f'{top1_fp32_m.avg:.4f} (max allowed {args.quant_top1_delta})')
     return results
 
 

@@ -324,6 +324,15 @@ def main():
                              'tier-1 CPU smoke (tiny models, same code path).')
     parser.add_argument('--replay-steps', default='', metavar='A,B',
                         help='(with --replay) comma-separated subset of step ids')
+    parser.add_argument('--kernels', action='store_true',
+                        help='kernel portfolio win-or-delete A/B: run every registered '
+                             'Pallas kernel (timm_tpu/kernels/registry.py) against its '
+                             'XLA reference at the declared regime shapes and print one '
+                             'keep/delete/pending verdict line per kernel, recording the '
+                             'verdicts into BENCH_SELF.json. Combine with --dry-run for '
+                             'the tier-1 CPU smoke (parity always runs; timed verdicts '
+                             'settle on the claimed hardware). Also runs as the replay '
+                             "checklist's `kernels` step.")
     parser.add_argument('--profile', action='store_true',
                         help='capture a jax.profiler trace of the train step for --model '
                              'and print the self-parsed MXU vs non-MXU op summary '
@@ -355,6 +364,9 @@ def main():
 
     if args.replay:
         raise SystemExit(_replay_checklist(args))
+
+    if args.kernels:
+        raise SystemExit(_kernels_ab(args))
 
     if args.profile:
         raise SystemExit(_profile_run(args))
@@ -667,6 +679,43 @@ def _replay_checklist(args) -> int:
         'value': float(doc['completed']), 'unit': 'checklist steps ok',
         'vs_baseline': None}), flush=True)
     return rc if not errs else (rc or 2)
+
+
+def _kernels_ab(args) -> int:
+    """Kernel-portfolio win-or-delete A/B (PERF.md 'Kernel portfolio &
+    win-or-delete harness'): every registered Pallas kernel runs its declared
+    regime cases against its XLA reference — parity first (a kernel that is
+    wrong gets 'delete' without being timed), then wall-clock on hardware the
+    kernel actually claimed. The dry-run arm is the tier-1 CPU smoke: parity
+    still gates, TPU-claimed kernels come back 'pending'. Verdict records
+    stream into BENCH_SELF.json so the round file carries the decision data
+    even when the driver keeps only the tail line."""
+    _force_cpu_topology()
+    from timm_tpu.perfbudget.replay import load_self_doc, save_self_doc
+    from timm_tpu.utils import configure_compile_cache
+
+    configure_compile_cache()
+    from timm_tpu.kernels.harness import format_verdict_line, run_kernel_ab
+
+    live = not args.dry_run
+    _status(f'kernels: portfolio win-or-delete A/B ({"LIVE" if live else "dry-run"})')
+    verdicts = run_kernel_ab(live=live, steps=max(1, min(args.steps, 20)))
+    for rec in verdicts:
+        print(format_verdict_line(rec), flush=True)
+    doc = load_self_doc(SELF_RESULT_PATH)
+    doc['kernels'] = {'at': time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
+                      'live': live, 'verdicts': verdicts}
+    save_self_doc(SELF_RESULT_PATH, doc)
+    counts = {v: sum(1 for r in verdicts if r['verdict'] == v)
+              for v in ('keep', 'pending', 'delete')}
+    print(json.dumps({
+        'metric': (f"kernel portfolio A/B ({'live' if live else 'dry-run'}): "
+                   f"{counts['keep']} keep, {counts['pending']} pending, "
+                   f"{counts['delete']} delete of {len(verdicts)} registered "
+                   f'-> {SELF_RESULT_PATH}'),
+        'value': float(len(verdicts) - counts['delete']),
+        'unit': 'kernels surviving', 'vs_baseline': None}), flush=True)
+    return 0 if counts['delete'] == 0 else 2
 
 
 def _profile_run(args) -> int:

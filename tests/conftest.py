@@ -56,6 +56,11 @@ def pytest_configure(config):
         'quant: int8 post-training weight-only quantization — round-trip '
         'bounds, golden-fixture logits tolerance, scale-spec inheritance, '
         'quantized serve parity, distill smoke (runs in tier-1)')
+    config.addinivalue_line(
+        'markers',
+        'kernels: Pallas kernel portfolio — registry lint, auto-generated '
+        'parity, fused AdamW/EMA drift, augment-epilogue oracle parity, '
+        'win-or-delete verdicts (runs in tier-1)')
 
 
 @pytest.fixture(scope='session')

@@ -1,6 +1,29 @@
-"""TPU Pallas kernels for hot ops.
+# no-kernel-registry: package init — re-exports, no kernel defined here
+"""TPU Pallas kernels for hot ops, behind a win-or-delete registry.
 
-`flash_attention` is the Pallas fused-attention kernel used behind the
-`use_fused_attn()` config switch (see timm_tpu/layers/attention.py).
+Every kernel module here registers a `KernelSpec` (registry.py): a declared
+regime (the shapes/dtypes/mask pattern where it claims to beat XLA), a
+reference XLA implementation, and a parity tolerance. harness.py turns those
+specs into the auto-generated CPU-interpreter parity tests, the perfbudget
+`kernels` probe, and the `bench.py --kernels` keep/delete verdicts; an
+unregistered kernel module fails the lint in tests/test_kernels.py.
+
+Portfolio:
+- `flash_attention` — fused attention behind `use_fused_attn()` dispatch
+  (layers/attention.py); gate: win at masked N>=576 or delete.
+- `fused_adamw` — one-HBM-pass AdamW+EMA update, the opt-in
+  `TrainingTask(fused_update=True)` path; optax stays default + oracle.
+- `augment_epilogue` — one-pass uint8->erase->mix->normalize epilogue for
+  the PR-9 `DeviceAugment` program ('const' erase regime).
 """
 from .flash_attention import flash_attention, flash_attention_supported
+from .fused_adamw import fused_adamw_apply, fused_adamw_step
+from .augment_epilogue import augment_epilogue_supported, augment_image_batch_fused
+from .registry import KernelCase, KernelSpec, all_specs, ensure_registered
+
+__all__ = [
+    'flash_attention', 'flash_attention_supported',
+    'fused_adamw_apply', 'fused_adamw_step',
+    'augment_epilogue_supported', 'augment_image_batch_fused',
+    'KernelCase', 'KernelSpec', 'all_specs', 'ensure_registered',
+]

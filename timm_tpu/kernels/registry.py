@@ -1,0 +1,129 @@
+# no-kernel-registry: infrastructure module — the registry itself, not a kernel
+"""Kernel registry: every Pallas kernel declares its win regime as DATA.
+
+SNIPPETS.md [3]'s pjit premise is that the compiler owns layout, so a
+hand-written kernel is guilty until proven innocent: it must carry (a) a
+**reference XLA implementation** (the parity oracle AND the A/B baseline it
+has to beat), (b) a **declared regime** — the concrete shapes/dtypes/mask
+pattern where it claims to win, split into a `dry` arm (tiny, CPU-interpret,
+tier-1-smoked) and a `live` arm (the claimed shapes, decided on hardware) —
+and (c) a **parity tolerance**. harness.py consumes these specs to
+auto-generate the per-kernel parity test, the perfbudget `kernels` probe
+metrics, and the `bench.py --kernels` keep/delete verdict lines; an
+unregistered kernel module cannot land (tests/test_kernels.py lint).
+
+Kernel modules register themselves at import time; `ensure_registered()`
+imports the portfolio so registry consumers never observe a half-populated
+table.
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+__all__ = ['KernelCase', 'KernelSpec', 'register', 'unregister', 'get',
+           'all_specs', 'kernel_names', 'ensure_registered', 'default_io_bytes']
+
+# modules whose import populates the registry (the portfolio)
+_PORTFOLIO = ('flash_attention', 'fused_adamw', 'augment_epilogue')
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelCase:
+    """One point of a kernel's declared regime. `dry` / `live` are kwargs for
+    the spec's `make_inputs` — same runner, different scale (the replay dry/
+    live pattern): dry is tiny and CPU-provable, live is the claimed shape
+    the hardware A/B decides on. `statics` are forwarded to BOTH the kernel
+    and the reference (compile-time config: dtypes, masks, coefficients)."""
+    name: str
+    dry: Dict = dataclasses.field(default_factory=dict)
+    live: Dict = dataclasses.field(default_factory=dict)
+    statics: Dict = dataclasses.field(default_factory=dict)
+    desc: str = ''
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSpec:
+    """A registered kernel: implementation + oracle + executable win claim.
+
+    `kernel_fn` / `reference_fn` share one signature: ``fn(**inputs,
+    **case.statics)`` where `inputs = make_inputs(seed=..., **case.dry)`
+    (or `.live`). Outputs may be a single array or a pytree; parity compares
+    them leaf-for-leaf. `backends` scopes where the win claim is decidable —
+    off those backends the harness emits a `pending` verdict (parity still
+    measured, via `pallas_call(interpret=True)`)."""
+    name: str
+    module: str                      # python module the lint checks off
+    regime: str                      # prose: where the kernel claims to win
+    gate: str                        # the win-or-delete sentence
+    parity_tol: float
+    kernel_fn: Callable
+    reference_fn: Callable
+    make_inputs: Callable            # (seed=0, **case_kwargs) -> {name: array}
+    cases: Tuple[KernelCase, ...]
+    backends: Tuple[str, ...] = ('tpu',)
+
+    def __post_init__(self):
+        if not self.cases:
+            raise ValueError(f'kernel {self.name!r}: declared regime is empty '
+                             '(at least one KernelCase required)')
+        if not (self.parity_tol > 0):
+            raise ValueError(f'kernel {self.name!r}: parity_tol must be > 0')
+
+
+_REGISTRY: Dict[str, KernelSpec] = {}
+
+
+def register(spec: KernelSpec) -> KernelSpec:
+    if spec.name in _REGISTRY:
+        raise ValueError(f'kernel {spec.name!r} already registered')
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def unregister(name: str) -> None:
+    _REGISTRY.pop(name, None)
+
+
+def ensure_registered() -> None:
+    """Import the portfolio modules (idempotent) so every kernel's
+    import-time registration has run before the registry is consumed."""
+    for mod in _PORTFOLIO:
+        importlib.import_module(f'{__package__}.{mod}')
+
+
+def get(name: str) -> KernelSpec:
+    ensure_registered()
+    if name not in _REGISTRY:
+        raise KeyError(f'kernel {name!r} not registered '
+                       f'(have: {sorted(_REGISTRY)})')
+    return _REGISTRY[name]
+
+
+def all_specs() -> Tuple[KernelSpec, ...]:
+    ensure_registered()
+    return tuple(_REGISTRY[name] for name in sorted(_REGISTRY))
+
+
+def kernel_names() -> Tuple[str, ...]:
+    ensure_registered()
+    return tuple(sorted(_REGISTRY))
+
+
+def default_io_bytes(spec: KernelSpec, case: KernelCase,
+                     inputs: Optional[Dict] = None, seed: int = 0) -> int:
+    """Analytic one-pass HBM bytes of a kernel invocation: every input
+    operand read once + every output written once. For a Pallas kernel this
+    IS the HBM traffic contract (each grid block is DMA'd HBM->VMEM exactly
+    once; intermediates live in VMEM) — the number the XLA arm's pre-fusion
+    ``cost_analysis()['bytes accessed']`` is compared against in the
+    perfbudget `kernels` probe."""
+    import jax
+
+    if inputs is None:
+        inputs = spec.make_inputs(seed=seed, **case.dry)
+    total = sum(int(leaf.nbytes) for leaf in jax.tree.leaves(inputs))
+    out = jax.eval_shape(lambda kw: spec.reference_fn(**kw, **case.statics), inputs)
+    total += sum(int(leaf.size) * leaf.dtype.itemsize for leaf in jax.tree.leaves(out))
+    return total

@@ -202,6 +202,11 @@ REPLAY_STEPS: Tuple[Dict, ...] = (
                'fused into the step vs host-prepped floats (baseline step)',
          dry=dict(_TINY, device_augment=True),
          live=dict(_VITB, device_augment=True)),
+    dict(id='kernels', item=5, kind='kernels',
+         title='kernel portfolio win-or-delete A/B: every registered Pallas '
+               'kernel vs its XLA reference at the declared regime shapes '
+               '(dry = parity + pending gates on CPU; live = timed verdicts)',
+         dry=dict(steps=3), live=dict(steps=20)),
     dict(id='naflex_bucketed', item=5, kind='naflex',
          title='NaFlex packed variable-resolution batches: zero fresh compiles over '
                'the seq-len bucket ladder after warmup (the flash masked-N>=576 '
@@ -551,6 +556,23 @@ def _run_quant_serve(spec: Dict) -> Dict:
             'int8_p99_ms': int8['p99_ms'], 'num_requests': int8['num_requests']}
 
 
+def _run_kernels(spec: Dict, live: bool) -> Dict:
+    """Kernel-portfolio win-or-delete A/B over the registry
+    (kernels/harness.py). Parity always runs; on hardware a kernel did not
+    claim (dry CPU arm for the TPU-only portfolio) its verdict is 'pending'
+    — the gate settles on the first live relay window. A 'delete' verdict
+    (parity failure, or a timed loss on claimed hardware) fails the step:
+    the checklist refuses to carry a losing kernel forward."""
+    from ..kernels.harness import format_verdict_line, run_kernel_ab
+
+    verdicts = run_kernel_ab(live=live, steps=int(spec.get('steps', 5)))
+    deletes = [r['kernel'] for r in verdicts if r['verdict'] == 'delete']
+    return {'status': 'failed' if deletes else 'ok',
+            'kernels': len(verdicts), 'delete': deletes,
+            'verdicts': verdicts,
+            'verdict_lines': [format_verdict_line(r) for r in verdicts]}
+
+
 def _run_step(step: Dict, dry_run: bool, trace_dir: Optional[str]) -> Dict:
     spec = step['dry'] if dry_run else step['live']
     if step['kind'] == 'train':
@@ -565,6 +587,8 @@ def _run_step(step: Dict, dry_run: bool, trace_dir: Optional[str]) -> Dict:
         return _run_quant_serve(spec)
     if step['kind'] == 'naflex':
         return _run_naflex(spec)
+    if step['kind'] == 'kernels':
+        return _run_kernels(spec, live=not dry_run)
     raise ValueError(f"unknown replay step kind {step['kind']!r}")
 
 

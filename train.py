@@ -61,6 +61,11 @@ def make_parser():
     group.add_argument('--block-scan', action='store_true', default=False,
                        help='run homogeneous transformer block stacks as one lax.scan '
                             'over stacked per-layer params (O(1)-in-depth trace/compile)')
+    group.add_argument('--fused-update', action='store_true', default=False,
+                       help='route the optimizer update through the one-HBM-pass fused '
+                            'AdamW+EMA Pallas kernel (timm_tpu/kernels/fused_adamw.py). '
+                            'Requires a plain adamw --opt chain; optax stays the default '
+                            'and the parity oracle')
     group.add_argument('--distill', default='', type=str, metavar='SPEC',
                        help="knowledge-distillation spec "
                             "'teacher=NAME[,kind=logit|feature][,alpha=F][,temperature=F]"
@@ -467,6 +472,7 @@ def main():
         std=norm_std,
         nonfinite_guard=False if args.no_nonfinite_guard else None,
         nonfinite_tolerance=args.nonfinite_tolerance,
+        fused_update=args.fused_update,
         **task_kwargs,
     )
 

@@ -306,8 +306,10 @@ def main():
     parser.add_argument('--fault-inject', default='', metavar='SPEC',
                         help='(with --dry-run) also run the resilience fault-injection '
                              'selftest: truncated-checkpoint fallback, reader retry/backoff, '
-                             'poison-skip budget, @-step faults. SPEC is parse-checked; the '
-                             'canonical drill set always runs (tier-1 smoke, no TPU).')
+                             'poison-skip budget, @-step faults incl. elastic resize@N:D '
+                             '(fire-once parse + device-count capture). SPEC is '
+                             'parse-checked; the canonical drill set always runs '
+                             '(tier-1 smoke, no TPU).')
     parser.add_argument('--serve', action='store_true',
                         help='run the serving load drill instead of a train/infer bench: '
                              'canonical continuous-batching vs per-request A/B (two models, '

@@ -61,6 +61,11 @@ def pytest_configure(config):
         'kernels: Pallas kernel portfolio — registry lint, auto-generated '
         'parity, fused AdamW/EMA drift, augment-epilogue oracle parity, '
         'win-or-delete verdicts (runs in tier-1)')
+    config.addinivalue_line(
+        'markers',
+        'elastic: elastic pod-scale training — resize-the-mesh resume drills '
+        '(8↔4 devices, global batch invariant) + async checkpoint writer '
+        '(runs in tier-1)')
 
 
 @pytest.fixture(scope='session')

@@ -21,6 +21,15 @@ sets it). Modes:
                    and durably save the 2-D-sharded checkpoint.
   load1_tp <dir> — 1 device: verify + load the (2,2,2) checkpoint and eval —
                    the save is mesh-shape-agnostic.
+  elastic8to4 <dir> — elastic resume drill: an 8-device ('data','fsdp')=(2,4)
+                   train.py run is resize-faulted (`resize@3:4` → SIGTERM)
+                   mid-epoch, then restarted as a FRESH 4-device process with
+                   `--resume auto --elastic`; the planner holds the global
+                   batch constant, the mesh rebuilds as (1,4), and final
+                   params/optimizer state must match an uninterrupted run to
+                   ≤1e-6. Spawns 3 train.py subprocesses with XLA_FLAGS
+                   overridden per topology.
+  elastic4to8 <dir> — same drill scaling UP from 4 to 8 devices.
 
 Prints one JSON line with the results; exit 0 on success.
 """
@@ -376,7 +385,79 @@ def quant_load1(workdir):
     assert diff <= 1e-5, f'quantized cross-mesh serving diverged: {diff}'
 
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _elastic_train(workdir, experiment, devices, *extra):
+    """One train.py child pinned to a virtual CPU topology of `devices`."""
+    import subprocess
+    cmd = [
+        sys.executable, os.path.join(REPO, 'train.py'),
+        '--synthetic-data', '--model', 'test_vit', '--img-size', '32', '-b', '8',
+        '--synthetic-len', '64', '--epochs', '1', '--opt', 'sgd', '--lr', '0.05',
+        '--sched', 'cosine', '--warmup-epochs', '0', '--workers', '1',
+        '--log-interval', '50', '--fsdp', '4',
+        '--output', str(workdir), '--experiment', experiment, *extra,
+    ]
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               XLA_FLAGS=f'--xla_force_host_platform_device_count={devices}')
+    return subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=REPO, timeout=420)
+
+
+def _host_ckpt(path):
+    with np.load(path, allow_pickle=False) as d:
+        return {k: d[k] for k in d.files if k.startswith(('state_dict.', 'optimizer.'))}
+
+
+def _elastic(workdir, n_from, n_to):
+    """Resize drill: uninterrupted run at n_from devices vs a run resize-
+    faulted mid-epoch and resumed as a fresh n_to-device process. `--fsdp 4`
+    on every leg (4 divides both topologies: (2,4) on 8 devices, (1,4) on 4)
+    and batch geometry 8x1 is held constant so the synthetic loader stream —
+    and hence the final state — is reproducible across the resize."""
+    r = _elastic_train(workdir, 'base', n_from)
+    assert r.returncode == 0, r.stderr[-2000:]
+    r = _elastic_train(workdir, 'pre', n_from, '--fault-inject', f'resize@3:{n_to}')
+    assert r.returncode == 0, r.stderr[-2000:]
+    pre_dir = os.path.join(workdir, 'pre')
+    recs = [n for n in os.listdir(pre_dir) if n.startswith('recovery-') and n.endswith('.npz')]
+    assert recs, (sorted(os.listdir(pre_dir)), r.stderr[-2000:])
+    # the recovery checkpoint advertises the dead run's batch geometry
+    with np.load(os.path.join(pre_dir, recs[0])) as d:
+        saved_global = int(d['_resume.global_batch'])
+        saved_devices = int(d['_resume.device_count'])
+    assert saved_global == 8 and saved_devices == n_from, (saved_global, saved_devices)
+
+    r = _elastic_train(workdir, 'pre', n_to, '--resume', 'auto', '--elastic')
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert 'Resumed mid-epoch' in r.stderr, r.stderr[-2000:]
+    assert '[elastic] live topology' in r.stderr, r.stderr[-2000:]
+
+    base = _host_ckpt(os.path.join(workdir, 'base', 'last.npz'))
+    resumed = _host_ckpt(os.path.join(pre_dir, 'last.npz'))
+    assert set(base) == set(resumed)
+    diff = max(float(np.abs(base[k].astype(np.float64) - resumed[k].astype(np.float64)).max())
+               for k in base)
+    print(json.dumps({
+        'from_devices': n_from, 'to_devices': n_to,
+        'saved_global_batch': saved_global,
+        'max_param_diff': diff,
+        'recovery_pruned': not [n for n in os.listdir(pre_dir) if n.startswith('recovery-')],
+    }))
+    assert diff <= 1e-6, f'elastic resume diverged from uninterrupted run: {diff}'
+
+
+def elastic8to4(workdir):
+    _elastic(workdir, 8, 4)
+
+
+def elastic4to8(workdir):
+    _elastic(workdir, 4, 8)
+
+
 if __name__ == '__main__':
     mode, workdir = sys.argv[1], sys.argv[2]
     {'parity8': parity8, 'load1': load1, 'parity_tp': parity_tp, 'load1_tp': load1_tp,
-     'serve8': serve8, 'quant_save8': quant_save8, 'quant_load1': quant_load1}[mode](workdir)
+     'serve8': serve8, 'quant_save8': quant_save8, 'quant_load1': quant_load1,
+     'elastic8to4': elastic8to4, 'elastic4to8': elastic4to8}[mode](workdir)

@@ -1,0 +1,48 @@
+"""Elastic pod-scale training acceptance drills (ISSUE 13 tentpole).
+
+Each test runs `tests/fsdp_drill.py elastic8to4|elastic4to8` in a subprocess:
+a train.py run on the FROM topology is resize-faulted (`resize@3:D` → SIGTERM
++ recovery checkpoint) mid-epoch, then restarted as a fresh process on the TO
+topology with `--resume auto --elastic`. The planner rebuilds the mesh from
+the live device count, holds the global batch constant, and the resumed run's
+final params/optimizer state must match an uninterrupted run to ≤1e-6.
+
+The drill pins each child's topology via XLA_FLAGS
+(--xla_force_host_platform_device_count), so these tests spawn grandchildren
+and are the slowest resilience drills — but they are the acceptance criteria,
+so they stay in tier-1.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.elastic
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _drill(mode, workdir):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, 'tests', 'fsdp_drill.py'),
+         mode, str(workdir)],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT, timeout=900)
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-3000:])
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_elastic_shrink_8_to_4(tmp_path):
+    out = _drill('elastic8to4', tmp_path)
+    assert out['saved_global_batch'] == 8  # geometry recorded by the dead run
+    assert out['max_param_diff'] <= 1e-6, out
+    assert out['recovery_pruned'], out  # end-of-epoch save reaped the recovery file
+
+
+def test_elastic_grow_4_to_8(tmp_path):
+    out = _drill('elastic4to8', tmp_path)
+    assert out['saved_global_batch'] == 8
+    assert out['max_param_diff'] <= 1e-6, out
+    assert out['recovery_pruned'], out

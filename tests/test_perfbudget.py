@@ -191,6 +191,18 @@ def test_injected_blockscan_regression_trips_budgets(seed_budgets):
     assert tripped == {'jaxpr_eqns', 'trace_ms'}, format_violations(violations)
 
 
+def test_elastic_resize_probe_within_budgets(seed_budgets):
+    """PR-13 acceptance: the re-placed-after-resize train step stays legal —
+    state saved on the 8-device (2,4) mesh re-places sharded on the 4-device
+    mesh, the rescale solver holds the global batch, and donation survives
+    the resize (all pinned in perf_budgets.json as exact bools/counts)."""
+    measured = run_matrix(names=['elastic_resize'])
+    violations = compare_budgets(measured, seed_budgets, configs=['elastic_resize'])
+    assert not violations, format_violations(violations)
+    assert measured['elastic_resize']['elastic_resharding_ok'] is True
+    assert measured['elastic_resize']['donation_ok'] is True
+
+
 def test_run_matrix_rejects_unknown_config():
     with pytest.raises(ValueError, match='unknown'):
         run_matrix(names=['no_such_config'])

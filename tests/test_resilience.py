@@ -1,19 +1,23 @@
 """Fault-tolerance subsystem tests (timm_tpu/resilience): durable checkpoint
 verification + fallback, recovery ordering, non-finite sentinel, reader
-retry/skip policy, fault injection, and the SIGTERM→`--resume auto` parity
-drill on a tiny CPU model."""
+retry/skip policy, fault injection, elastic rescale planning, the async
+checkpoint writer, and the SIGTERM→`--resume auto` parity drill on a tiny
+CPU model."""
 import os
 import subprocess
 import sys
+import threading
 
 import numpy as np
 import pytest
 
 from timm_tpu.resilience import (
-    CorruptCheckpointError, FaultInjector, NonFiniteError, SkipBudget,
-    TooManyBadSamples, atomic_write_npz, backoff_delays, capture_host_rng,
-    fault_selftest, find_checkpoints, load_with_fallback, resolve_auto_resume,
-    restore_host_rng, retry_io, verify_checkpoint,
+    AsyncCheckpointWriter, CorruptCheckpointError, FaultInjector, GracefulShutdown,
+    NonFiniteError, SkipBudget, TooManyBadSamples, atomic_write_npz, backoff_delays,
+    capture_host_rng, convert_loader_position, fault_selftest, find_checkpoints,
+    load_with_fallback, plan_elastic_resume, rescale_for_devices, resolve_auto_resume,
+    restore_host_rng, retry_io, set_durable_write_listener, set_fault_injector,
+    snapshot_to_host, verify_checkpoint,
 )
 
 pytestmark = pytest.mark.resilience
@@ -267,9 +271,299 @@ def test_bench_dry_run_fault_inject_smoke():
         softmax_dtype = ''
         norm_dtype = ''
         mu_dtype = ''
-        fault_inject = 'truncate_ckpt,io_error%2,nan_grads@1:2,sigterm@3'
+        fault_inject = 'truncate_ckpt,io_error%2,nan_grads@1:2,sigterm@3,resize@5:4'
 
     assert bench._dry_run(Args()) == 0
+
+
+def test_resize_fault_spec():
+    fi = FaultInjector('resize@4:2')
+    assert fi.resize_devices == 2
+    assert not fi.resize_at(3) and fi.resize_at(4) and not fi.resize_at(4)  # fires once
+    with pytest.raises(ValueError, match='resize fault needs a device count'):
+        FaultInjector('resize@4')  # the :D suffix is mandatory
+
+
+# -- elastic rescale planning --------------------------------------------------
+
+def test_rescale_holds_global_batch_constant():
+    # 8->4 devices, global batch 256: keep the loader batch if it still shards
+    assert rescale_for_devices(256, 4, prefer_batch_size=32) == (32, 8)
+    # loader batch no longer divisible -> nearest shardable batch wins
+    # (ties break toward the smaller batch: 8 and 16 are both 4 away from 12)
+    assert rescale_for_devices(256, 8, prefer_batch_size=12) == (8, 32)
+    assert rescale_for_devices(256, 8, prefer_batch_size=13) == (16, 16)
+    # exact fit, no accum
+    assert rescale_for_devices(64, 8, prefer_batch_size=64) == (64, 1)
+    for g, n in ((256, 4), (96, 6), (512, 8)):
+        bs, accum = rescale_for_devices(g, n)
+        assert bs * accum == g and bs % n == 0
+
+
+def test_rescale_refuses_with_nearest_legal_suggestion():
+    # 100 is not a multiple of 8: no loader batch can shard evenly
+    with pytest.raises(ValueError) as ei:
+        rescale_for_devices(100, 8)
+    msg = str(ei.value)
+    assert 'Nearest legal global batch: 96 or 104' in msg
+    assert 'multiples of the mesh batch-shard count 8' in msg
+    # the accum cap shapes the solution: a tiny preferred batch is pushed up
+    # to the smallest batch whose accum still fits the cap
+    assert rescale_for_devices(1024, 2, prefer_batch_size=2, max_accum=4) == (256, 4)
+
+
+def test_convert_loader_position():
+    assert convert_loader_position(10, 32, 32) == (10, True)
+    assert convert_loader_position(10, 32, 16) == (20, True)   # samples invariant
+    assert convert_loader_position(5, 24, 16) == (7, False)    # 120 samples, inexact
+    with pytest.raises(ValueError):
+        convert_loader_position(1, 0, 16)
+
+
+def test_plan_elastic_resume_from_checkpoint(tmp_path):
+    # the dead run: 8 devices, batch 32 x accum 8 = global 256
+    ckpt = str(tmp_path / 'recovery-0-3.npz')
+    atomic_write_npz(ckpt, {
+        'state_dict.w': np.zeros(4),
+        '_resume.batch_size': np.asarray(32),
+        '_resume.global_batch': np.asarray(256),
+        '_resume.device_count': np.asarray(8),
+    }, meta={'epoch': 0})
+    # restart on 4 devices with the same flags: global batch held at 256
+    plan = plan_elastic_resume(devices=4, batch_size=32, grad_accum=8,
+                               fsdp=8, resume=ckpt)
+    assert plan.global_batch == 256 and plan.batch_size * plan.grad_accum == 256
+    assert plan.batch_size % 4 == 0
+    assert plan.fsdp == 4  # clamped to what divides the live topology
+    assert plan.source == ckpt
+    assert any('clamped' in n for n in plan.notes)
+    # fresh start (no resume): plan only validates the fresh configuration
+    fresh = plan_elastic_resume(devices=4, batch_size=32, grad_accum=1)
+    assert (fresh.batch_size, fresh.grad_accum, fresh.source) == (32, 1, '')
+
+
+def test_resolve_elastic_axes_clamps_to_divisors():
+    from timm_tpu.parallel import create_mesh, resolve_elastic_axes
+    assert resolve_elastic_axes(8, fsdp=4) == (4, None)
+    assert resolve_elastic_axes(4, fsdp=8) == (4, None)     # clamp down
+    assert resolve_elastic_axes(6, fsdp=4) == (3, None)     # largest divisor <= 4
+    assert resolve_elastic_axes(8, fsdp=4, tp=4) == (2, 4)  # tp wins the factor
+    assert resolve_elastic_axes(5, fsdp=4, tp=2) == (None, None)  # prime: no axes
+    # the contract: create_mesh always accepts the clamped result
+    import jax
+    devs = jax.devices()
+    for n in (1, 2, 4, 8):
+        fsdp, tp = resolve_elastic_axes(n, fsdp=4, tp=2)
+        create_mesh(devices=devs[:n], fsdp=fsdp, tp=tp)
+
+
+# -- async checkpoint writer ---------------------------------------------------
+
+def test_async_writer_supersede_and_ordering():
+    w = AsyncCheckpointWriter()
+    started, release = threading.Event(), threading.Event()
+    ran = []
+
+    def blocker():
+        started.set()
+        release.wait(10)
+        ran.append('first')
+
+    try:
+        w.submit(blocker, label='first', key='recovery')
+        assert started.wait(10)
+        w.submit(lambda: ran.append('stale'), label='stale', key='recovery')
+        w.submit(lambda: ran.append('ckpt'), label='ckpt', key='checkpoint')
+        w.submit(lambda: ran.append('newest'), label='newest', key='recovery')
+        assert w.superseded == 1  # 'stale' replaced before it ever ran
+        release.set()
+        w.drain()
+    finally:
+        release.set()
+        w.close()
+    # supersede re-queues at the tail; distinct keys keep submission order
+    assert ran == ['first', 'ckpt', 'newest']
+
+
+def test_async_writer_drain_ordering_and_error_propagation():
+    w = AsyncCheckpointWriter()
+    ran = []
+    for i in range(3):
+        w.submit(lambda i=i: ran.append(i), label=f'op-{i}', key=f'k{i}')
+    w.drain()
+    assert ran == [0, 1, 2]
+    # a persistent (non-transient) failure re-raises on the caller thread
+    w.submit(lambda: (_ for _ in ()).throw(ValueError('disk gone')), key='bad')
+    with pytest.raises(ValueError, match='disk gone'):
+        w.drain()
+    w.close()
+    with pytest.raises(RuntimeError, match='closed'):
+        w.submit(lambda: None)
+
+
+def test_async_writer_retries_transient_io_error():
+    """io_error%M must exercise the ASYNC durable path: the injected OSError
+    fires inside the retried closure and the backoff rides through it."""
+    set_fault_injector('io_error%2')
+    try:
+        w = AsyncCheckpointWriter(base_delay=0.0)
+        ran = []
+        for i in range(4):  # every 2nd closure attempt hits the injected fault
+            w.submit(lambda i=i: ran.append(i), label=f'op-{i}', key=f'k{i}')
+        w.close()
+        assert ran == [0, 1, 2, 3]
+    finally:
+        set_fault_injector('')
+
+
+def test_async_save_keeps_durable_writes_off_step_thread(tmp_path, mesh8):
+    """The instrumentation hook the acceptance criteria name: every durable
+    write of an async save runs on the writer thread, never the step thread —
+    and the npz bytes + SHA-256 manifest are byte-identical to a sync save."""
+    import jax.numpy as jnp
+    from timm_tpu.resilience.durable import read_manifest
+
+    state = {'state_dict.w': jnp.arange(64.0).reshape(8, 8),
+             'epoch': np.asarray(0)}
+    sync_path = str(tmp_path / 'sync.npz')
+    async_path = str(tmp_path / 'async.npz')
+    atomic_write_npz(sync_path, state, meta={'epoch': 0})
+
+    writes = []
+    prev = set_durable_write_listener(lambda path, thread: writes.append((path, thread.name)))
+    try:
+        w = AsyncCheckpointWriter()
+        host = snapshot_to_host(state)  # step-thread half: gather only, no I/O
+        w.submit(lambda: atomic_write_npz(async_path, host, meta={'epoch': 0}),
+                 key='ckpt')
+        w.close()
+    finally:
+        set_durable_write_listener(prev)
+    assert writes and all(t == AsyncCheckpointWriter.THREAD_NAME for _p, t in writes), writes
+
+    msync, masync = read_manifest(sync_path), read_manifest(async_path)
+    assert {k: v['sha256'] for k, v in msync['arrays'].items()} == \
+           {k: v['sha256'] for k, v in masync['arrays'].items()}
+    assert open(sync_path, 'rb').read() == open(async_path, 'rb').read()
+
+
+def test_saver_async_matches_sync_save(tmp_path, mesh8):
+    """CheckpointSaver in async mode: save_recovery/save_checkpoint produce
+    byte-identical npz + manifests to sync mode, all durable writes stay on
+    the writer thread, and no staging litter survives."""
+    import jax.numpy as jnp
+    from timm_tpu.utils import CheckpointSaver
+
+    class _Task:
+        def get_checkpoint_state(self):
+            return {'state_dict.w': jnp.full((4, 4), 7.0),
+                    'optimizer.m': jnp.zeros(4)}
+
+    def run(d, writer):
+        saver = CheckpointSaver(task=_Task(), checkpoint_dir=d, recovery_dir=d,
+                                async_writer=writer)
+        saver.save_recovery(0, 3, extra_state={'_resume.num_updates': np.asarray(3)})
+        saver.save_checkpoint(0, metric=1.0)
+        if writer is not None:
+            writer.close()
+        return saver
+
+    d_sync, d_async = str(tmp_path / 'sync'), str(tmp_path / 'async')
+    os.makedirs(d_sync), os.makedirs(d_async)
+    run(d_sync, None)
+    writes = []
+    prev = set_durable_write_listener(lambda path, thread: writes.append(thread.name))
+    try:
+        run(d_async, AsyncCheckpointWriter())
+    finally:
+        set_durable_write_listener(prev)
+    assert writes and set(writes) == {AsyncCheckpointWriter.THREAD_NAME}
+
+    sync_names = sorted(os.listdir(d_sync))
+    assert sorted(os.listdir(d_async)) == sync_names  # incl. NO .async-stage-* dir
+    for name in sync_names:
+        a, b = os.path.join(d_sync, name), os.path.join(d_async, name)
+        if name.endswith('.npz'):
+            assert open(a, 'rb').read() == open(b, 'rb').read(), name
+
+
+def test_saver_sweeps_orphaned_async_staging_dir(tmp_path):
+    """Regression: a writer killed mid-write leaves `.async-stage-<pid>/` with
+    temp litter; the next process's startup sweep must reap it wholesale."""
+    from timm_tpu.utils import CheckpointSaver
+    d = str(tmp_path)
+    stage = os.path.join(d, '.async-stage-99999')  # "killed" writer's pid
+    os.makedirs(stage)
+    open(os.path.join(stage, '.last.npz.123.tmp'), 'wb').write(b'partial')
+    atomic_write_npz(os.path.join(d, 'last.npz'), {'w': np.ones(4)}, meta={'epoch': 0})
+    CheckpointSaver(task=None, checkpoint_dir=d, recovery_dir=d)
+    assert not os.path.exists(stage)
+    ok, reason = verify_checkpoint(os.path.join(d, 'last.npz'))
+    assert ok, reason  # the sweep never touches committed checkpoints
+
+
+def test_saver_async_staging_dir_killed_writer_subprocess(tmp_path):
+    """End-to-end injected kill: a child process starts an async save and is
+    SIGKILLed while the writer holds the temp file open; the parent's startup
+    sweep reaps the orphaned staging dir."""
+    import signal
+    child = f'''
+import os, sys, threading, numpy as np
+sys.path.insert(0, {repr(REPO_ROOT)})
+import jax; jax.config.update('jax_platforms', 'cpu')
+from timm_tpu.resilience import AsyncCheckpointWriter
+from timm_tpu.utils import CheckpointSaver
+
+class T:
+    def get_checkpoint_state(self):
+        return {{'state_dict.w': np.zeros((256, 256), np.float32)}}
+
+d = {repr(str(tmp_path))}
+hold = threading.Event()
+w = AsyncCheckpointWriter()
+saver = CheckpointSaver(task=T(), checkpoint_dir=d, recovery_dir=d, async_writer=w)
+# wedge the writer AFTER the staging dir exists so the kill lands mid-flight
+w.submit(lambda: hold.wait(30), key='wedge')
+saver.save_recovery(0, 1, extra_state={{'_resume.num_updates': np.asarray(1)}})
+open(os.path.join(d, 'ready'), 'w').write('1')
+hold.clear()
+import time; time.sleep(30)
+'''
+    proc = subprocess.Popen([sys.executable, '-c', child],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        for _ in range(600):
+            if os.path.exists(tmp_path / 'ready'):
+                break
+            import time
+            time.sleep(0.05)
+        else:
+            raise AssertionError(proc.stderr.read().decode()[-2000:])
+        proc.send_signal(signal.SIGKILL)
+    finally:
+        proc.wait(timeout=30)
+    stages = [n for n in os.listdir(tmp_path) if n.startswith('.async-stage-')]
+    assert stages  # the kill really orphaned a staging dir
+    from timm_tpu.utils import CheckpointSaver
+    CheckpointSaver(task=None, checkpoint_dir=str(tmp_path), recovery_dir=str(tmp_path))
+    assert not [n for n in os.listdir(tmp_path) if n.startswith('.async-stage-')]
+
+
+# -- graceful shutdown install/uninstall ---------------------------------------
+
+def test_graceful_shutdown_install_idempotent_and_finally_safe():
+    import signal
+    before = {s: signal.getsignal(s) for s in (signal.SIGTERM, signal.SIGINT)}
+    sd = GracefulShutdown()
+    assert sd.install() is sd
+    assert sd.install() is sd  # second install: no-op, does NOT record itself
+    try:
+        assert signal.getsignal(signal.SIGTERM) is not before[signal.SIGTERM]
+    finally:
+        sd.uninstall()
+    for s, h in before.items():
+        assert signal.getsignal(s) is h, f'handler for {s} not restored'
+    sd.uninstall()  # idempotent: already uninstalled is a no-op
 
 
 # -- host RNG capture ---------------------------------------------------------

@@ -1,6 +1,6 @@
 from .mesh import (
     batch_axes, create_mesh, data_sharding, get_global_mesh, nonmodel_batch_axes, peek_global_mesh,
-    replicate_sharding, set_global_mesh, shard_batch,
+    replicate_sharding, resolve_elastic_axes, set_global_mesh, shard_batch,
 )
 from .distributed import (
     all_hosts_flag, init_distributed_device, is_distributed_env, is_primary, reduce_tensor,

@@ -35,7 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     'create_mesh', 'data_sharding', 'replicate_sharding', 'shard_batch',
     'get_global_mesh', 'set_global_mesh', 'peek_global_mesh', 'batch_axes',
-    'nonmodel_batch_axes',
+    'nonmodel_batch_axes', 'resolve_elastic_axes',
 ]
 
 _GLOBAL_MESH: Optional[Mesh] = None
@@ -107,6 +107,36 @@ def create_mesh(
         dev_array = np.array(devices).reshape(num_slices, -1)
         return Mesh(dev_array, ('dcn', data_axis))
     return Mesh(np.array(devices), (data_axis,))
+
+
+def resolve_elastic_axes(
+        n_devices: int,
+        fsdp: Optional[int] = None,
+        tp: Optional[int] = None,
+        num_slices: int = 1,
+) -> Tuple[Optional[int], Optional[int]]:
+    """Clamp requested fsdp/tp axis sizes to the LIVE topology.
+
+    An elastic restart reuses the dead run's ``--fsdp``/``--tp`` flags, but
+    the surviving device count may no longer divide the same way. Each
+    request is clamped to the largest divisor of the available per-slice
+    device count not exceeding it — tp first (innermost, most
+    collective-hungry axis), then fsdp within the remaining factor — so
+    ``create_mesh(fsdp=..., tp=...)`` is guaranteed to accept the result.
+    Returns ``(fsdp, tp)`` with None where the axis should be omitted,
+    matching create_mesh's treatment of ``fsdp=1``/``tp=1``.
+    """
+    per_slice = max(1, int(n_devices) // max(1, int(num_slices)))
+
+    def largest_divisor(request: int, limit: int) -> int:
+        d = min(int(request), limit)
+        while limit % d:
+            d -= 1
+        return d
+
+    tp_eff = largest_divisor(tp, per_slice) if tp and int(tp) > 1 else 1
+    fsdp_eff = largest_divisor(fsdp, per_slice // tp_eff) if fsdp and int(fsdp) > 1 else 1
+    return (fsdp_eff if fsdp_eff > 1 else None, tp_eff if tp_eff > 1 else None)
 
 
 def set_global_mesh(mesh: Mesh):

@@ -89,6 +89,13 @@ TOLERANCES: Dict[str, tuple] = {
     # verdict rests on. `kernels_registered` pins the portfolio size (band
     # with zero tolerance = exact count) so a dropped registration cannot
     # pass silently.
+    # elastic resize probe (resilience/elastic.py): pure legality — the
+    # re-placed-after-resize state must land sharded on the new mesh and the
+    # rescale solver must hold the global batch; device counts pinned exactly
+    'elastic_resharding_ok': ('bool', 0.0),
+    'elastic_global_batch_ok': ('bool', 0.0),
+    'elastic_devices_from': ('band', 0.0),
+    'elastic_devices_to': ('band', 0.0),
     'kernels_registered': ('band', 0.0),
     'fused_adamw_eqns': ('band', 0.10),
     'fused_adamw_ref_eqns': ('band', 0.10),

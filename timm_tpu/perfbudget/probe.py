@@ -57,7 +57,7 @@ class ProbeConfig:
     block_scan: Optional[bool] = None     # None = model default
     grad_accum: int = 1
     opt: str = 'adamw'
-    collect: str = 'full'   # 'trace' | 'full' | 'fwd' | 'serve' | 'quant' | 'augment' | 'naflex' | 'kernels'
+    collect: str = 'full'   # 'trace' | 'full' | 'fwd' | 'serve' | 'quant' | 'augment' | 'naflex' | 'kernels' | 'elastic'
     buckets: Tuple[int, ...] = (2, 4)     # serve only
     seq_len: int = 25                     # naflex packed probe only
     fused_update: bool = False            # route the step through fused_adamw
@@ -127,6 +127,13 @@ DEFAULT_MATRIX: Tuple[ProbeConfig, ...] = (
     ProbeConfig(name='fused_update', model='test_vit',
                 model_kwargs=(('num_classes', 10), ('img_size', 32)),
                 batch_size=8, collect='full', fused_update=True),
+    # elastic resize: state saved on an 8-device (2,4) mesh re-places on the
+    # 4-device post-resize mesh (fsdp clamped by resolve_elastic_axes), the
+    # rescale solver holds the global batch, and the RE-PLACED train step
+    # still lowers with donation intact (resilience/elastic.py)
+    ProbeConfig(name='elastic_resize', model='test_vit',
+                model_kwargs=(('num_classes', 10), ('img_size', 32)),
+                batch_size=8, fsdp=4, collect='elastic'),
 )
 
 
@@ -584,6 +591,87 @@ def _probe_quant(cfg: ProbeConfig) -> Dict:
     return metrics
 
 
+def _probe_elastic(cfg: ProbeConfig) -> Dict:
+    """Elastic-resize legality (resilience/elastic.py): checkpoint state
+    captured under the pre-resize mesh (all devices, fsdp=cfg.fsdp) re-places
+    under the post-resize half-pod mesh with the fsdp axis clamped the way
+    ``plan_elastic_resume`` would clamp it, and the re-placed task's train
+    step still lowers with its state donation aliased.
+
+      * ``elastic_resharding_ok``   — every re-placed param landed on the NEW
+        mesh with at least one leaf actually sharded over 'fsdp', and the
+        values round-tripped bit-exactly through the host snapshot;
+      * ``elastic_global_batch_ok`` — the rescale solver returns a
+        (batch, accum) pair that preserves the global batch and shards evenly
+        on the post-resize mesh;
+      * ``donation_aliases`` / ``donation_ok`` — the usual HLO alias-table
+        evidence, for the step compiled AFTER the resize re-placement.
+
+    No trace_ms: this probe pins legality, not trace cost."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    import timm_tpu
+    from ..loss import LabelSmoothingCrossEntropy
+    from ..optim import create_optimizer_v2
+    from ..parallel import create_mesh, resolve_elastic_axes, set_global_mesh, shard_batch
+    from ..resilience import rescale_for_devices, snapshot_to_host
+    from ..task import ClassificationTask
+
+    def build(mesh):
+        model = timm_tpu.create_model(cfg.model, **cfg.kwargs())
+        return ClassificationTask(
+            model, optimizer=create_optimizer_v2(model, opt=cfg.opt, lr=0.1),
+            mesh=mesh, train_loss_fn=LabelSmoothingCrossEntropy(0.1))
+
+    # pre-resize: the dead run's full-pod mesh
+    mesh_from = create_mesh(fsdp=cfg.fsdp)
+    set_global_mesh(mesh_from)
+    state = snapshot_to_host(build(mesh_from).get_checkpoint_state())
+
+    # post-resize: half the devices survive; clamp the axes as the planner does
+    devices = jax.devices()
+    n_to = max(1, len(devices) // 2)
+    fsdp_to, tp_to = resolve_elastic_axes(n_to, fsdp=cfg.fsdp, tp=cfg.tp)
+    mesh_to = create_mesh(devices=devices[:n_to], fsdp=fsdp_to, tp=tp_to)
+    set_global_mesh(mesh_to)
+    task_to = build(mesh_to)
+    task_to.load_checkpoint_state(state)
+
+    metrics: Dict = {'elastic_devices_from': len(devices), 'elastic_devices_to': n_to}
+    params = nnx.state(task_to.model, nnx.Param)
+    on_new_mesh, fsdp_sharded = True, False
+    for leaf in jax.tree.leaves(params):
+        sharding = getattr(getattr(leaf, 'value', leaf), 'sharding', None)
+        on_new_mesh = on_new_mesh and getattr(sharding, 'mesh', None) == mesh_to
+        fsdp_sharded = fsdp_sharded or 'fsdp' in tuple(getattr(sharding, 'spec', ()) or ())
+    # bit-exact round trip through the host snapshot for one witness leaf
+    key = next(k for k in state if k.startswith('state_dict.'))
+    reloaded = snapshot_to_host(task_to.get_checkpoint_state())
+    values_ok = np.array_equal(state[key], reloaded[key])
+    metrics['elastic_resharding_ok'] = bool(on_new_mesh and fsdp_sharded and values_ok)
+
+    global_batch = cfg.batch_size * cfg.grad_accum
+    bs, accum = rescale_for_devices(global_batch, mesh_to.size,
+                                    prefer_batch_size=cfg.batch_size)
+    metrics['elastic_global_batch_ok'] = bool(
+        bs * accum == global_batch and bs % mesh_to.size == 0)
+
+    rng = np.random.RandomState(0)
+    s = int(cfg.kwargs().get('img_size', 224))
+    num_classes = int(cfg.kwargs().get('num_classes', 1000))
+    batch = shard_batch({'input': jnp.asarray(rng.rand(bs, s, s, 3), jnp.float32),
+                         'target': jnp.asarray(rng.randint(0, num_classes, bs))},
+                        mesh_to)
+    compiled = task_to.lower_train_step(batch, lr=0.1)
+    ev = donation_evidence(compiled)
+    metrics['donation_aliases'] = ev['aliases']
+    metrics['donation_ok'] = ev['aliases'] > 0
+    return metrics
+
+
 def _probe_kernels(cfg: ProbeConfig) -> Dict:
     """Per-kernel lowering A/B over the registry (kernels/harness.py): one
     budget anchor per kernel (its first declared regime case, dry arm).
@@ -615,6 +703,8 @@ def probe_config(cfg: ProbeConfig) -> Dict:
             return _probe_naflex(cfg)
         if cfg.collect == 'kernels':
             return _probe_kernels(cfg)
+        if cfg.collect == 'elastic':
+            return _probe_elastic(cfg)
         return _probe_train(cfg)
     finally:
         mesh_mod._GLOBAL_MESH = saved

@@ -10,10 +10,15 @@ from .durable import (
     SCHEMA_VERSION, CorruptCheckpointError, atomic_copy, atomic_write_bytes,
     atomic_write_json, atomic_write_npz, checkpoint_progress_key, find_checkpoints,
     load_verified, load_with_fallback, manifest_path, read_manifest,
-    resolve_auto_resume, verify_checkpoint,
+    resolve_auto_resume, set_durable_write_listener, snapshot_to_host,
+    verify_checkpoint,
+)
+from .elastic import (
+    AsyncCheckpointWriter, ElasticPlan, convert_loader_position,
+    plan_elastic_resume, rescale_for_devices,
 )
 from .faultinject import FaultInjector, fault_selftest, get_fault_injector, set_fault_injector
-from .hoststate import capture_host_rng, restore_host_rng
+from .hoststate import RESUME_PREFIX, capture_host_rng, restore_host_rng
 from .preemption import GracefulShutdown, TrainingPreempted
 from .retry import (
     DEFAULT_POISON_BUDGET, SkipBudget, TooManyBadSamples, backoff_delays, retry_io,

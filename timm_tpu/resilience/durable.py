@@ -19,6 +19,7 @@ import logging
 import os
 import re
 import tempfile
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -30,7 +31,7 @@ __all__ = [
     'atomic_write_bytes', 'atomic_write_json', 'atomic_write_npz', 'atomic_copy',
     'manifest_path', 'read_manifest', 'verify_checkpoint', 'load_verified',
     'find_checkpoints', 'load_with_fallback', 'resolve_auto_resume',
-    'checkpoint_progress_key',
+    'checkpoint_progress_key', 'set_durable_write_listener', 'snapshot_to_host',
 ]
 
 SCHEMA_VERSION = 1
@@ -53,10 +54,35 @@ def _fsync_dir(path: str):
         os.close(fd)
 
 
-def atomic_write_bytes(path: str, data: bytes):
-    """tmp → fsync → os.replace; the final path is never partially written."""
+_write_listener = None
+
+
+def set_durable_write_listener(fn):
+    """Test instrumentation: `fn(path, thread)` runs at the start of every
+    durable write (atomic_write_bytes / atomic_write_npz) with the thread the
+    write executes on — how tier-1 asserts that async checkpointing keeps
+    fsync off the step-loop thread. Returns the previous listener; pass None
+    to clear."""
+    global _write_listener
+    prev, _write_listener = _write_listener, fn
+    return prev
+
+
+def _notify_write(path: str):
+    if _write_listener is not None:
+        _write_listener(path, threading.current_thread())
+
+
+def atomic_write_bytes(path: str, data: bytes, tmp_dir: Optional[str] = None):
+    """tmp → fsync → os.replace; the final path is never partially written.
+
+    `tmp_dir` (must be on the destination's filesystem — e.g. a staging
+    subdirectory) confines the temp file so a writer killed mid-flight leaves
+    its litter where a startup sweep can reap it wholesale."""
+    _notify_write(path)
     d = os.path.dirname(os.path.abspath(path))
-    fd, tmp = tempfile.mkstemp(prefix='.' + os.path.basename(path) + '.', suffix='.tmp', dir=d)
+    fd, tmp = tempfile.mkstemp(prefix='.' + os.path.basename(path) + '.', suffix='.tmp',
+                               dir=tmp_dir or d)
     try:
         with os.fdopen(fd, 'wb') as f:
             f.write(data)
@@ -72,8 +98,8 @@ def atomic_write_bytes(path: str, data: bytes):
         raise
 
 
-def atomic_write_json(path: str, obj):
-    atomic_write_bytes(path, json.dumps(obj, indent=1, default=str).encode())
+def atomic_write_json(path: str, obj, tmp_dir: Optional[str] = None):
+    atomic_write_bytes(path, json.dumps(obj, indent=1, default=str).encode(), tmp_dir=tmp_dir)
 
 
 def manifest_path(path: str) -> str:
@@ -94,6 +120,18 @@ def _gather_to_host(v) -> np.ndarray:
     return np.asarray(v)
 
 
+def snapshot_to_host(arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Device→host snapshot of a checkpoint state dict — the cheap, bounded
+    half of an async write, run on the step thread at submit time.
+
+    Mandatory before handing state to a background writer: the next train
+    step DELETES donated input buffers, so live jax.Arrays must be gathered
+    now. The result is plain numpy, making atomic_write_npz's own gather a
+    no-op — which is why async npz bytes and SHA-256 manifests stay
+    byte-identical to a synchronous save of the same state."""
+    return {k: _gather_to_host(v) for k, v in arrays.items()}
+
+
 def _array_digest(arr: np.ndarray) -> str:
     arr = np.ascontiguousarray(arr)
     h = hashlib.sha256()
@@ -103,18 +141,22 @@ def _array_digest(arr: np.ndarray) -> str:
     return h.hexdigest()
 
 
-def atomic_write_npz(path: str, arrays: Dict[str, np.ndarray], meta: Optional[dict] = None) -> str:
+def atomic_write_npz(path: str, arrays: Dict[str, np.ndarray], meta: Optional[dict] = None,
+                     tmp_dir: Optional[str] = None) -> str:
     """Durably write `arrays` as an .npz at `path` with a sidecar manifest.
 
     Write order: data file committed first (tmp+fsync+replace), manifest
     second — the manifest's presence with matching hashes is what marks the
-    checkpoint complete. Returns the manifest path.
+    checkpoint complete. Returns the manifest path. `tmp_dir` stages the temp
+    file as in atomic_write_bytes.
     """
     from .faultinject import get_fault_injector
 
+    _notify_write(path)
     arrays = {k: _gather_to_host(v) for k, v in arrays.items()}
     d = os.path.dirname(os.path.abspath(path))
-    fd, tmp = tempfile.mkstemp(prefix='.' + os.path.basename(path) + '.', suffix='.tmp', dir=d)
+    fd, tmp = tempfile.mkstemp(prefix='.' + os.path.basename(path) + '.', suffix='.tmp',
+                               dir=tmp_dir or d)
     try:
         with os.fdopen(fd, 'wb') as f:
             np.savez(f, **arrays)
@@ -145,7 +187,7 @@ def atomic_write_npz(path: str, arrays: Dict[str, np.ndarray], meta: Optional[di
         'meta': dict(meta or {}),
     }
     mpath = manifest_path(path)
-    atomic_write_json(mpath, manifest)
+    atomic_write_json(mpath, manifest, tmp_dir=tmp_dir)
     return mpath
 
 

@@ -1,0 +1,320 @@
+"""Elastic pod-scale training: resume on a changed topology, never block a step on fsync.
+
+Two halves, composed by train.py:
+
+1. Elastic resume (:func:`plan_elastic_resume`): a preempted run restarts with
+   whatever devices survived.  The plan rebuilds the mesh axes from the live
+   topology (clamping the dead run's ``--fsdp``/``--tp`` to what still divides
+   the surviving device count), re-reads the interrupted run's global batch
+   from its recovery state, and re-solves
+   ``per_device_batch x devices x accum`` so the global batch stays invariant
+   — refusing loudly, with the nearest legal global batch, when no integer
+   solution exists (the same contract ``shard_batch`` already enforces).
+
+2. Async checkpointing (:class:`AsyncCheckpointWriter`): every durable write
+   splits into snapshot-to-host (a cheap device->host gather on the step
+   thread; see ``durable.snapshot_to_host``) and the existing
+   tmp->fsync->os.replace->SHA-256-manifest pipeline, replayed unchanged on a
+   single background writer thread.  At most one write is in flight; a newer
+   snapshot supersedes a queued one of the same kind; transient ``OSError``s
+   ride the ``retry.retry_io`` backoff; the first persistent failure is
+   re-raised on the step thread (fail loudly, never silently drop a
+   checkpoint); SIGTERM paths drain the writer before exit so the recovery
+   guarantees of the synchronous path are unchanged byte for byte.
+"""
+import dataclasses
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from .faultinject import get_fault_injector
+from .retry import retry_io
+
+__all__ = [
+    'AsyncCheckpointWriter',
+    'ElasticPlan',
+    'convert_loader_position',
+    'plan_elastic_resume',
+    'rescale_for_devices',
+]
+
+
+# ---------------------------------------------------------------------------
+# batch/accum rescale solver
+# ---------------------------------------------------------------------------
+
+def rescale_for_devices(global_batch, n_shards, prefer_batch_size=None,
+                        max_accum=64):
+    """Solve (loader batch size, grad accum) holding the global batch constant.
+
+    ``global_batch = batch_size * accum`` must survive a device-count change,
+    and every loader batch must still shard evenly over the mesh
+    (``batch_size % n_shards == 0``, the ``shard_batch`` divisibility rule).
+    Returns ``(batch_size, accum)`` with ``accum <= max_accum``, preferring a
+    batch size closest to ``prefer_batch_size`` (keeping the loader batch size
+    unchanged preserves bit-deterministic data-order on resume).
+
+    Raises ValueError — loudly, with the nearest legal global batch, exactly
+    like ``shard_batch`` does — when no integer solution exists.
+    """
+    g, n = int(global_batch), int(n_shards)
+    if g <= 0:
+        raise ValueError(f'global_batch must be positive, got {global_batch}')
+    if n <= 0:
+        raise ValueError(f'n_shards must be positive, got {n_shards}')
+    candidates = [b for b in range(n, g + 1, n)
+                  if g % b == 0 and g // b <= max_accum]
+    if not candidates:
+        lo, hi = (g // n) * n, -(-g // n) * n
+        nearest = str(hi) if lo <= 0 or lo == hi else f'{lo} or {hi}'
+        raise ValueError(
+            f'Global batch {g} cannot be held constant on a mesh with '
+            f'{n} batch shards: no loader batch size b satisfies '
+            f'b % {n} == 0, {g} % b == 0 and {g} // b <= {max_accum} '
+            f'(grad-accum cap). Nearest legal global batch: {nearest} '
+            f'(multiples of the mesh batch-shard count {n}).')
+    prefer = int(prefer_batch_size) if prefer_batch_size else g
+    batch_size = min(candidates, key=lambda b: (abs(b - prefer), b))
+    return batch_size, g // batch_size
+
+
+def convert_loader_position(batches_consumed, old_batch_size, new_batch_size):
+    """Convert a mid-epoch loader position across a batch-size change.
+
+    Positions are stored as loader batches consumed; the invariant unit is
+    samples.  Rounds down (re-seeing a partial batch beats skipping samples).
+    Returns ``(new_batches_consumed, exact)`` where ``exact`` is False when
+    the sample count did not divide evenly — bit-determinism of the resumed
+    data order is only guaranteed when the loader batch size is unchanged.
+    """
+    old_bs, new_bs = int(old_batch_size), int(new_batch_size)
+    if old_bs <= 0 or new_bs <= 0:
+        raise ValueError('batch sizes must be positive')
+    samples = int(batches_consumed) * old_bs
+    return samples // new_bs, samples % new_bs == 0
+
+
+# ---------------------------------------------------------------------------
+# elastic resume planning
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ElasticPlan:
+    """Everything train.py must override before building mesh/loaders."""
+    devices: int
+    fsdp: int | None       # clamped to the live topology (None = unrequested)
+    tp: int | None
+    batch_size: int        # loader batch size (per optimizer micro-step)
+    grad_accum: int
+    global_batch: int      # the invariant: batch_size * grad_accum
+    source: str            # checkpoint the global batch was recovered from
+    notes: tuple = ()      # human-readable decisions, for the resume log
+
+
+def _checkpoint_global_batch(path):
+    """Recover (global_batch, batch_size) recorded by the interrupted run.
+
+    Prefers the ``_resume.*`` arrays inside the recovery npz; falls back to
+    the args json sidecar written next to every checkpoint (older recovery
+    files predate the ``_resume.global_batch`` key).  Returns (None, None)
+    when neither source exists.
+    """
+    try:
+        with np.load(path) as z:
+            keys = set(z.files)
+            if '_resume.global_batch' in keys:
+                gb = int(z['_resume.global_batch'])
+                bs = int(z['_resume.batch_size']) if '_resume.batch_size' in keys else None
+                return gb, bs
+    except (OSError, ValueError):
+        pass
+    sidecar = os.path.splitext(path)[0] + '.json'
+    try:
+        with open(sidecar, encoding='utf-8') as f:
+            args = json.load(f)
+        bs = int(args['batch_size'])
+        accum = int(args.get('grad_accum_steps', 1) or 1)
+        return bs * accum, bs
+    except (OSError, ValueError, KeyError, TypeError):
+        return None, None
+
+
+def plan_elastic_resume(devices, batch_size, grad_accum, fsdp=None, tp=None,
+                        resume='', num_slices=1, max_accum=64):
+    """Plan a restart on the live topology, holding the global batch constant.
+
+    ``devices`` is what is actually there now (``jax.device_count()``), not
+    the flag the dead run used.  ``batch_size``/``grad_accum``/``fsdp``/``tp``
+    are this restart's requested values (normally the same flags as the dead
+    run); ``resume`` is the resolved checkpoint path ('' for a fresh start —
+    the plan then only validates/clamps the fresh run's own configuration).
+    """
+    from ..parallel.mesh import resolve_elastic_axes
+
+    devices = int(devices)
+    notes = []
+    fsdp_eff, tp_eff = resolve_elastic_axes(devices, fsdp=fsdp, tp=tp,
+                                            num_slices=num_slices)
+    if fsdp and fsdp_eff != fsdp:
+        notes.append(f'fsdp clamped {fsdp} -> {fsdp_eff} for {devices} devices')
+    if tp and tp_eff != tp:
+        notes.append(f'tp clamped {tp} -> {tp_eff} for {devices} devices')
+
+    global_batch = int(batch_size) * int(grad_accum)
+    source = ''
+    if resume:
+        ckpt_gb, ckpt_bs = _checkpoint_global_batch(resume)
+        if ckpt_gb is not None:
+            if ckpt_gb != global_batch:
+                notes.append(f'global batch {global_batch} -> {ckpt_gb} '
+                             f'(held constant from {os.path.basename(resume)})')
+            global_batch = ckpt_gb
+            if ckpt_bs:
+                batch_size = ckpt_bs   # prefer the dead run's loader batch
+            source = resume
+
+    new_bs, new_accum = rescale_for_devices(
+        global_batch, devices, prefer_batch_size=batch_size,
+        max_accum=max_accum)
+    if (new_bs, new_accum) != (int(batch_size), int(grad_accum)):
+        notes.append(f'rescaled batch_size x accum: {batch_size} x '
+                     f'{grad_accum} -> {new_bs} x {new_accum} '
+                     f'(global batch {global_batch} invariant)')
+    return ElasticPlan(devices=devices, fsdp=fsdp_eff, tp=tp_eff,
+                       batch_size=new_bs, grad_accum=new_accum,
+                       global_batch=global_batch, source=source,
+                       notes=tuple(notes))
+
+
+# ---------------------------------------------------------------------------
+# async durable writer
+# ---------------------------------------------------------------------------
+
+class AsyncCheckpointWriter:
+    """Single background thread running durable checkpoint writes.
+
+    The step loop snapshots state to host (``durable.snapshot_to_host`` —
+    mandatory: donated device buffers are deleted by the next train step) and
+    submits a closure that replays the unchanged synchronous write pipeline,
+    so the npz bytes and SHA-256 manifests stay byte-identical to a
+    synchronous save.
+
+    Queue discipline: one write in flight, one queued slot per ``key``.  A
+    newer submit with the same key supersedes the queued (not yet started)
+    closure — recovery snapshots overwrite the same file anyway, so only the
+    newest matters.  Distinct keys (e.g. 'recovery' vs 'checkpoint') queue
+    side by side and run in submission order.
+
+    Failure discipline: transient ``OSError``s retry with backoff
+    (``retry.retry_io``); the injected ``io_error%M`` fault fires inside the
+    retried closure so the drill exercises this exact path.  The first
+    persistent failure is stored and re-raised on the caller thread at the
+    next submit()/drain() — an async writer must fail as loudly as the
+    synchronous write it replaced.
+    """
+
+    THREAD_NAME = 'timm-tpu-ckpt-writer'
+
+    def __init__(self, retries=3, base_delay=0.05, max_delay=2.0):
+        self._cond = threading.Condition()
+        self._queue = {}          # key -> (label, fn); insertion-ordered
+        self._in_flight = None    # label while a write runs
+        self._error = None        # first persistent failure, raised on caller
+        self._closed = False
+        self._retries = int(retries)
+        self._base_delay = float(base_delay)
+        self._max_delay = float(max_delay)
+        self.superseded = 0       # queued closures replaced before running
+        self.completed = 0        # closures finished (success or failure)
+        self._thread = threading.Thread(
+            target=self._run, name=self.THREAD_NAME, daemon=True)
+        self._thread.start()
+
+    # -- caller-thread API --------------------------------------------------
+
+    def submit(self, fn, label='checkpoint', key=None):
+        """Queue ``fn`` for the writer thread; raises any pending failure."""
+        with self._cond:
+            self._raise_pending_locked()
+            if self._closed:
+                raise RuntimeError('AsyncCheckpointWriter is closed')
+            key = key if key is not None else label
+            if key in self._queue:
+                self.superseded += 1
+            self._queue.pop(key, None)   # re-insert at the tail
+            self._queue[key] = (label, fn)
+            self._cond.notify_all()
+
+    def drain(self, timeout=60.0):
+        """Block until queued + in-flight writes finish; raise any failure."""
+        deadline = None if timeout is None else time.monotonic() + float(timeout)
+        with self._cond:
+            while self._queue or self._in_flight is not None:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f'async checkpoint writer did not drain within '
+                        f'{timeout}s (in flight: {self._in_flight!r}, '
+                        f'queued: {list(self._queue)})')
+                self._cond.wait(remaining)
+            self._raise_pending_locked()
+
+    def close(self, timeout=60.0):
+        """Drain, then stop the writer thread (idempotent)."""
+        try:
+            self.drain(timeout)
+        finally:
+            with self._cond:
+                self._closed = True
+                self._cond.notify_all()
+            self._thread.join(timeout)
+
+    @property
+    def pending(self):
+        with self._cond:
+            return len(self._queue) + (self._in_flight is not None)
+
+    def _raise_pending_locked(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    # -- writer thread ------------------------------------------------------
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if not self._queue:
+                    return    # closed and drained
+                key = next(iter(self._queue))
+                label, fn = self._queue.pop(key)
+                self._in_flight = label
+            try:
+                retry_io(lambda: self._call_with_faults(fn),
+                         retries=self._retries, base_delay=self._base_delay,
+                         max_delay=self._max_delay,
+                         desc=f'async checkpoint write ({label})')
+            except BaseException as e:   # noqa: BLE001 — stored, re-raised on caller
+                with self._cond:
+                    if self._error is None:
+                        self._error = e
+            finally:
+                with self._cond:
+                    self._in_flight = None
+                    self.completed += 1
+                    self._cond.notify_all()
+
+    @staticmethod
+    def _call_with_faults(fn):
+        # io_error%M must exercise the async durable path, not just loader
+        # workers: consume a tick inside the retried closure so retry_io's
+        # backoff is what rides through the transient failure.
+        injector = get_fault_injector()
+        if injector is not None and injector.io_error_tick():
+            raise OSError('injected transient io_error (async writer)')
+        return fn()

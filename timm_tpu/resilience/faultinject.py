@@ -8,7 +8,12 @@ Spec grammar (comma-separated, via `train.py --fault-inject`, `bench.py
                     nan_grads@N:K poisons K consecutive updates (abort drills)
   sigterm@N         deliver SIGTERM to this process at global update N (one-shot)
   io_error%M        raise IOError on every M-th sample read (exercises the
-                    reader retry/backoff + poison-skip budget)
+                    reader retry/backoff + poison-skip budget — and, when an
+                    async checkpoint writer is armed, its durable-write path)
+  resize@N:D        elastic-resize drill: deliver SIGTERM at global update N
+                    (one-shot, like sigterm@N); the restarting harness reads
+                    `resize_devices` = D and relaunches with that forced
+                    device count (`--elastic` resume rebuilds the mesh)
 
 The injector is deliberately dumb: hooks call `take`/`nan_at`/`sigterm_at`/
 `io_error_tick` at the natural fault site, so the tests and manual drills
@@ -27,7 +32,7 @@ _logger = logging.getLogger(__name__)
 __all__ = ['FaultInjector', 'get_fault_injector', 'set_fault_injector', 'fault_selftest']
 
 _KINDS_ONESHOT = ('truncate_ckpt',)
-_KINDS_AT = ('nan_grads', 'sigterm')
+_KINDS_AT = ('nan_grads', 'sigterm', 'resize')
 _KINDS_EVERY = ('io_error',)
 
 
@@ -42,13 +47,24 @@ class FaultInjector:
         self._fired: Dict[str, bool] = {}
         self._every: Dict[str, int] = {}        # kind -> period M
         self._ticks: Dict[str, int] = {}
+        self.resize_devices: Optional[int] = None
         for part in filter(None, (p.strip() for p in self.spec.split(','))):
             if '@' in part:
                 kind, _, n = part.partition('@')
                 if kind not in _KINDS_AT:
                     raise ValueError(f'unknown @-fault {kind!r} in spec {spec!r}')
-                n, _, count = n.partition(':')
-                self._at[kind] = (int(n), max(1, int(count)) if count else 1)
+                n, _, suffix = n.partition(':')
+                if kind == 'resize':
+                    # resize@N:D — the :D suffix is the restart's forced
+                    # device count, not a window; the fault fires exactly once
+                    if not suffix or int(suffix) < 1:
+                        raise ValueError(
+                            f'resize fault needs a device count >= 1: {part!r} '
+                            f'(want resize@N:D)')
+                    self.resize_devices = int(suffix)
+                    self._at[kind] = (int(n), 1)
+                else:
+                    self._at[kind] = (int(n), max(1, int(suffix)) if suffix else 1)
             elif '%' in part:
                 kind, _, m = part.partition('%')
                 if kind not in _KINDS_EVERY:
@@ -84,6 +100,17 @@ class FaultInjector:
         with self._lock:
             if self._at_window('sigterm', update_idx) and not self._fired.get('sigterm'):
                 self._fired['sigterm'] = True
+                return True
+        return False
+
+    def resize_at(self, update_idx: int) -> bool:
+        """True exactly once when `resize@N:D` is armed and update N is
+        reached. The caller SIGTERMs itself (same recovery-save path as a
+        real preemption); the restarting harness reads `resize_devices` for
+        the forced device count of the relaunch."""
+        with self._lock:
+            if self._at_window('resize', update_idx) and not self._fired.get('resize'):
+                self._fired['resize'] = True
                 return True
         return False
 
@@ -184,6 +211,10 @@ def fault_selftest(spec: str = '', tmp_dir: Optional[str] = None) -> dict:
         checks['at_faults'] = (not fi.nan_at(2) and fi.nan_at(3) and fi.nan_at(4)
                                and not fi.nan_at(5)
                                and fi.sigterm_at(5) and not fi.sigterm_at(5))
+        # 5. resize@N:D parses the forced device count and fires exactly once
+        fi = FaultInjector('resize@4:2')
+        checks['resize'] = (fi.resize_devices == 2 and not fi.resize_at(3)
+                            and fi.resize_at(4) and not fi.resize_at(4))
     finally:
         set_fault_injector(prev)
         if tmp_dir is None:

@@ -17,20 +17,25 @@ import numpy as np
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ['capture_host_rng', 'restore_host_rng']
+__all__ = ['RESUME_PREFIX', 'capture_host_rng', 'restore_host_rng']
+
+# Every step-granular resume key (RNG streams here; loader position, update
+# counter, global batch in train.py) rides inside the recovery .npz under
+# this prefix, and is filtered back out before state re-placement.
+RESUME_PREFIX = '_resume.'
 
 
 def capture_host_rng() -> Dict[str, np.ndarray]:
     name, keys, pos, has_gauss, cached = np.random.get_state()
     out = {
-        '_resume.np_rng_keys': np.asarray(keys, np.uint32),
-        '_resume.np_rng_meta': np.asarray([pos, has_gauss], np.int64),
-        '_resume.np_rng_gauss': np.asarray(cached, np.float64),
+        RESUME_PREFIX + 'np_rng_keys': np.asarray(keys, np.uint32),
+        RESUME_PREFIX + 'np_rng_meta': np.asarray([pos, has_gauss], np.int64),
+        RESUME_PREFIX + 'np_rng_gauss': np.asarray(cached, np.float64),
     }
     version, internal, gauss_next = _pyrandom.getstate()
     if version == 3:
-        out['_resume.py_rng_state'] = np.asarray(internal, np.uint64)
-        out['_resume.py_rng_gauss'] = np.asarray(
+        out[RESUME_PREFIX + 'py_rng_state'] = np.asarray(internal, np.uint64)
+        out[RESUME_PREFIX + 'py_rng_gauss'] = np.asarray(
             [1.0, gauss_next] if gauss_next is not None else [0.0, 0.0], np.float64)
     return out
 

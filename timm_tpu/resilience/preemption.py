@@ -50,20 +50,43 @@ class GracefulShutdown:
 
     def install(self) -> 'GracefulShutdown':
         """Install handlers (main thread only; no-op elsewhere so library use
-        inside workers stays safe)."""
+        inside workers stays safe). Idempotent: a second install keeps the
+        ORIGINAL handler chain — it must not record our own handler as the
+        previous one, or uninstall() could never restore the caller's. A
+        partial install (one signal.signal raising) rolls back so no signal
+        is left pointing at a handler whose siblings never registered."""
         if threading.current_thread() is not threading.main_thread():
             _logger.warning('GracefulShutdown.install() skipped: not on the main thread')
             return self
-        for sig in self.signals:
-            self._prev_handlers[sig] = signal.signal(sig, self._handle)
+        if self._installed:
+            return self
+        installed = []
+        try:
+            for sig in self.signals:
+                self._prev_handlers[sig] = signal.signal(sig, self._handle)
+                installed.append(sig)
+        except BaseException:
+            for sig in installed:
+                signal.signal(sig, self._prev_handlers.pop(sig))
+            raise
         self._installed = True
         return self
 
     def uninstall(self):
-        for sig, prev in self._prev_handlers.items():
-            signal.signal(sig, prev)
-        self._prev_handlers.clear()
+        """Restore the previous handlers. Finally-safe: every recorded
+        handler is restored (and forgotten) even when one restore raises;
+        the first error propagates after the rest are back in place."""
+        first_err = None
+        for sig in list(self._prev_handlers):
+            prev = self._prev_handlers.pop(sig)
+            try:
+                signal.signal(sig, prev)
+            except BaseException as e:  # keep restoring the remaining signals
+                if first_err is None:
+                    first_err = e
         self._installed = False
+        if first_err is not None:
+            raise first_err
 
     def _handle(self, signum, frame):
         if self._flag.is_set() and signum == signal.SIGINT:

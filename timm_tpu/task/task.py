@@ -192,6 +192,19 @@ class TrainingTask:
         self._eval_step = None
         return True
 
+    def set_grad_accum(self, steps: int) -> bool:
+        """Rescale gradient accumulation (elastic resume holds
+        global_batch = loader_batch x accum invariant across topology
+        changes). `accum` is captured inside the jitted train step's
+        accumulation scan, so the step is invalidated exactly like
+        set_block_scan; returns True when the value actually changed."""
+        steps = max(1, int(steps))
+        if steps == self.grad_accum_steps:
+            return False
+        self.grad_accum_steps = steps
+        self._train_step = None
+        return True
+
     def compile(self, backend: str = ''):
         self.compiled = True  # parity no-op; the steps are always jitted
 

@@ -8,9 +8,17 @@ Retention: `last` always, top-k by metric, `model_best` copied.
 Durability (resilience subsystem): every write goes tmp → fsync →
 `os.replace` with a SHA-256 sidecar manifest (resilience/durable.py), so a
 preemption or crash mid-write can never leave a torn `last.npz` as the only
-resume candidate. Startup sweeps orphaned tmp files and corrupt recovery
-files; `find_recovery` orders `(epoch, batch_idx)` numerically and returns
-the newest file that passes verification.
+resume candidate. Startup sweeps orphaned tmp files, async staging dirs left
+by a writer thread killed mid-flight, and corrupt recovery files;
+`find_recovery` orders `(epoch, batch_idx)` numerically and returns the
+newest file that passes verification.
+
+Async mode (`async_writer`): the step thread only snapshots state to host
+(resilience.snapshot_to_host — mandatory before the next step deletes
+donated buffers) and computes retention/best bookkeeping; the unchanged
+durable pipeline (write + prune + copies) replays in order on the writer
+thread, staging temp files inside a `.async-stage-<pid>/` subdirectory so a
+kill mid-write leaves nothing loose next to real checkpoints.
 """
 from __future__ import annotations
 
@@ -19,12 +27,14 @@ import logging
 import operator
 import os
 import re
+import shutil
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..resilience import (
-    atomic_copy, atomic_write_json, atomic_write_npz, manifest_path, verify_checkpoint,
+    atomic_copy, atomic_write_json, atomic_write_npz, manifest_path, snapshot_to_host,
+    verify_checkpoint,
 )
 
 _logger = logging.getLogger(__name__)
@@ -45,9 +55,11 @@ class CheckpointSaver:
             recovery_dir: str = '',
             decreasing: bool = False,
             max_history: int = 10,
+            async_writer=None,
     ):
         self.task = task
         self.args = args
+        self.async_writer = async_writer  # resilience.AsyncCheckpointWriter or None
         self.checkpoint_files: List[Tuple[str, float]] = []
         self.best_epoch: Optional[int] = None
         self.best_metric: Optional[float] = None
@@ -67,14 +79,18 @@ class CheckpointSaver:
 
     def _cleanup_startup(self):
         """Sweep artifacts of a previous crash: orphaned tmp files from
-        interrupted atomic writes, the legacy non-atomic `tmp.npz`, and
-        recovery files that fail integrity verification."""
+        interrupted atomic writes, the legacy non-atomic `tmp.npz`, async
+        staging dirs from a writer thread killed mid-flight, and recovery
+        files that fail integrity verification."""
         for d in {self.checkpoint_dir, self.recovery_dir}:
             if not d or not os.path.isdir(d):
                 continue
             for name in os.listdir(d):
                 path = os.path.join(d, name)
-                if name.endswith('.tmp') or name in ('tmp.npz', 'tmp.json'):
+                if name.startswith('.async-stage-') and os.path.isdir(path):
+                    _logger.info(f'Removing orphaned async staging dir: {path}')
+                    shutil.rmtree(path, ignore_errors=True)
+                elif name.endswith('.tmp') or name in ('tmp.npz', 'tmp.json'):
                     _logger.info(f'Removing orphaned checkpoint temp file: {path}')
                     self._unlink(path)
                 elif name.startswith(self.recovery_prefix) and name.endswith(self.extension):
@@ -84,6 +100,23 @@ class CheckpointSaver:
                         self._unlink(path)
                         self._unlink(manifest_path(path))
 
+    def _stage_for(self, directory: str) -> Optional[str]:
+        """Staging dir for async temp files (same filesystem as the
+        destination, so os.replace stays atomic); None in sync mode."""
+        if self.async_writer is None or not directory:
+            return None
+        stage = os.path.join(directory, f'.async-stage-{os.getpid()}')
+        os.makedirs(stage, exist_ok=True)
+        return stage
+
+    def _dispatch(self, commit, label: str, key: str):
+        """Run the durable closure inline (sync) or hand it to the writer
+        thread (async; a newer snapshot supersedes a same-key queued one)."""
+        if self.async_writer is None:
+            commit()
+        else:
+            self.async_writer.submit(commit, label=label, key=key)
+
     @staticmethod
     def _unlink(path: str):
         try:
@@ -91,8 +124,10 @@ class CheckpointSaver:
         except OSError:
             pass
 
-    def _save(self, save_path: str, epoch: int, metric: Optional[float] = None,
-              extra_state: Optional[Dict[str, np.ndarray]] = None):
+    def _snapshot(self, save_path: str, epoch: int, metric: Optional[float] = None,
+                  extra_state: Optional[Dict[str, np.ndarray]] = None):
+        """Caller-thread half of a save: assemble + host-snapshot the state,
+        return the durable-commit closure (the unchanged sync pipeline)."""
         state = self.task.get_checkpoint_state()
         state['epoch'] = np.asarray(epoch)
         if metric is not None:
@@ -102,27 +137,56 @@ class CheckpointSaver:
         meta = {'epoch': epoch, 'metric': metric}
         if extra_state and '_resume.num_updates' in extra_state:
             meta['num_updates'] = int(np.asarray(extra_state['_resume.num_updates']))
-        atomic_write_npz(save_path, state, meta=meta)
+        if self.async_writer is not None:
+            # must happen NOW: the next train step deletes donated buffers
+            state = snapshot_to_host(state)
+        args_doc = None
         if self.args is not None:
-            atomic_write_json(save_path.replace(self.extension, '.json'), {
+            args_doc = {
                 'epoch': epoch, 'metric': metric, 'arch': getattr(self.args, 'model', None),
-                'args': {k: str(v) for k, v in vars(self.args).items()}})
+                'args': {k: str(v) for k, v in vars(self.args).items()}}
+        stage = self._stage_for(os.path.dirname(save_path))
+
+        def commit():
+            if stage is not None:
+                os.makedirs(stage, exist_ok=True)
+            atomic_write_npz(save_path, state, meta=meta, tmp_dir=stage)
+            if args_doc is not None:
+                atomic_write_json(save_path.replace(self.extension, '.json'), args_doc,
+                                  tmp_dir=stage)
+            if stage is not None:
+                try:
+                    os.rmdir(stage)  # empty after a clean write; litter keeps it
+                except OSError:
+                    pass
+        return commit
+
+    def _save(self, save_path: str, epoch: int, metric: Optional[float] = None,
+              extra_state: Optional[Dict[str, np.ndarray]] = None):
+        self._snapshot(save_path, epoch, metric, extra_state)()
 
     def save_checkpoint(self, epoch: int, metric: Optional[float] = None):
         assert epoch >= 0
         last_save_path = os.path.join(self.checkpoint_dir, 'last' + self.extension)
-        self._save(last_save_path, epoch, metric)
+        # retention/best bookkeeping happens eagerly on the caller thread;
+        # `ops` collects the durable file operations, replayed in order
+        ops = [self._snapshot(last_save_path, epoch, metric)]
         # an end-of-epoch checkpoint supersedes any mid-epoch recovery of this
         # or an earlier epoch — drop them so `--resume auto` can't step back
-        self._prune_stale_recovery(epoch)
+        # (the dir scan runs in the closure, AFTER any queued recovery write)
+        ops.append(lambda: self._prune_stale_recovery_files(epoch))
+        for attr in ('curr_recovery_file', 'prev_recovery_file'):
+            m = _RECOVERY_RE.search(getattr(self, attr) or '')
+            if m and int(m.group(1)) <= epoch:
+                setattr(self, attr, '')
 
         worst_file = self.checkpoint_files[-1] if self.checkpoint_files else None
         if len(self.checkpoint_files) < self.max_history or metric is None or self.cmp(metric, worst_file[1]):
             if len(self.checkpoint_files) >= self.max_history:
-                self._cleanup_checkpoints(1)
+                ops.append(self._cleanup_checkpoints(1))
             filename = '-'.join([self.save_prefix, str(epoch)]) + self.extension
             save_path = os.path.join(self.checkpoint_dir, filename)
-            atomic_copy(last_save_path, save_path)
+            ops.append(lambda: atomic_copy(last_save_path, save_path))
             self.checkpoint_files.append((save_path, metric))
             self.checkpoint_files = sorted(
                 self.checkpoint_files, key=lambda x: x[1] if x[1] is not None else -float('inf'),
@@ -137,39 +201,55 @@ class CheckpointSaver:
                 self.best_epoch = epoch
                 self.best_metric = metric
                 best_save_path = os.path.join(self.checkpoint_dir, 'model_best' + self.extension)
-                atomic_copy(last_save_path, best_save_path)
+                ops.append(lambda: atomic_copy(last_save_path, best_save_path))
 
+        def commit():
+            for op in ops:
+                op()
+
+        self._dispatch(commit, label=f'checkpoint-{epoch}', key='checkpoint')
         return (None, None) if self.best_metric is None else (self.best_metric, self.best_epoch)
 
     def _cleanup_checkpoints(self, trim: int = 0):
+        """Trim the tracked checkpoint list now; return the closure that
+        removes the files (run inline in sync mode, on the writer in async)."""
         trim = min(len(self.checkpoint_files), trim)
         delete_index = self.max_history - trim
         if delete_index < 0 or len(self.checkpoint_files) <= delete_index:
-            return
+            return lambda: None
         to_delete = self.checkpoint_files[delete_index:]
-        for d in to_delete:
-            try:
-                _logger.debug(f'Cleaning checkpoint: {d}')
-                os.remove(d[0])
-                for side in (d[0].replace(self.extension, '.json'), manifest_path(d[0])):
-                    if os.path.exists(side):
-                        os.remove(side)
-            except OSError:
-                _logger.error(f'Exception removing checkpoint {d}')
         self.checkpoint_files = self.checkpoint_files[:delete_index]
+
+        def remove():
+            for d in to_delete:
+                try:
+                    _logger.debug(f'Cleaning checkpoint: {d}')
+                    os.remove(d[0])
+                    for side in (d[0].replace(self.extension, '.json'), manifest_path(d[0])):
+                        if os.path.exists(side):
+                            os.remove(side)
+                except OSError:
+                    _logger.error(f'Exception removing checkpoint {d}')
+        return remove
 
     def save_recovery(self, epoch: int, batch_idx: int = 0,
                       extra_state: Optional[Dict[str, np.ndarray]] = None) -> str:
         filename = '-'.join([self.recovery_prefix, str(epoch), str(batch_idx)]) + self.extension
         save_path = os.path.join(self.recovery_dir, filename)
-        self._save(save_path, epoch, extra_state=extra_state)
-        if os.path.exists(self.prev_recovery_file):
-            try:
-                os.remove(self.prev_recovery_file)
-                self._unlink(manifest_path(self.prev_recovery_file))
-                self._unlink(self.prev_recovery_file.replace(self.extension, '.json'))
-            except OSError:
-                _logger.error(f'Exception removing {self.prev_recovery_file}')
+        commit_write = self._snapshot(save_path, epoch, extra_state=extra_state)
+        prev_to_remove = self.prev_recovery_file
+
+        def commit():
+            commit_write()
+            if prev_to_remove and os.path.exists(prev_to_remove):
+                try:
+                    os.remove(prev_to_remove)
+                    self._unlink(manifest_path(prev_to_remove))
+                    self._unlink(prev_to_remove.replace(self.extension, '.json'))
+                except OSError:
+                    _logger.error(f'Exception removing {prev_to_remove}')
+
+        self._dispatch(commit, label=f'recovery-{epoch}-{batch_idx}', key='recovery')
         self.prev_recovery_file = self.curr_recovery_file
         self.curr_recovery_file = save_path
         return save_path
@@ -186,17 +266,15 @@ class CheckpointSaver:
 
         return sorted(files, key=key, reverse=True)
 
-    def _prune_stale_recovery(self, completed_epoch: int):
+    def _prune_stale_recovery_files(self, completed_epoch: int):
+        """File-system half of recovery pruning (writer-thread safe: no
+        bookkeeping mutation — save_checkpoint clears curr/prev eagerly)."""
         for f in self._recovery_files():
             m = _RECOVERY_RE.search(f)
             if m and int(m.group(1)) <= completed_epoch:
                 self._unlink(f)
                 self._unlink(manifest_path(f))
                 self._unlink(f.replace(self.extension, '.json'))
-                if f == self.curr_recovery_file:
-                    self.curr_recovery_file = ''
-                if f == self.prev_recovery_file:
-                    self.prev_recovery_file = ''
 
     def find_recovery(self) -> str:
         """Newest recovery checkpoint that passes integrity verification."""

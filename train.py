@@ -197,8 +197,16 @@ def make_parser():
     group = parser.add_argument_group('Fault tolerance parameters')
     group.add_argument('--fault-inject', default='', type=str, metavar='SPEC',
                        help="arm the fault-injection harness for drills, e.g. "
-                            "'truncate_ckpt,nan_grads@12,sigterm@7,io_error%%50' "
+                            "'truncate_ckpt,nan_grads@12,sigterm@7,io_error%%50,resize@7:4' "
                             "(timm_tpu/resilience/faultinject.py)")
+    group.add_argument('--elastic', action='store_true', default=False,
+                       help='elastic resume: rebuild the mesh from the LIVE device '
+                            'topology (clamping --fsdp/--tp to what still divides it) '
+                            'and rescale --batch-size x --grad-accum-steps so the '
+                            "interrupted run's global batch stays constant; refuses "
+                            'loudly when no integer solution exists. Combine with '
+                            '--resume auto after a slice preemption '
+                            '(timm_tpu/resilience/elastic.py)')
     group.add_argument('--nonfinite-tolerance', type=int, default=None, metavar='K',
                        help='abort after K consecutive non-finite (NaN/Inf) train steps '
                             '(default: env TIMM_TPU_NONFINITE_TOLERANCE or 3); skipped '
@@ -296,8 +304,9 @@ def main():
     )
 
     from timm_tpu.resilience import (
-        GracefulShutdown, NonFiniteError, TrainingPreempted,
-        load_with_fallback, resolve_auto_resume, restore_host_rng, set_fault_injector,
+        AsyncCheckpointWriter, GracefulShutdown, NonFiniteError, TrainingPreempted,
+        convert_loader_position, load_with_fallback, plan_elastic_resume,
+        resolve_auto_resume, restore_host_rng, set_fault_injector,
     )
 
     setup_default_logging()
@@ -314,6 +323,30 @@ def main():
         jax.config.update('jax_platforms', args.device)
     world_size, rank, _ = init_distributed_device(args)
     random_seed(args.seed, rank)
+
+    if args.elastic:
+        # elastic pre-pass: clamp mesh axes to the LIVE topology and hold the
+        # interrupted run's global batch constant, BEFORE mesh/loaders exist.
+        # (The resume path is re-resolved here because output_dir is built
+        # later; `--resume auto` needs --experiment for a stable dir.)
+        probe_dir = (os.path.join(args.output or './output/train', args.experiment)
+                     if args.experiment else '')
+        elastic_resume = args.resume
+        if args.resume == 'auto':
+            elastic_resume = (resolve_auto_resume(probe_dir) or '') if probe_dir else ''
+        plan = plan_elastic_resume(
+            devices=jax.device_count(),
+            batch_size=args.batch_size, grad_accum=args.grad_accum_steps,
+            fsdp=args.fsdp or None, tp=args.tp or None, resume=elastic_resume)
+        args.fsdp, args.tp = plan.fsdp or 0, plan.tp or 0
+        args.batch_size, args.grad_accum_steps = plan.batch_size, plan.grad_accum
+        for note in plan.notes:
+            _logger.info(f'[elastic] {note}')
+        _logger.info(
+            f'[elastic] live topology: {plan.devices} devices, fsdp={plan.fsdp}, '
+            f'tp={plan.tp}; global batch {plan.global_batch} = '
+            f'{plan.batch_size} x {plan.grad_accum}'
+            + (f' (held constant from {os.path.basename(plan.source)})' if plan.source else ''))
 
     mesh = create_mesh(fsdp=args.fsdp if args.fsdp else None,
                        tp=args.tp if args.tp else None)
@@ -678,11 +711,18 @@ def main():
     output_dir = None
     exp_name = args.experiment or '-'.join([
         datetime.now().strftime('%Y%m%d-%H%M%S'), args.model, str(img_size)])
+    async_writer = None
     if rank == 0:
         output_dir = get_outdir(args.output if args.output else './output/train', exp_name)
+        if os.environ.get('TIMM_TPU_ASYNC_CKPT', '1') != '0':
+            # async checkpointing (default on): the step loop only snapshots
+            # state to host; fsync/os.replace run on this writer thread.
+            # TIMM_TPU_ASYNC_CKPT=0 restores fully synchronous writes.
+            async_writer = AsyncCheckpointWriter()
         saver = CheckpointSaver(
             task, args=args, checkpoint_dir=output_dir, recovery_dir=output_dir,
-            decreasing=args.eval_metric == 'loss', max_history=args.checkpoint_hist)
+            decreasing=args.eval_metric == 'loss', max_history=args.checkpoint_hist,
+            async_writer=async_writer)
         with open(os.path.join(output_dir, 'args.yaml'), 'w') as f:
             f.write(args_text)
     elif args.experiment:
@@ -721,6 +761,17 @@ def main():
             # already-consumed loader batches, continue the update counter
             start_epoch = ck_epoch
             start_batch_idx = int(state['_resume.batches_consumed'])
+            if '_resume.batch_size' in state:
+                old_bs = int(state['_resume.batch_size'])
+                if old_bs != args.batch_size:
+                    start_batch_idx, exact = convert_loader_position(
+                        start_batch_idx, old_bs, args.batch_size)
+                    _logger.warning(
+                        f'Loader batch size changed {old_bs} -> {args.batch_size} on '
+                        f'resume: position converted to {start_batch_idx} batches'
+                        + ('' if exact else ' (inexact: partial batch re-seen)')
+                        + '; data order is only bit-identical when the loader '
+                          'batch size is unchanged')
             resume_num_updates = int(state['_resume.num_updates'])
             _logger.info(
                 f'Resumed mid-epoch from {used_path}: epoch {start_epoch}, '
@@ -750,51 +801,59 @@ def main():
     best_metric = None
     best_epoch = None
     eval_metrics = {}
-    for epoch in range(start_epoch, num_epochs):
-        if shutdown.requested:
-            # preempted at an epoch boundary: last.npz already covers resume
-            _logger.warning(f'Shutdown requested; stopping before epoch {epoch} '
-                            f'(resume with --resume auto)')
-            raise SystemExit(0)
-        if hasattr(loader_train, 'set_epoch'):
-            loader_train.set_epoch(epoch)  # fresh shuffle/schedule (ref train.py:478)
-        if args.mixup_off_epoch and epoch >= args.mixup_off_epoch:
-            if mixup_fn is not None:
-                mixup_fn.mixup_enabled = False  # ref train.py disable-mixup schedule
-            elif getattr(loader_train, 'mixup', None) is not None:
-                # device-augment stage: same schedule; the sampler emits
-                # identity params (lam=1) so the jitted program is unchanged
-                loader_train.mixup.mixup_enabled = False
-        try:
-            train_metrics = train_one_epoch(
-                epoch, task, loader_train, args, lr_scheduler, mesh, shard_batch,
-                updates_per_epoch, saver=saver, mixup_fn=mixup_fn, shutdown=shutdown,
-                skip_batches=start_batch_idx if epoch == start_epoch else 0,
-                start_updates=resume_num_updates if epoch == start_epoch else None,
-                rollback_budget=rollback_budget)
-        except TrainingPreempted as e:
-            _logger.warning(f'Preempted during epoch {epoch}; recovery checkpoint: '
-                            f'{e.recovery_path or "(non-primary host)"}. Exiting 0 for reschedule.')
-            raise SystemExit(0)
-        except NonFiniteError as e:
-            _logger.error(f'Aborting training: {e}')
-            raise SystemExit(3)
+    try:
+        for epoch in range(start_epoch, num_epochs):
+            if shutdown.requested:
+                # preempted at an epoch boundary: last.npz already covers resume
+                _logger.warning(f'Shutdown requested; stopping before epoch {epoch} '
+                                f'(resume with --resume auto)')
+                raise SystemExit(0)
+            if hasattr(loader_train, 'set_epoch'):
+                loader_train.set_epoch(epoch)  # fresh shuffle/schedule (ref train.py:478)
+            if args.mixup_off_epoch and epoch >= args.mixup_off_epoch:
+                if mixup_fn is not None:
+                    mixup_fn.mixup_enabled = False  # ref train.py disable-mixup schedule
+                elif getattr(loader_train, 'mixup', None) is not None:
+                    # device-augment stage: same schedule; the sampler emits
+                    # identity params (lam=1) so the jitted program is unchanged
+                    loader_train.mixup.mixup_enabled = False
+            try:
+                train_metrics = train_one_epoch(
+                    epoch, task, loader_train, args, lr_scheduler, mesh, shard_batch,
+                    updates_per_epoch, saver=saver, mixup_fn=mixup_fn, shutdown=shutdown,
+                    skip_batches=start_batch_idx if epoch == start_epoch else 0,
+                    start_updates=resume_num_updates if epoch == start_epoch else None,
+                    rollback_budget=rollback_budget)
+            except TrainingPreempted as e:
+                _logger.warning(f'Preempted during epoch {epoch}; recovery checkpoint: '
+                                f'{e.recovery_path or "(non-primary host)"}. Exiting 0 for reschedule.')
+                raise SystemExit(0)
+            except NonFiniteError as e:
+                _logger.error(f'Aborting training: {e}')
+                raise SystemExit(3)
 
-        eval_metrics = validate(task, loader_eval, args, mesh, shard_batch)
-        if task.ema_params is not None:
-            ema_metrics = validate(task, loader_eval, args, mesh, shard_batch, use_ema=True)
-            eval_metrics.update({f'{k}_ema': v for k, v in ema_metrics.items()})
+            eval_metrics = validate(task, loader_eval, args, mesh, shard_batch)
+            if task.ema_params is not None:
+                ema_metrics = validate(task, loader_eval, args, mesh, shard_batch, use_ema=True)
+                eval_metrics.update({f'{k}_ema': v for k, v in ema_metrics.items()})
 
-        if output_dir is not None:
-            update_summary(
-                epoch, train_metrics, eval_metrics,
-                filename=os.path.join(output_dir, 'summary.csv'),
-                lr=train_metrics.get('lr'),
-                write_header=epoch == start_epoch, log_wandb=args.log_wandb)
-        if saver is not None:
-            best_metric, best_epoch = saver.save_checkpoint(epoch, metric=eval_metrics.get(args.eval_metric))
-        if lr_scheduler is not None:
-            lr_scheduler.step(epoch + 1, eval_metrics.get(args.eval_metric))
+            if output_dir is not None:
+                update_summary(
+                    epoch, train_metrics, eval_metrics,
+                    filename=os.path.join(output_dir, 'summary.csv'),
+                    lr=train_metrics.get('lr'),
+                    write_header=epoch == start_epoch, log_wandb=args.log_wandb)
+            if saver is not None:
+                best_metric, best_epoch = saver.save_checkpoint(epoch, metric=eval_metrics.get(args.eval_metric))
+            if lr_scheduler is not None:
+                lr_scheduler.step(epoch + 1, eval_metrics.get(args.eval_metric))
+    finally:
+        # drain the async writer on EVERY exit — including the SystemExit(0)
+        # a SIGTERM/TrainingPreempted turns into — so the recovery checkpoint
+        # is durable before the scheduler restarts us. A pending write failure
+        # raises here: an undrained writer must fail as loudly as a sync one.
+        if async_writer is not None:
+            async_writer.close()
 
     if best_metric is not None:
         _logger.info(f'*** Best metric: {best_metric} (epoch {best_epoch})')
@@ -802,15 +861,21 @@ def main():
     return eval_metrics
 
 
-def _recovery_extras(batches_consumed, num_updates):
+def _recovery_extras(batches_consumed, num_updates, args=None):
     """Step-granular resume state stored alongside the task state in a
-    recovery checkpoint: loader position, update counter, host RNG streams."""
+    recovery checkpoint: loader position, update counter, host RNG streams —
+    plus the batch geometry an `--elastic` restart needs to hold the global
+    batch constant on a different topology."""
     from timm_tpu.resilience import capture_host_rng
     extras = {
         '_resume.mid_epoch': np.asarray(1),
         '_resume.batches_consumed': np.asarray(batches_consumed),
         '_resume.num_updates': np.asarray(num_updates),
     }
+    if args is not None:
+        extras['_resume.batch_size'] = np.asarray(args.batch_size)
+        extras['_resume.global_batch'] = np.asarray(args.batch_size * args.grad_accum_steps)
+        extras['_resume.device_count'] = np.asarray(jax.device_count())
     extras.update(capture_host_rng())
     return extras
 
@@ -855,12 +920,18 @@ def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
         if injector is not None and injector.sigterm_at(num_updates - 1):
             _logger.warning(f'[fault-inject] SIGTERM at update {num_updates - 1}')
             os.kill(os.getpid(), __import__('signal').SIGTERM)
+        if injector is not None and injector.resize_at(num_updates - 1):
+            # in-process, a resize IS a preemption: SIGTERM now; the restart
+            # harness (tests/fsdp_drill.py) relaunches with the new topology
+            _logger.warning(f'[fault-inject] resize to {injector.resize_devices} '
+                            f'devices at update {num_updates - 1}: delivering SIGTERM')
+            os.kill(os.getpid(), __import__('signal').SIGTERM)
         if shutdown is not None and shutdown.should_stop(update_idx):
             path = ''
             if saver is not None:
                 path = saver.save_recovery(
                     epoch, update_idx,
-                    extra_state=_recovery_extras(batch_idx + 1, num_updates))
+                    extra_state=_recovery_extras(batch_idx + 1, num_updates, args))
             raise TrainingPreempted(path)
 
     metrics = {}
@@ -902,7 +973,7 @@ def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
                 log_t0 = time.time()
             if saver is not None and args.recovery_interval and (update_idx + 1) % args.recovery_interval == 0:
                 saver.save_recovery(epoch, update_idx,
-                                    extra_state=_recovery_extras(batch_idx + 1, num_updates))
+                                    extra_state=_recovery_extras(batch_idx + 1, num_updates, args))
             poll_faults_and_shutdown(batch_idx, update_idx)
             update_idx += 1
             continue
@@ -946,7 +1017,7 @@ def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
                 f'{ips:.1f} img/s' + (f' NaN-skipped: {nf}' if nf else ''))
         if saver is not None and args.recovery_interval and (update_idx + 1) % args.recovery_interval == 0:
             saver.save_recovery(epoch, update_idx,
-                                extra_state=_recovery_extras(batch_idx + 1, num_updates))
+                                extra_state=_recovery_extras(batch_idx + 1, num_updates, args))
         poll_faults_and_shutdown(batch_idx, update_idx)
         update_idx += 1
     if micro_inputs:

@@ -9,6 +9,17 @@
 # same document.
 cd /root/repo
 while true; do
+  # static-analysis gate: never measure a repo the analyzers reject. Full
+  # suite (source + jaxpr + HLO rules + zoo abstract-trace); the report lands
+  # in ANALYSIS_SELF.json so a failed gate leaves evidence next to the bench
+  # doc. Exit 2 = violations, 3 = analyzer error — both skip the round.
+  python -m timm_tpu.analysis --json ANALYSIS_SELF.json >> /tmp/bench_loop.log 2>&1
+  arc=$?
+  echo "[$(date -u +%FT%TZ)] timm_tpu.analysis rc=$arc" >> /tmp/bench_loop.log
+  if [ $arc -ne 0 ]; then
+    sleep 180
+    continue
+  fi
   BENCH_TOTAL_BUDGET=1800 python bench.py --save-self >> /tmp/bench_loop.log 2>&1
   rc=$?
   echo "[$(date -u +%FT%TZ)] bench.py --save-self rc=$rc" >> /tmp/bench_loop.log

@@ -231,7 +231,7 @@ def _run_child(args, timeout_s: int) -> dict | None:
             try:
                 f.close()
                 os.unlink(f.name)
-            except Exception:
+            except OSError:
                 pass
     for line in reversed((stdout or '').strip().splitlines()):
         try:
@@ -335,6 +335,15 @@ def main():
                              'the tier-1 CPU smoke (parity always runs; timed verdicts '
                              'settle on the claimed hardware). Also runs as the replay '
                              "checklist's `kernels` step.")
+    parser.add_argument('--analysis', action='store_true',
+                        help='run the static-analysis suite (timm_tpu/analysis: source/'
+                             'jaxpr/HLO rules + zoo abstract-trace) and record the report '
+                             'into BENCH_SELF.json. Combine with --dry-run for the cheap '
+                             'arm (Tier A source rules + zoo smoke, no probe lowering) — '
+                             "the same spec the replay checklist's `analysis` step smokes "
+                             'in tier-1; the full run also walks the jaxpr/HLO of every '
+                             'probe program. Exit 0 clean / 2 violations / 3 analyzer '
+                             'error.')
     parser.add_argument('--profile', action='store_true',
                         help='capture a jax.profiler trace of the train step for --model '
                              'and print the self-parsed MXU vs non-MXU op summary '
@@ -369,6 +378,9 @@ def main():
 
     if args.kernels:
         raise SystemExit(_kernels_ab(args))
+
+    if args.analysis:
+        raise SystemExit(_analysis(args))
 
     if args.profile:
         raise SystemExit(_profile_run(args))
@@ -720,6 +732,34 @@ def _kernels_ab(args) -> int:
     return 0 if counts['delete'] == 0 else 2
 
 
+def _analysis(args) -> int:
+    """Static-analysis gate as a bench mode: the same suite the replay
+    checklist's `analysis` step runs, callable standalone so a bench round
+    (and .bench_loop.sh) can refuse to measure a repo the analyzers reject.
+    --dry-run is the cheap arm (Tier A source rules + the zoo smoke subset,
+    no probe lowering); full mode runs every rule, including the jaxpr/HLO
+    passes over the freshly lowered probe programs. The per-rule report
+    lands in BENCH_SELF.json next to the kernel verdicts."""
+    _force_cpu_topology()
+    from timm_tpu.perfbudget.replay import _run_analysis, load_self_doc, save_self_doc
+    from timm_tpu.utils import configure_compile_cache
+
+    configure_compile_cache()
+    _status(f'analysis: static-analysis suite ({"dry-run" if args.dry_run else "full"})')
+    spec = dict(tiers=('A',), zoo='smoke') if args.dry_run else {}
+    result = _run_analysis(spec)
+    doc = load_self_doc(SELF_RESULT_PATH)
+    doc['analysis'] = dict(result, at=time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime()))
+    save_self_doc(SELF_RESULT_PATH, doc)
+    print(json.dumps({
+        'metric': (f"static analysis ({'dry-run' if args.dry_run else 'full'}): "
+                   f"{result['violations']} violation(s), {result['waived']} waived, "
+                   f"{len(result['errors'])} analyzer error(s) -> {SELF_RESULT_PATH}"),
+        'value': float(result['violations']), 'unit': 'violations',
+        'vs_baseline': None}), flush=True)
+    return result['exit_code']
+
+
 def _profile_run(args) -> int:
     """Unattended profiler harness (PERF.md checklist item 6): capture a
     jax.profiler trace of the train step for --model and print the
@@ -759,6 +799,7 @@ def _compile_child(args) -> int:
     import jax
     try:
         jax.config.update('jax_platforms', 'cpu')  # compile cost needs no TPU
+    # timm-tpu-lint: disable=silent-except platform may be pinned after jax init; cpu is the fallback either way
     except Exception:
         pass
     from timm_tpu.utils.compile_cache import configure_compile_cache, count_jaxpr_eqns
@@ -1041,6 +1082,7 @@ def _measure(args) -> int:
         kind = jax.devices()[0].device_kind.lower().replace(' ', '').replace('tpu', '')
         peak = next((v for k, v in CHIP_PEAK.items() if k in kind or kind in k), 197e12)
         mfu = (fwd_flops * flops_mult / n_chips) / per_step / peak
+    # timm-tpu-lint: disable=silent-except MFU is best-effort decoration (cost_analysis may be absent); the bench result row stands without it
     except Exception:
         pass
 

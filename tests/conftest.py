@@ -66,6 +66,11 @@ def pytest_configure(config):
         'elastic: elastic pod-scale training — resize-the-mesh resume drills '
         '(8↔4 devices, global batch invariant) + async checkpoint writer '
         '(runs in tier-1)')
+    config.addinivalue_line(
+        'markers',
+        'analysis: unified static-analysis suite — source/jaxpr/HLO rules, '
+        'pragma waivers, planted-violation fixtures, CLI exit codes, zoo '
+        'abstract-trace smoke (runs in tier-1)')
 
 
 @pytest.fixture(scope='session')
@@ -74,3 +79,20 @@ def mesh8():
     mesh = create_mesh()
     set_global_mesh(mesh)
     return mesh
+
+
+@pytest.fixture(scope='session')
+def analysis_programs():
+    """ONE probe run shared by the perf-budget comparisons (test_perfbudget)
+    and the analysis suite's Tier B/C passes (test_analysis): run_matrix
+    lowers each program exactly once, and capture_programs hands the jaxprs
+    + compiled executables to the jaxpr/HLO rules without re-lowering.
+    probe_config saves/restores the global mesh, so this composes with
+    whatever mesh the consuming test file has active."""
+    from timm_tpu.perfbudget import run_matrix
+    from timm_tpu.perfbudget.probe import capture_programs
+
+    names = ('base', 'accum4', 'serve_test_vit', 'tp22', 'elastic_resize')
+    with capture_programs() as programs:
+        measured = run_matrix(names=list(names))
+    return {'names': names, 'measured': measured, 'programs': list(programs)}

@@ -1,0 +1,13 @@
+"""Planted violation: a 2 MB numpy constant closed over into the traced
+program (rule large-literal) — the PR 9 landmine in miniature."""
+import numpy as np
+
+_BIG = np.ones((512, 1024), np.float32)  # 2.0 MB baked constant
+
+
+def program(x):
+    return x + _BIG
+
+
+def example_args():
+    return (np.zeros((512, 1024), np.float32),)

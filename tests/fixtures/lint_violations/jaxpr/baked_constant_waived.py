@@ -1,0 +1,14 @@
+"""Planted waiver twin: a module-scope pragma (first 5 lines) waives
+large-literal file-wide."""
+# timm-tpu-lint: disable=large-literal planted fixture proving the module-scope waiver
+import numpy as np
+
+_BIG = np.ones((512, 1024), np.float32)
+
+
+def program(x):
+    return x + _BIG
+
+
+def example_args():
+    return (np.zeros((512, 1024), np.float32),)

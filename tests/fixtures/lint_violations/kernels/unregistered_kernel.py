@@ -1,0 +1,6 @@
+"""Planted violation: a kernels/ module that registers no KernelSpec and
+carries no waiver (rule kernel-registered)."""
+
+
+def fused_noop(x):
+    return x

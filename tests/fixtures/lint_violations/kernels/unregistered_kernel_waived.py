@@ -1,0 +1,6 @@
+"""Planted waiver twin for kernel-registered."""
+# no-kernel-registry: planted fixture - host-side helper, no kernel to register
+
+
+def fused_noop(x):
+    return x

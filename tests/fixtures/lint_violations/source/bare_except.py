@@ -1,0 +1,10 @@
+"""Planted violation: a silent exception swallow (rule silent-except)."""
+
+
+def read_maybe(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except Exception:
+        pass
+    return None

@@ -1,0 +1,15 @@
+"""Planted waiver twin: the same swallow, waived with a mandatory reason.
+
+The standalone pragma waives the NEXT line, which is where the finding
+anchors (the `except` line).
+"""
+
+
+def read_maybe(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    # timm-tpu-lint: disable=silent-except planted fixture proving the line-scope waiver
+    except Exception:
+        pass
+    return None

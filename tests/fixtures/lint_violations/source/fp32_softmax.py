@@ -1,0 +1,8 @@
+"""Planted violation: a hard-coded fp32 softmax outside the policy module
+(rule fp32-softmax)."""
+import jax
+import jax.numpy as jnp
+
+
+def attend(scores):
+    return jax.nn.softmax(scores.astype(jnp.float32), axis=-1)

@@ -1,0 +1,8 @@
+"""Planted violation: a host-synchronizing call inside a jitted body
+(rule host-sync)."""
+import jax
+
+
+@jax.jit
+def loss_scalar(x):
+    return x.sum().item()

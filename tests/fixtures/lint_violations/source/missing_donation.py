@@ -1,0 +1,10 @@
+"""Planted violation: a jax.jit call with neither donate_argnums nor a
+`# no-donate: <reason>` waiver (rule donation-declared)."""
+import jax
+
+
+def train_step(state, batch):
+    return state
+
+
+step = jax.jit(train_step)

@@ -1,0 +1,10 @@
+"""Planted waiver twin: the legacy `# no-donate:` shim suppresses the rule."""
+import jax
+
+
+def eval_step(state, batch):
+    return state
+
+
+# no-donate: planted fixture - eval step reuses its inputs across calls
+step = jax.jit(eval_step)

@@ -1,0 +1,11 @@
+"""Planted violation: a Python branch on a traced argument value inside a
+jitted function (rule traced-branch)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp_positive(x):
+    if x > 0:
+        return x
+    return jnp.zeros_like(x)

@@ -1,8 +1,8 @@
 """Pallas kernel tests (interpret mode on CPU; native on TPU).
 
 1. Flash-attention numerics (the original hand-written checks).
-2. Registry lint: every module in timm_tpu/kernels/ registers a KernelSpec
-   or carries an explicit `# no-kernel-registry: <reason>` waiver.
+2. Registry behaviour (the every-module-registered-or-waived lint moved to
+   timm_tpu/analysis, rule `kernel-registered`).
 3. Auto-generated parity: one test per (kernel, declared regime case) pair,
    jitted kernel vs jitted XLA reference at the case's dry shapes.
 4. Fused AdamW+EMA: 5 donated TrainingTask steps with fused_update=True must
@@ -19,8 +19,6 @@
 """
 import dataclasses
 import functools
-import glob
-import os
 
 import jax
 import jax.numpy as jnp
@@ -28,7 +26,6 @@ import numpy as np
 import pytest
 from flax import nnx
 
-import timm_tpu.kernels as kernels_pkg
 from timm_tpu.kernels import harness, registry
 from timm_tpu.kernels.flash_attention import _flash, flash_attention
 from timm_tpu.layers.attention import _sdpa
@@ -76,30 +73,10 @@ def test_flash_grads_match():
         assert float(jnp.abs(a - b).max()) < 5e-2
 
 
-# ---- 2. registry lint -------------------------------------------------------
-
-_WAIVER = '# no-kernel-registry:'
-
-
-def test_registry_lint_every_module_registered_or_waived():
-    """An unregistered kernel module cannot land: each .py in timm_tpu/kernels/
-    either registers a KernelSpec whose `module` names it, or opens with an
-    explicit `# no-kernel-registry: <reason>` waiver line."""
-    registry.ensure_registered()
-    registered = {spec.module for spec in registry.all_specs()}
-    pkg_dir = os.path.dirname(kernels_pkg.__file__)
-    for path in sorted(glob.glob(os.path.join(pkg_dir, '*.py'))):
-        stem = os.path.splitext(os.path.basename(path))[0]
-        with open(path) as f:
-            head = [f.readline() for _ in range(5)]
-        waivers = [ln for ln in head if ln.startswith(_WAIVER)]
-        if waivers:
-            reason = waivers[0][len(_WAIVER):].strip()
-            assert reason, f'{stem}.py: the no-kernel-registry waiver needs a reason'
-            continue
-        assert f'timm_tpu.kernels.{stem}' in registered, (
-            f'{stem}.py defines no registered kernel and carries no '
-            f'{_WAIVER!r} waiver (registered modules: {sorted(registered)})')
+# ---- 2. registry ------------------------------------------------------------
+# The every-module-registered-or-waived lint is now the analysis rule
+# `kernel-registered` (timm_tpu/analysis/source_rules.py); the
+# `# no-kernel-registry: <reason>` waiver spelling is unchanged.
 
 
 def test_registry_portfolio_and_dup_rejection():

@@ -426,22 +426,5 @@ def test_split_attn_groups():
     assert m(x).shape == (2, 8, 8, 16)
 
 
-def test_no_hardcoded_fp32_softmax_in_layers():
-    """Precision-policy lint: layers must route softmax dtype selection
-    through config.softmax_with_policy — a hard-coded fp32 upcast next to a
-    softmax silently bypasses the TIMM_TPU_SOFTMAX_DTYPE lever. config.py is
-    the policy module and the single allowed location."""
-    import os
-    import timm_tpu.layers as layers_pkg
-    layers_dir = os.path.dirname(layers_pkg.__file__)
-    offenders = []
-    for fname in sorted(os.listdir(layers_dir)):
-        if not fname.endswith('.py') or fname == 'config.py':
-            continue
-        with open(os.path.join(layers_dir, fname)) as f:
-            for lineno, line in enumerate(f, 1):
-                if 'softmax(' in line and 'float32' in line:
-                    offenders.append(f'{fname}:{lineno}: {line.strip()}')
-    assert not offenders, (
-        'hard-coded fp32 softmax outside the policy module '
-        '(use timm_tpu.layers.softmax_with_policy):\n' + '\n'.join(offenders))
+# The hard-coded-fp32-softmax lint is now the analysis rule `fp32-softmax`
+# (timm_tpu/analysis/source_rules.py), enforced by tests/test_analysis.py.

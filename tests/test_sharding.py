@@ -1,11 +1,12 @@
 """Sharded-execution tests.
 
 1. BatchNorm semantics under a sharded batch (SURVEY §7 hard part (c)).
-2. FSDP partition rules: every ViT param resolves to exactly one rule; m/v
-   optimizer slots mirror their param's spec (what makes donation aliasing
-   legal).
-3. Donated jitted steps: re-using a donated buffer raises; every jit in
-   timm_tpu/task/ declares donate_argnums or an explicit no-donate reason.
+2. FSDP partition rules: m/v optimizer slots mirror their param's spec
+   (what makes donation aliasing legal). The disjoint/exhaustive rule-table
+   lint moved to timm_tpu/analysis (rule `partition-rules`).
+3. Donated jitted steps: re-using a donated buffer raises. The source and
+   compiled-HLO donation lints moved to timm_tpu/analysis (rules
+   `donation-declared`, `donation-alias`).
 4. Scanned grad accumulation: grad parity ≤1e-6 vs the legacy unroll, and
    jaxpr trace size is O(1) in grad_accum_steps.
 5. 8-CPU-device subprocess drills: ('data','fsdp') train parity vs a single
@@ -14,7 +15,6 @@
 """
 import json
 import os
-import re
 import subprocess
 import sys
 
@@ -30,8 +30,8 @@ from timm_tpu.layers import BatchNormAct2d
 from timm_tpu.loss import LabelSmoothingCrossEntropy
 from timm_tpu.optim import create_optimizer_v2
 from timm_tpu.parallel import (
-    build_opt_shardings, build_param_shardings, create_mesh, default_partition_rules,
-    match_rule, param_bytes_per_device, path_specs, shard_batch, spec_for_param,
+    build_opt_shardings, build_param_shardings, create_mesh,
+    param_bytes_per_device, path_specs, shard_batch, spec_for_param,
 )
 from timm_tpu.task import ClassificationTask
 
@@ -124,24 +124,6 @@ def _param_paths(model_name, **kwargs):
     model = timm_tpu.create_model(model_name, **kwargs)
     from timm_tpu.utils.serialization import flatten_pytree
     return flatten_pytree(nnx.state(model, nnx.Param))
-
-
-@pytest.mark.parametrize('model_name,kwargs', [
-    ('test_vit', dict(num_classes=10, img_size=32)),
-    ('vit_tiny_patch16_224', dict(img_size=64)),
-])
-def test_every_vit_param_matches_exactly_one_rule(model_name, kwargs):
-    """The default rule set is disjoint + exhaustive on the ViT family: each
-    param path matches EXACTLY one non-catch-all rule (first-match-wins never
-    has to disambiguate), so placement is auditable from the table alone."""
-    rules = default_partition_rules()
-    specific, catchall = rules[:-1], rules[-1]
-    assert catchall.pattern == '.*'
-    for path in _param_paths(model_name, **kwargs):
-        n = sum(1 for r in specific if r.matches(path))
-        assert n == 1, f'{path} matched {n} rules (expected exactly 1)'
-        idx, rule = match_rule(path, rules)
-        assert rules[idx].matches(path)
 
 
 def test_rule_specs_shard_large_kernels_replicate_small(mesh8):
@@ -264,59 +246,10 @@ def test_eval_after_donated_train_step(mesh8):
     assert np.isfinite(np.asarray(out)).all() and np.isfinite(np.asarray(out_ema)).all()
 
 
-def test_task_jits_declare_donation_or_reason():
-    """Lint: every jax.jit/nnx.jit call in timm_tpu/task/ must declare
-    donate_argnums or carry an explicit `# no-donate:` reason — the PERF.md
-    item-3a regression (donation landed in bench only, never in the real
-    step) cannot silently return."""
-    task_dir = os.path.join(REPO_ROOT, 'timm_tpu', 'task')
-    pattern = re.compile(r'(?:jax|nnx)\.jit\s*\(')
-    violations = []
-    for fname in sorted(os.listdir(task_dir)):
-        if not fname.endswith('.py'):
-            continue
-        with open(os.path.join(task_dir, fname)) as f:
-            lines = f.read().splitlines()
-        for i, line in enumerate(lines):
-            if not pattern.search(line.split('#')[0]):
-                continue
-            window = '\n'.join(lines[max(0, i - 3):i + 12])
-            if 'donate_argnums' not in window and 'no-donate:' not in window:
-                violations.append(f'{fname}:{i + 1}: {line.strip()}')
-    assert not violations, (
-        'jit call(s) in timm_tpu/task/ without donate_argnums or a '
-        f'`# no-donate: <reason>` comment:\n' + '\n'.join(violations))
-
-
-@pytest.mark.perfbudget
-def test_donation_asserted_on_compiled_executables(mesh8):
-    """Donation lint on the COMPILED artifacts, not `donate_argnums` presence
-    in source (the regex lint above can't see a donation that XLA dropped).
-
-    Train step: params/opt/EMA outputs match their donated inputs, so the
-    AOT executable's HLO header must carry a real input_output_alias table.
-    Serve engine: the bucket programs' input donation must provably reach
-    lowering — on CPU the logits are smaller than the donated image batch,
-    so the evidence is jax's "not usable" lowering warning (emitted only for
-    declared donors) rather than an alias entry."""
-    from timm_tpu.perfbudget import donation_evidence
-    from timm_tpu.serve import InferenceEngine
-
-    task = _make_task(mesh8, opt='adamw')
-    compiled = task.lower_train_step(_batch(mesh8), lr=0.1)
-    evidence = donation_evidence(compiled)
-    assert evidence['aliases'] > 0, \
-        'train step compiled with an empty input_output_alias table — donation died'
-
-    eng = InferenceEngine(buckets=(2, 4))
-    eng.add_model('test_vit', num_classes=10, img_size=32)
-    assert set(eng.aot_executables('test_vit')) == {2, 4}, \
-        'prewarm left bucket programs without AOT executables'
-    report = eng.donation_report('test_vit')
-    for bucket, rec in report.items():
-        assert rec['declared'], (
-            f'bucket {bucket} input donation never reached lowering '
-            f'(donate_argnums dropped from _bucket_jit?): {rec}')
+# The in-test donation lints that lived here (source regex over timm_tpu/task/
+# and donation_evidence on compiled artifacts) are now analysis rules
+# `donation-declared` (Tier A) and `donation-alias` (Tier C) — see
+# timm_tpu/analysis and tests/test_analysis.py.
 
 
 # ---- scanned grad accumulation ----------------------------------------------
@@ -510,30 +443,8 @@ def test_shard_batch_3axis_error_names_axes_and_nearest_batch(mesh8):
     assert 'Nearest legal global batch: 8 or 16' in msg
 
 
-def test_tp_rules_disjoint_and_every_model_rule_exercised():
-    """Satellite lint: under tp>1 the rule table stays disjoint + exhaustive
-    on test_vit, and each of the four 'model'-axis rules shards at least one
-    real param over 'model' (a rule nothing exercises is dead weight that
-    would silently rot)."""
-    mesh = _tp_mesh()
-    rules = default_partition_rules()
-    specific = rules[:-1]
-    paths = _param_paths('test_vit', num_classes=10, img_size=32)
-    for path in paths:
-        n = sum(1 for r in specific if r.matches(path))
-        assert n == 1, f'{path} matched {n} non-catch-all rules under tp'
-    specs = path_specs(paths, mesh)
-    by_rule = {}
-    for path in paths:
-        _, rule = match_rule(path, rules)
-        by_rule.setdefault(rule.name, []).append(path)
-    for rname in ('attn-qkv', 'attn-out', 'mlp-fc1', 'mlp-fc2'):
-        hit = [p for p in by_rule.get(rname, ())
-               if any(ax == 'model' for ax in specs[p])]
-        assert hit, f"tp rule {rname!r} not exercised by any test_vit param"
-    # 2-D sharding: the tp kernels also carry 'fsdp' on the other dim
-    qkv = specs['blocks.0.attn.qkv.kernel']
-    assert 'model' in tuple(qkv) and 'fsdp' in tuple(qkv), qkv
+# The tp disjoint/exhaustive + every-model-rule-exercised lint is now the
+# analysis rule `partition-rules` (timm_tpu/analysis/source_rules.py).
 
 
 def test_tp1_specs_bit_identical_to_fsdp_only():

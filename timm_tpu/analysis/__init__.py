@@ -1,0 +1,32 @@
+"""timm_tpu.analysis — the unified static-analysis suite.
+
+One rule registry, three analyzer tiers, one report/waiver/CLI spine:
+
+  * **Tier A** (source/AST): donation-declared, partition-rules,
+    kernel-registered, fp32-softmax, silent-except, host-sync,
+    traced-branch, pragma-syntax;
+  * **Tier B** (jaxpr): large-literal (>1 MB baked constants in traced
+    programs), dtype-promotion, zoo-abstract-trace;
+  * **Tier C** (compiled HLO): donation-alias, replicated-residual,
+    baked-constant — verdicts over every captured perfbudget probe program.
+
+Waivers use ``# timm-tpu-lint: disable=<rule> <reason>`` (pragmas.py; the
+historical ``# no-donate:`` / ``# no-kernel-registry:`` spellings still
+work). CLI: ``python -m timm_tpu.analysis [--rules ...] [--json out.json]``
+— exit 0 clean / 2 violations / 3 internal error.
+"""
+from .pragmas import FilePragmas
+from .registry import (
+    AnalysisContext, DEFAULT_PROBE_NAMES, Rule, all_rules, ensure_registered,
+    get, register, rule, run_analysis, select,
+)
+from .report import (
+    EXIT_CLEAN, EXIT_ERROR, EXIT_VIOLATIONS, Finding, Report,
+)
+
+__all__ = [
+    'AnalysisContext', 'DEFAULT_PROBE_NAMES', 'FilePragmas', 'Finding',
+    'Report', 'Rule', 'EXIT_CLEAN', 'EXIT_ERROR', 'EXIT_VIOLATIONS',
+    'all_rules', 'ensure_registered', 'get', 'register', 'rule',
+    'run_analysis', 'select',
+]

@@ -1,0 +1,148 @@
+"""Tier C — compiled-HLO rules: verdicts on the artifact XLA emitted.
+
+GSPMD makes the compiled program, not the source, the ground truth: a
+`donate_argnums` the compiler dropped, a residual it replicated, a constant
+it baked — none of those are visible in source or jaxpr. These passes
+generalize the one-off checks that caught each of those by hand (PR 8's
+dropped donation, PR 6's replicated residual, PR 9's baked batch) into
+verdicts over EVERY captured probe program: train, serve AOT ladder, quant,
+augment, naflex, and elastic.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .registry import AnalysisContext, rule
+from .report import Finding
+
+__all__ = ['BAKED_CONSTANT_BYTES', 'large_hlo_constants', 'hlo_text']
+
+BAKED_CONSTANT_BYTES = 1 << 20  # 1 MB
+
+# `name = f32[512,1024]{1,0} constant({...})` — dims group empty for scalars
+_CONST_RE = re.compile(r'=\s*([a-z]\w*)\[([\d,]*)\][^ ]*\s+constant\(')
+
+_DTYPE_BYTES = {
+    'pred': 1, 's8': 1, 'u8': 1, 's4': 1, 'u4': 1,
+    's16': 2, 'u16': 2, 'bf16': 2, 'f16': 2,
+    's32': 4, 'u32': 4, 'f32': 4,
+    's64': 8, 'u64': 8, 'f64': 8, 'c64': 8, 'c128': 16,
+}
+
+
+def hlo_text(compiled) -> str:
+    try:
+        return compiled.as_text() if hasattr(compiled, 'as_text') else ''
+    except Exception:
+        return ''
+
+
+def large_hlo_constants(text: str,
+                        threshold: int = BAKED_CONSTANT_BYTES
+                        ) -> List[Tuple[int, str]]:
+    """(nbytes, 'dtype[dims]') for every HLO constant op over `threshold`."""
+    out = []
+    for m in _CONST_RE.finditer(text):
+        dtype, dims = m.group(1), m.group(2)
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(','):
+            if d:
+                n *= int(d)
+        nbytes = n * _DTYPE_BYTES[dtype]
+        if nbytes > threshold:
+            out.append((nbytes, f'{dtype}[{dims}]'))
+    return out
+
+
+def _programs(ctx: AnalysisContext, compiled_only: bool = True) -> List[Dict]:
+    return [rec for rec in ctx.ensure_programs()
+            if not compiled_only or rec.get('compiled') is not None]
+
+
+@rule('donation-alias', 'C',
+      'donation on the COMPILED artifacts, not donate_argnums presence: '
+      'train-style programs must carry a real input_output_alias table; '
+      'serve bucket programs must show the donation reached lowering',
+      needs_programs=True)
+def donation_alias(ctx: AnalysisContext) -> List[Finding]:
+    from ..perfbudget.probe import donation_evidence
+
+    findings = []
+    checked = 0
+    for rec in _programs(ctx, compiled_only=False):
+        expect = rec.get('expect', {})
+        donation = expect.get('donation')
+        if donation == 'alias':
+            checked += 1
+            ev = donation_evidence(rec['compiled'])
+            if ev['aliases'] <= 0:
+                findings.append(Finding(
+                    'donation-alias', rec['name'], 0,
+                    'compiled with an empty input_output_alias table — '
+                    'XLA silently dropped the declared donation'))
+        elif donation == 'declared':
+            checked += 1
+            if not expect.get('declared'):
+                findings.append(Finding(
+                    'donation-alias', rec['name'], 0,
+                    'input donation never reached lowering '
+                    '(donate_argnums dropped before compile)'))
+    if checked == 0:
+        findings.append(Finding(
+            'donation-alias', '<capture>', 0,
+            'no captured program carries a donation expectation — the '
+            'probe capture hook is disconnected'))
+    return findings
+
+
+@rule('replicated-residual', 'C',
+      'tp forward programs keep the residual stream sharded: the per-device '
+      'residual shape appears in the HLO and the full (replicated) shape '
+      'never materializes (the PR 6 involuntary-remat regression)',
+      needs_programs=True)
+def replicated_residual(ctx: AnalysisContext) -> List[Finding]:
+    findings = []
+    checked = 0
+    for rec in _programs(ctx):
+        expect = rec.get('expect', {})
+        shard = expect.get('expect_shard')
+        if not shard:
+            continue
+        checked += 1
+        text = hlo_text(rec['compiled'])
+        if shard not in text:
+            findings.append(Finding(
+                'replicated-residual', rec['name'], 0,
+                f'per-device residual shape {shard} missing from the '
+                f'compiled HLO — GSPMD is not sharding the residual'))
+        forbid = expect.get('forbid_full')
+        if forbid and forbid in text:
+            findings.append(Finding(
+                'replicated-residual', rec['name'], 0,
+                f'full residual shape {forbid} materialized in the '
+                f'compiled HLO (replicated residual / involuntary remat)'))
+    if checked == 0:
+        findings.append(Finding(
+            'replicated-residual', '<capture>', 0,
+            'no captured program carries a residual-sharding expectation — '
+            'include the tp forward probe (tp22) in the capture'))
+    return findings
+
+
+@rule('baked-constant', 'C',
+      'no compiled probe program embeds a constant > 1 MB — the HLO-level '
+      'twin of the Tier B large-literal pass (catches constants XLA '
+      'materializes after optimization, not just traced literals)',
+      needs_programs=True)
+def baked_constant(ctx: AnalysisContext) -> List[Finding]:
+    findings = []
+    for rec in _programs(ctx):
+        for nbytes, desc in large_hlo_constants(hlo_text(rec['compiled'])):
+            findings.append(Finding(
+                'baked-constant', rec['name'], 0,
+                f'compiled HLO embeds constant {desc} = '
+                f'{nbytes / 1e6:.1f} MB'))
+    return findings

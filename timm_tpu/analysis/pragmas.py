@@ -1,0 +1,121 @@
+"""The unified waiver pragma + back-compat shims.
+
+Unified syntax (any source line)::
+
+    # timm-tpu-lint: disable=<rule>[,<rule2>] <reason>
+
+Placement decides scope:
+
+  * trailing on a code line       -> waives findings anchored to THAT line;
+  * on its own comment line       -> waives findings on the NEXT line;
+  * within the first 5 file lines -> waives the rule file-wide.
+
+A reason is mandatory — a reasonless pragma waives nothing and is itself a
+finding (rule ``pragma-syntax``), so waivers can't silently accrete.
+
+Back-compat shims (pre-existing waiver spellings, kept verbatim so no
+call-site churn was needed when the lints moved out of tests/):
+
+  * ``# no-donate: <reason>``          == disable=donation-declared
+  * ``# no-kernel-registry: <reason>`` == disable=kernel-registered
+    (first 5 lines of a kernel module, exactly as before)
+"""
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ['FilePragmas', 'PRAGMA_PREFIX', 'SHIMS', 'MODULE_SCOPE_LINES']
+
+PRAGMA_PREFIX = '# timm-tpu-lint:'
+MODULE_SCOPE_LINES = 5
+
+_PRAGMA_RE = re.compile(r'#\s*timm-tpu-lint:\s*(.*)$')
+_DISABLE_RE = re.compile(r'disable=([\w,.-]+)\s*(.*)$', re.DOTALL)
+
+# shim comment prefix -> rule it waives (same scoping as the unified pragma)
+SHIMS = {
+    '# no-donate:': 'donation-declared',
+    '# no-kernel-registry:': 'kernel-registered',
+}
+
+
+class FilePragmas:
+    """Parsed waivers for one source file's text."""
+
+    def __init__(self, text: str, path: str = '<text>'):
+        self.path = path
+        # lineno -> {rule: reason}
+        self.line_waivers: Dict[int, Dict[str, str]] = {}
+        self.module_waivers: Dict[str, str] = {}
+        # (lineno, message) — fed to the pragma-syntax rule
+        self.malformed: List[Tuple[int, str]] = []
+        self._parse(text)
+
+    def _record(self, lineno: int, standalone: bool, rules: List[str],
+                reason: str) -> None:
+        if lineno <= MODULE_SCOPE_LINES:
+            for r in rules:
+                self.module_waivers.setdefault(r, reason)
+            return
+        target = lineno + 1 if standalone else lineno
+        slot = self.line_waivers.setdefault(target, {})
+        for r in rules:
+            slot.setdefault(r, reason)
+
+    @staticmethod
+    def _iter_comments(text: str) -> Iterable[Tuple[int, str]]:
+        """(lineno, comment_text) for every REAL comment token — pragma
+        spellings inside strings/docstrings are not pragmas."""
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # unparseable (partial fixture files): raw line scan fallback
+            for lineno, line in enumerate(text.splitlines(), 1):
+                idx = line.find('#')
+                if idx >= 0:
+                    yield lineno, line[idx:]
+
+    def _parse(self, text: str) -> None:
+        lines = text.splitlines()
+        for lineno, line in self._iter_comments(text):
+            src = lines[lineno - 1] if lineno <= len(lines) else line
+            standalone = src.strip().startswith('#')
+            m = _PRAGMA_RE.search(line)
+            if m:
+                body = m.group(1).strip()
+                dm = _DISABLE_RE.match(body)
+                if not dm:
+                    self.malformed.append(
+                        (lineno, f'malformed pragma (expected '
+                                 f'"disable=<rule> <reason>"): {line.strip()}'))
+                    continue
+                rules = [r for r in dm.group(1).split(',') if r]
+                reason = dm.group(2).strip()
+                if not reason:
+                    self.malformed.append(
+                        (lineno, f'pragma waives {",".join(rules)} without a '
+                                 f'reason — reasons are mandatory'))
+                    continue
+                self._record(lineno, standalone, rules, reason)
+                continue
+            for prefix, rule in SHIMS.items():
+                idx = line.find(prefix)
+                if idx < 0:
+                    continue
+                reason = line[idx + len(prefix):].strip()
+                if not reason:
+                    self.malformed.append(
+                        (lineno, f'{prefix!r} waiver without a reason'))
+                    continue
+                self._record(lineno, standalone, [rule], reason)
+
+    def waiver_for(self, rule: str, lineno: int = 0) -> Optional[str]:
+        """Reason string if `rule` is waived at `lineno` (or file-wide)."""
+        if lineno and rule in self.line_waivers.get(lineno, ()):
+            return self.line_waivers[lineno][rule]
+        return self.module_waivers.get(rule)

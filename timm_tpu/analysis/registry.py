@@ -1,0 +1,214 @@
+"""Rule registry + the AnalysisContext every rule runs against.
+
+Three tiers share this spine:
+
+  * **A** — AST/source rules: pure text/AST, no jax work, always cheap;
+  * **B** — jaxpr rules: walk traced programs (captured from the perfbudget
+    probes, or traced abstractly) before XLA sees them;
+  * **C** — compiled-HLO rules: verdicts on the artifacts XLA actually
+    emitted — the ground truth GSPMD leaves us (PAPERS.md [2]).
+
+Tier B/C rules declare ``needs_programs``: they consume the jaxprs and
+compiled executables the perfbudget probes already lower, captured via
+:func:`timm_tpu.perfbudget.probe.capture_programs` so nothing is lowered
+twice. ``ctx.ensure_programs()`` lowers on demand only when the caller did
+not inject a capture (the CLI path); the tier-1 session fixture injects the
+capture it shares with the perf-budget comparisons.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .pragmas import FilePragmas
+from .report import Finding, Report
+
+__all__ = ['Rule', 'AnalysisContext', 'register', 'rule', 'all_rules', 'get',
+           'select', 'ensure_registered', 'run_analysis',
+           'DEFAULT_PROBE_NAMES']
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+# every probe config whose programs feed Tier B/C in a full CLI run: train
+# (base), accum trace, tp forward (replicated-residual), serve AOT ladder,
+# quant serve, on-device augment, naflex packed step, and elastic resize
+DEFAULT_PROBE_NAMES: Tuple[str, ...] = (
+    'base', 'accum4', 'tp22', 'serve_test_vit', 'quant_serve_int8',
+    'device_augment', 'naflex_packed', 'elastic_resize',
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    tier: str                      # 'A' | 'B' | 'C'
+    description: str
+    fn: Callable[['AnalysisContext'], List[Finding]]
+    needs_programs: bool = False   # consumes captured probe programs
+    needs_devices: int = 1         # minimum jax device count (mesh rules)
+
+
+_RULES: Dict[str, Rule] = {}
+_TIERS = ('A', 'B', 'C')
+
+
+def register(r: Rule) -> Rule:
+    if r.tier not in _TIERS:
+        raise ValueError(f'unknown tier {r.tier!r} for rule {r.name!r}')
+    if r.name in _RULES:
+        raise ValueError(f'rule {r.name!r} already registered')
+    _RULES[r.name] = r
+    return r
+
+
+def rule(name: str, tier: str, description: str, **kw):
+    """Decorator: register `fn` as a Rule."""
+    def deco(fn):
+        register(Rule(name=name, tier=tier, description=description,
+                      fn=fn, **kw))
+        return fn
+    return deco
+
+
+def ensure_registered() -> None:
+    from . import hlo_rules, jaxpr_rules, source_rules, zoo  # noqa: F401
+
+
+def all_rules() -> Tuple[Rule, ...]:
+    ensure_registered()
+    return tuple(sorted(_RULES.values(), key=lambda r: (r.tier, r.name)))
+
+
+def get(name: str) -> Rule:
+    ensure_registered()
+    if name not in _RULES:
+        raise KeyError(f'unknown rule {name!r} '
+                       f'(known: {sorted(_RULES)})')
+    return _RULES[name]
+
+
+def select(names: Optional[Sequence[str]] = None,
+           tiers: Optional[Sequence[str]] = None) -> List[Rule]:
+    rules = list(all_rules())
+    if names is not None:
+        unknown = set(names) - {r.name for r in rules}
+        if unknown:
+            raise KeyError(f'unknown rule(s): {sorted(unknown)} '
+                           f'(known: {sorted(r.name for r in rules)})')
+        rules = [r for r in rules if r.name in set(names)]
+    if tiers is not None:
+        bad = set(tiers) - set(_TIERS)
+        if bad:
+            raise KeyError(f'unknown tier(s): {sorted(bad)}')
+        rules = [r for r in rules if r.tier in set(tiers)]
+    return rules
+
+
+class AnalysisContext:
+    """Everything a rule may consult: the source root, parsed pragmas, and
+    the captured probe programs (Tier B/C)."""
+
+    def __init__(self, root: Optional[str] = None,
+                 programs: Optional[List[Dict]] = None,
+                 probe_names: Optional[Sequence[str]] = None,
+                 zoo_families: Optional[Sequence[str]] = None,
+                 log: Optional[Callable[[str], None]] = None):
+        self.root = os.path.abspath(root or REPO_ROOT)
+        self.programs = programs
+        self.probe_names = tuple(probe_names or DEFAULT_PROBE_NAMES)
+        self.zoo_families = tuple(zoo_families) if zoo_families else None
+        self.log = log or (lambda msg: None)
+        self._pragmas: Dict[str, FilePragmas] = {}
+        self._asts: Dict[str, object] = {}
+
+    # ---- source-file access -------------------------------------------------
+
+    def source_dir(self, *rel: str) -> str:
+        """`<root>/<rel...>` if it exists, else the root itself — so the same
+        rule scans the real package on the repo and a flat directory of
+        planted fixtures under tests/."""
+        path = os.path.join(self.root, *rel)
+        return path if os.path.isdir(path) else self.root
+
+    def source_files(self, *rel: str) -> List[str]:
+        d = self.source_dir(*rel)
+        return [os.path.join(d, f) for f in sorted(os.listdir(d))
+                if f.endswith('.py')]
+
+    def walk_files(self, *rel: str) -> List[str]:
+        """All .py files under `<root>/<rel...>` (or the root), recursively."""
+        top = self.source_dir(*rel)
+        out = []
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames if d != '__pycache__')
+            out.extend(os.path.join(dirpath, f) for f in sorted(filenames)
+                       if f.endswith('.py'))
+        return out
+
+    def read(self, path: str) -> str:
+        with open(path, encoding='utf-8') as f:
+            return f.read()
+
+    def pragmas(self, path: str) -> FilePragmas:
+        if path not in self._pragmas:
+            self._pragmas[path] = FilePragmas(self.read(path), path=path)
+        return self._pragmas[path]
+
+    def ast_of(self, path: str):
+        """Parsed AST, cached across rules (host-sync and traced-branch walk
+        the same trees); None for unparseable files."""
+        import ast as ast_mod
+        if path not in self._asts:
+            try:
+                self._asts[path] = ast_mod.parse(self.read(path))
+            except SyntaxError:
+                self._asts[path] = None
+        return self._asts[path]
+
+    def rel(self, path: str) -> str:
+        try:
+            return os.path.relpath(path, self.root)
+        except ValueError:
+            return path
+
+    def finding(self, rule_name: str, path: str, line: int,
+                message: str) -> Finding:
+        """Build a Finding, applying any pragma waiver at (path, line)."""
+        reason = self.pragmas(path).waiver_for(rule_name, line)
+        return Finding(rule=rule_name, path=self.rel(path), line=line,
+                       message=message, waived=reason is not None,
+                       waive_reason=reason or '')
+
+    # ---- captured probe programs (Tier B/C) ---------------------------------
+
+    def ensure_programs(self) -> List[Dict]:
+        if self.programs is None:
+            from ..perfbudget.probe import capture_programs, run_matrix
+            self.log(f'analysis: lowering probe programs '
+                     f'{",".join(self.probe_names)}')
+            with capture_programs() as captured:
+                run_matrix(names=list(self.probe_names), log=self.log)
+            self.programs = list(captured)
+        return self.programs
+
+
+def run_analysis(ctx: AnalysisContext,
+                 rules: Optional[Sequence[Rule]] = None) -> Report:
+    """Run `rules` (default: all registered) against `ctx` -> Report.
+
+    A rule that raises is recorded as an internal error (exit 3) — an
+    analyzer crash must never read as a clean repo."""
+    report = Report()
+    for r in (rules if rules is not None else all_rules()):
+        t0 = time.perf_counter()
+        try:
+            findings = list(r.fn(ctx))
+            error = None
+        except Exception as e:  # noqa: BLE001 - reported as exit-3 error
+            findings, error = [], f'{type(e).__name__}: {e}'
+        report.add(r.name, findings, time.perf_counter() - t0, error=error)
+        ctx.log(f'analysis rule {r.name}: {report.rules[r.name]["status"]} '
+                f'({report.rules[r.name]["wall_s"]}s)')
+    return report

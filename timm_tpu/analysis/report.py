@@ -1,0 +1,131 @@
+"""Finding/Report spine shared by every analyzer tier.
+
+One :class:`Finding` = one rule hit at one location (a source line, a
+captured program, or a model family). Waived findings stay in the report —
+the waiver and its reason are part of the audit trail — but only unwaived
+findings count as violations and drive the exit code.
+
+Exit-code contract (pinned by tests/test_analysis.py):
+
+  * 0 — every selected rule ran and produced no unwaived finding;
+  * 2 — at least one unwaived finding (violations);
+  * 3 — a rule raised (internal error) — the run is NOT evidence of a clean
+    repo, so it must never be conflated with exit 2.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, List, Optional
+
+__all__ = ['Finding', 'Report', 'EXIT_CLEAN', 'EXIT_VIOLATIONS', 'EXIT_ERROR']
+
+SCHEMA = 'timm-tpu-analysis/v1'
+EXIT_CLEAN = 0
+EXIT_VIOLATIONS = 2
+EXIT_ERROR = 3
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str                 # source file, captured-program name, or family
+    line: int = 0             # 0 = not line-anchored
+    message: str = ''
+    waived: bool = False
+    waive_reason: str = ''
+
+    @property
+    def location(self) -> str:
+        return f'{self.path}:{self.line}' if self.line else self.path
+
+    def to_dict(self) -> Dict:
+        d = {'rule': self.rule, 'path': self.path, 'line': self.line,
+             'message': self.message}
+        if self.waived:
+            d['waived'] = True
+            d['waive_reason'] = self.waive_reason
+        return d
+
+
+class Report:
+    """Per-rule results + the aggregate verdict."""
+
+    def __init__(self):
+        self.rules: Dict[str, Dict] = {}
+        self.started = time.time()
+
+    def add(self, name: str, findings: List[Finding], wall_s: float,
+            error: Optional[str] = None) -> None:
+        unwaived = [f for f in findings if not f.waived]
+        status = ('error' if error is not None
+                  else 'violations' if unwaived else 'ok')
+        self.rules[name] = {
+            'status': status,
+            'findings': findings,
+            'wall_s': round(wall_s, 3),
+            'error': error,
+        }
+
+    @property
+    def violations(self) -> List[Finding]:
+        return [f for r in self.rules.values() for f in r['findings']
+                if not f.waived]
+
+    @property
+    def waived(self) -> List[Finding]:
+        return [f for r in self.rules.values() for f in r['findings']
+                if f.waived]
+
+    @property
+    def errors(self) -> Dict[str, str]:
+        return {n: r['error'] for n, r in self.rules.items()
+                if r['error'] is not None}
+
+    @property
+    def exit_code(self) -> int:
+        if self.errors:
+            return EXIT_ERROR
+        if self.violations:
+            return EXIT_VIOLATIONS
+        return EXIT_CLEAN
+
+    def to_dict(self) -> Dict:
+        return {
+            'schema': SCHEMA,
+            'exit_code': self.exit_code,
+            'violations': len(self.violations),
+            'waived': len(self.waived),
+            'wall_s': round(time.time() - self.started, 3),
+            'rules': {
+                name: {
+                    'status': r['status'],
+                    'wall_s': r['wall_s'],
+                    'error': r['error'],
+                    'findings': [f.to_dict() for f in r['findings']],
+                }
+                for name, r in self.rules.items()
+            },
+        }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+    def format_text(self) -> str:
+        lines = []
+        for name, r in sorted(self.rules.items()):
+            n_viol = sum(1 for f in r['findings'] if not f.waived)
+            n_waived = len(r['findings']) - n_viol
+            tail = f' ({n_waived} waived)' if n_waived else ''
+            lines.append(f"{r['status']:10s} {name:24s} "
+                         f"{n_viol} violation(s){tail} [{r['wall_s']:.2f}s]")
+            if r['error'] is not None:
+                lines.append(f'           ! {r["error"]}')
+            for f in r['findings']:
+                mark = 'waived' if f.waived else 'FAIL'
+                lines.append(f'           {mark}: {f.location}: {f.message}'
+                             + (f' (waiver: {f.waive_reason})' if f.waived else ''))
+        lines.append(f'analysis: {len(self.violations)} violation(s), '
+                     f'{len(self.waived)} waived, exit {self.exit_code}')
+        return '\n'.join(lines)

@@ -1,0 +1,384 @@
+"""Tier A — AST/source rules.
+
+The five lints that used to live inline in tests/ (donation-declared,
+partition-rules, kernel-registered, fp32-softmax, silent-except) plus the
+new sweeps this PR adds (host-sync, traced-branch, pragma-syntax). All of
+them honor the unified pragma (see pragmas.py); the first four keep their
+historical waiver spellings via the shims.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Set
+
+from .registry import AnalysisContext, rule
+from .report import Finding
+
+# directories of the timm_tpu package swept by the repo-wide source rules
+_PACKAGE = 'timm_tpu'
+
+
+def _lineno(text: str, pos: int) -> int:
+    return text.count('\n', 0, pos) + 1
+
+
+# ---- silent-except (repo-wide; was tests/test_data.py, data/ only) ----------
+
+_SILENT_EXCEPT_RE = re.compile(
+    r'except\s+(Exception|BaseException)?\s*(as\s+\w+)?\s*:\s*\n\s*pass\b')
+
+
+@rule('silent-except', 'A',
+      'no `except [Exception]: pass` anywhere in timm_tpu/ or the top-level '
+      'scripts — transient faults go through the resilience retry policy, '
+      'permanent ones through the poison-skip budget; both log')
+def silent_except(ctx: AnalysisContext) -> List[Finding]:
+    files = list(ctx.walk_files(_PACKAGE))
+    pkg_dir = ctx.source_dir(_PACKAGE)
+    if pkg_dir != ctx.root:
+        # top-level driver scripts ride along (bench.py, train.py, ...)
+        files += [os.path.join(ctx.root, f) for f in sorted(os.listdir(ctx.root))
+                  if f.endswith('.py')]
+    findings = []
+    for path in files:
+        text = ctx.read(path)
+        for m in _SILENT_EXCEPT_RE.finditer(text):
+            line = _lineno(text, m.start())
+            findings.append(ctx.finding(
+                'silent-except', path, line,
+                'silent exception swallow — log it, retry it, or waive '
+                'with a reason'))
+    return findings
+
+
+# ---- fp32-softmax (was tests/test_layers.py) --------------------------------
+
+@rule('fp32-softmax', 'A',
+      'layers must route softmax dtype through config.softmax_with_policy; '
+      'a hard-coded fp32 upcast next to a softmax bypasses '
+      'TIMM_TPU_SOFTMAX_DTYPE (config.py is the one allowed location)')
+def fp32_softmax(ctx: AnalysisContext) -> List[Finding]:
+    findings = []
+    for path in ctx.source_files(_PACKAGE, 'layers'):
+        if os.path.basename(path) == 'config.py':
+            continue
+        for lineno, line in enumerate(ctx.read(path).splitlines(), 1):
+            if 'softmax(' in line and 'float32' in line:
+                findings.append(ctx.finding(
+                    'fp32-softmax', path, lineno,
+                    'hard-coded fp32 softmax outside the policy module '
+                    '(use timm_tpu.layers.softmax_with_policy)'))
+    return findings
+
+
+# ---- donation-declared (was tests/test_sharding.py) -------------------------
+
+_JIT_RE = re.compile(r'(?:jax|nnx)\.jit\s*\(')
+_DONATION_WAIVERS = ('no-donate:', 'timm-tpu-lint: disable=donation-declared')
+
+
+@rule('donation-declared', 'A',
+      'every jax.jit/nnx.jit call in timm_tpu/task/ declares donate_argnums '
+      'or carries an explicit `# no-donate: <reason>` — the PERF.md item-3a '
+      'regression (donation landed in bench only) cannot silently return')
+def donation_declared(ctx: AnalysisContext) -> List[Finding]:
+    findings = []
+    for path in ctx.source_files(_PACKAGE, 'task'):
+        lines = ctx.read(path).splitlines()
+        for i, line in enumerate(lines):
+            if not _JIT_RE.search(line.split('#')[0]):
+                continue
+            window = '\n'.join(lines[max(0, i - 3):i + 12])
+            if ('donate_argnums' in window
+                    or any(w in window for w in _DONATION_WAIVERS)):
+                continue
+            findings.append(ctx.finding(
+                'donation-declared', path, i + 1,
+                f'jit call without donate_argnums or a `# no-donate: '
+                f'<reason>` comment: {line.strip()}'))
+    return findings
+
+
+# ---- kernel-registered (was tests/test_kernels.py) --------------------------
+
+@rule('kernel-registered', 'A',
+      'each .py in timm_tpu/kernels/ registers a KernelSpec whose `module` '
+      'names it, or opens with `# no-kernel-registry: <reason>` in its '
+      'first 5 lines')
+def kernel_registered(ctx: AnalysisContext) -> List[Finding]:
+    from ..kernels import registry as kreg
+    kreg.ensure_registered()
+    registered = {spec.module for spec in kreg.all_specs()}
+    findings = []
+    for path in ctx.source_files(_PACKAGE, 'kernels'):
+        stem = os.path.splitext(os.path.basename(path))[0]
+        if f'{_PACKAGE}.kernels.{stem}' in registered:
+            continue
+        pragmas = ctx.pragmas(path)
+        reason = pragmas.waiver_for('kernel-registered')
+        if reason:
+            continue
+        findings.append(ctx.finding(
+            'kernel-registered', path, 1,
+            f'{stem}.py defines no registered kernel and carries no '
+            f'`# no-kernel-registry: <reason>` waiver '
+            f'(registered modules: {sorted(registered)})'))
+    return findings
+
+
+# ---- partition-rules (was tests/test_sharding.py, 2 tests) ------------------
+
+@rule('partition-rules', 'A',
+      'the default rule table stays disjoint + exhaustive on the ViT family '
+      '(each param path matches exactly one non-catch-all rule), and under '
+      'tp>1 every model-axis rule shards at least one real param',
+      needs_devices=4)
+def partition_rules(ctx: AnalysisContext) -> List[Finding]:
+    from flax import nnx
+
+    import timm_tpu
+    from ..parallel import (
+        create_mesh, default_partition_rules, match_rule, path_specs,
+    )
+    from ..utils.serialization import flatten_pytree
+
+    findings: List[Finding] = []
+    rules = default_partition_rules()
+    specific, catchall = rules[:-1], rules[-1]
+    if catchall.pattern != '.*':
+        findings.append(Finding('partition-rules', 'parallel/rules', 0,
+                                'last rule is not the catch-all'))
+        return findings
+
+    def paths_for(model_name, **kwargs):
+        model = timm_tpu.create_model(model_name, **kwargs)
+        return flatten_pytree(nnx.state(model, nnx.Param))
+
+    # disjoint + exhaustive: first-match-wins never has to disambiguate
+    for model_name, kwargs in (
+            ('test_vit', dict(num_classes=10, img_size=32)),
+            ('vit_tiny_patch16_224', dict(img_size=64))):
+        for path in paths_for(model_name, **kwargs):
+            n = sum(1 for r in specific if r.matches(path))
+            if n != 1:
+                findings.append(Finding(
+                    'partition-rules', f'{model_name}:{path}', 0,
+                    f'matched {n} non-catch-all rules (expected exactly 1)'))
+
+    # tp exercise: each of the four model-axis rules shards >=1 real param,
+    # and the tp kernels also carry fsdp on the other dim (2-D sharding)
+    mesh = create_mesh(fsdp=2, tp=2)
+    paths = paths_for('test_vit', num_classes=10, img_size=32)
+    specs = path_specs(paths, mesh)
+    by_rule: Dict[str, List[str]] = {}
+    for path in paths:
+        _, r = match_rule(path, rules)
+        by_rule.setdefault(r.name, []).append(path)
+    for rname in ('attn-qkv', 'attn-out', 'mlp-fc1', 'mlp-fc2'):
+        hit = [p for p in by_rule.get(rname, ())
+               if any(ax == 'model' for ax in specs[p])]
+        if not hit:
+            findings.append(Finding(
+                'partition-rules', f'rule:{rname}', 0,
+                'tp rule not exercised by any test_vit param '
+                '(dead weight that would silently rot)'))
+    qkv = tuple(specs.get('blocks.0.attn.qkv.kernel', ()))
+    if 'model' not in qkv or 'fsdp' not in qkv:
+        findings.append(Finding(
+            'partition-rules', 'blocks.0.attn.qkv.kernel', 0,
+            f'tp kernel not 2-D sharded (got spec {qkv})'))
+    return findings
+
+
+# ---- host-sync + traced-branch (new AST sweeps) -----------------------------
+
+def _is_jit_attr(node) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr == 'jit'
+
+
+def _has_jit_decorator(fn) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if _is_jit_attr(target):
+            return True
+        if (isinstance(dec, ast.Call) and dec.args
+                and _is_jit_attr(dec.args[0])):
+            return True  # @partial(jax.jit, ...)
+    return False
+
+
+def _scoped_children(node):
+    """(defs, other_nodes) whose nearest enclosing scope is `node` — the
+    walk stops at nested function/class boundaries."""
+    defs, others = [], []
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        ch = stack.pop()
+        if isinstance(ch, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            defs.append(ch)
+        else:
+            others.append(ch)
+            stack.extend(ast.iter_child_nodes(ch))
+    return defs, others
+
+
+def _jitted_functions(tree: ast.Module) -> List[ast.FunctionDef]:
+    """Function defs that are jit boundaries: decorated with *.jit (possibly
+    through functools.partial), or passed by name to a jax.jit/nnx.jit call.
+    Names resolve lexically — `jax.jit(step)` binds to the `step` visible
+    from the call site, so a jitted inner function never implicates an
+    outer method that happens to share its name."""
+    out: List[ast.FunctionDef] = []
+    seen: Set[int] = set()
+
+    def flag(fn) -> None:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append(fn)
+
+    def visit(node, env: Dict[str, ast.FunctionDef]) -> None:
+        defs, others = _scoped_children(node)
+        env = dict(env)
+        env.update({d.name: d for d in defs
+                    if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))})
+        for o in others:
+            if (isinstance(o, ast.Call) and _is_jit_attr(o.func)
+                    and o.args and isinstance(o.args[0], ast.Name)
+                    and o.args[0].id in env):
+                flag(env[o.args[0].id])
+        for d in defs:
+            if (isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and _has_jit_decorator(d)):
+                flag(d)
+            visit(d, env)
+
+    visit(tree, {})
+    return sorted(out, key=lambda f: f.lineno)
+
+
+def _param_names(fn: ast.FunctionDef) -> Set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    return {n for n in names if n not in ('self', 'cls')}
+
+
+_HOST_SYNC_NP_CALLS = {'asarray', 'array'}
+
+
+def _host_sync_hits(fn: ast.FunctionDef) -> Iterable[ast.Call]:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == 'item':
+            yield node
+        elif (isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name)
+              and f.value.id in ('np', 'numpy')
+              and f.attr in _HOST_SYNC_NP_CALLS):
+            yield node
+        elif (isinstance(f, ast.Name) and f.id in ('float', 'int')
+              and node.args
+              and not isinstance(node.args[0], ast.Constant)):
+            yield node
+
+
+@rule('host-sync', 'A',
+      'no host-synchronizing call (`.item()`, `np.asarray`/`np.array`, '
+      '`float()`/`int()` on a non-literal) inside a jitted function body — '
+      'under jit these either fail on tracers or force a device sync')
+def host_sync(ctx: AnalysisContext) -> List[Finding]:
+    findings = []
+    for path in ctx.walk_files(_PACKAGE):
+        tree = ctx.ast_of(path)
+        if tree is None:
+            continue
+        for fn in _jitted_functions(tree):
+            for call in _host_sync_hits(fn):
+                findings.append(ctx.finding(
+                    'host-sync', path, call.lineno,
+                    f'host-sync call inside jitted `{fn.name}` '
+                    f'(traced values cannot leave the device here)'))
+    return findings
+
+
+_STATIC_ATTRS = ('shape', 'ndim', 'dtype', 'size')
+_STATIC_CALLS = ('len', 'isinstance', 'getattr', 'hasattr', 'callable')
+
+
+def _hazardous_params(test: ast.expr, params: Set[str]) -> Set[str]:
+    """Param names whose runtime VALUE the test consults. Static uses branch
+    at trace time and are skipped: `x is None`, `x.shape`/`.ndim`/`.dtype`/
+    `.size`, `len(x)`, `isinstance(x, ...)`."""
+    hazards: Set[str] = set()
+
+    class _V(ast.NodeVisitor):
+        def visit_Attribute(self, node):
+            if (isinstance(node.value, ast.Name)
+                    and node.attr in _STATIC_ATTRS):
+                return
+            self.generic_visit(node)
+
+        def visit_Call(self, node):
+            if isinstance(node.func, ast.Name) and node.func.id in _STATIC_CALLS:
+                return
+            self.generic_visit(node)
+
+        def visit_Compare(self, node):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return
+            self.generic_visit(node)
+
+        def visit_Name(self, node):
+            if node.id in params:
+                hazards.add(node.id)
+
+    _V().visit(test)
+    return hazards
+
+
+def _branch_hits(fn: ast.FunctionDef) -> Iterable[ast.stmt]:
+    params = _param_names(fn)
+    for node in ast.walk(fn):
+        if (isinstance(node, (ast.If, ast.While))
+                and _hazardous_params(node.test, params)):
+            yield node
+
+
+@rule('traced-branch', 'A',
+      'no Python `if`/`while` on a traced argument value inside a jitted '
+      'function — the branch freezes at trace time (or raises '
+      'TracerBoolConversionError); use lax.cond/jnp.where')
+def traced_branch(ctx: AnalysisContext) -> List[Finding]:
+    findings = []
+    for path in ctx.walk_files(_PACKAGE):
+        tree = ctx.ast_of(path)
+        if tree is None:
+            continue
+        for fn in _jitted_functions(tree):
+            for stmt in _branch_hits(fn):
+                findings.append(ctx.finding(
+                    'traced-branch', path, stmt.lineno,
+                    f'Python branch on a traced argument inside jitted '
+                    f'`{fn.name}` — this freezes at trace time; use '
+                    f'lax.cond / jnp.where'))
+    return findings
+
+
+# ---- pragma-syntax ----------------------------------------------------------
+
+@rule('pragma-syntax', 'A',
+      'every `# timm-tpu-lint:` pragma and waiver shim parses and carries a '
+      'reason — reasonless waivers waive nothing')
+def pragma_syntax(ctx: AnalysisContext) -> List[Finding]:
+    findings = []
+    for path in ctx.walk_files(_PACKAGE):
+        for lineno, msg in ctx.pragmas(path).malformed:
+            findings.append(Finding('pragma-syntax', ctx.rel(path),
+                                    lineno, msg))
+    return findings

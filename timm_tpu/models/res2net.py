@@ -52,12 +52,14 @@ class Bottle2neck(nnx.Module):
             act_layer='relu',
             norm_layer: Callable = BatchNormAct2d,
             attn_layer: Optional[Callable] = None,
+            aa_layer: Optional[Callable] = None,
             drop_path: float = 0.0,
             *,
             dtype=None,
             param_dtype=jnp.float32,
             rngs: nnx.Rngs,
     ):
+        assert aa_layer is None, 'aa_layer not supported by Bottle2neck'
         self.scale = scale
         self.is_first = stride > 1 or downsample is not None
         self.num_scales = max(1, scale - 1)

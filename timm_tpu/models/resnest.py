@@ -39,10 +39,11 @@ class ResNestBottleneck(nnx.Module):
                  cardinality=1, base_width=64, avd=False, avd_first=False,
                  reduce_first=1, dilation=1, first_dilation=None,
                  act_layer='relu', norm_layer: Callable = BatchNormAct2d,
-                 attn_layer=None, drop_path=0.0,
+                 attn_layer=None, aa_layer=None, drop_path=0.0,
                  *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
         assert reduce_first == 1
         assert attn_layer is None, 'attn_layer not supported by ResNestBottleneck'
+        assert aa_layer is None, 'aa_layer not supported by ResNestBottleneck'
         group_width = int(planes * (base_width / 64.0)) * cardinality
         first_dilation = first_dilation or dilation
         # reference passes is_first per block; it's exactly "this block has a

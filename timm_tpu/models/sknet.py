@@ -29,9 +29,10 @@ class SelectiveKernelBasic(nnx.Module):
     def __init__(self, inplanes, planes, stride=1, downsample=None, cardinality=1,
                  base_width=64, sk_kwargs=None, reduce_first=1, dilation=1,
                  first_dilation=None, act_layer='relu', norm_layer: Callable = BatchNormAct2d,
-                 attn_layer=None, drop_path=0.0,
+                 attn_layer=None, aa_layer=None, drop_path=0.0,
                  *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
         sk_kwargs = sk_kwargs or {}
+        assert aa_layer is None, 'aa_layer not supported by SelectiveKernelBasic'
         assert cardinality == 1 and base_width == 64
         first_planes = planes // reduce_first
         outplanes = planes * self.expansion
@@ -71,9 +72,10 @@ class SelectiveKernelBottleneck(nnx.Module):
     def __init__(self, inplanes, planes, stride=1, downsample=None, cardinality=1,
                  base_width=64, sk_kwargs=None, reduce_first=1, dilation=1,
                  first_dilation=None, act_layer='relu', norm_layer: Callable = BatchNormAct2d,
-                 attn_layer=None, drop_path=0.0,
+                 attn_layer=None, aa_layer=None, drop_path=0.0,
                  *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
         sk_kwargs = sk_kwargs or {}
+        assert aa_layer is None, 'aa_layer not supported by SelectiveKernelBottleneck'
         width = int(math.floor(planes * (base_width / 64)) * cardinality)
         first_planes = width // reduce_first
         outplanes = planes * self.expansion

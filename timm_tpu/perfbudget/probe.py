@@ -38,12 +38,13 @@ bool the win-or-delete verdict machinery keys on.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ['ProbeConfig', 'DEFAULT_MATRIX', 'probe_config', 'run_matrix',
-           'donation_evidence']
+           'donation_evidence', 'capture_programs']
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,6 +136,37 @@ DEFAULT_MATRIX: Tuple[ProbeConfig, ...] = (
                 model_kwargs=(('num_classes', 10), ('img_size', 32)),
                 batch_size=8, fsdp=4, collect='elastic'),
 )
+
+
+# ---- program capture (timm_tpu.analysis Tier B/C hook) ----------------------
+#
+# The probes are the one place the repo lowers its REAL programs; the
+# analysis suite's jaxpr/HLO passes audit those exact artifacts instead of
+# re-lowering. Inside `capture_programs()`, every probe records the jaxprs
+# and compiled executables it produces, tagged with the invariant each one
+# is expected to uphold (donation via alias table vs declared-at-lowering,
+# residual-sharding shape strings).
+
+_CAPTURE: Optional[List[Dict]] = None
+
+
+@contextlib.contextmanager
+def capture_programs():
+    """Collect {'config','name','kind','jaxpr','compiled','expect'} records
+    for every program the probes lower while the context is active."""
+    global _CAPTURE
+    prev, _CAPTURE = _CAPTURE, []
+    try:
+        yield _CAPTURE
+    finally:
+        _CAPTURE = prev
+
+
+def _capture(config: str, name: str, kind: str, *,
+             jaxpr=None, compiled=None, **expect) -> None:
+    if _CAPTURE is not None:
+        _CAPTURE.append(dict(config=config, name=name, kind=kind,
+                             jaxpr=jaxpr, compiled=compiled, expect=expect))
 
 
 def _cost_analysis(compiled) -> Dict[str, float]:
@@ -241,6 +273,10 @@ def _probe_train(cfg: ProbeConfig) -> Dict:
             metrics['flops'] = float(ca['flops'])
         if 'bytes accessed' in ca:
             metrics['bytes_accessed'] = float(ca['bytes accessed'])
+        _capture(cfg.name, f'{cfg.name}/fwd', 'fwd',
+                 jaxpr=closed, compiled=compiled,
+                 expect_shard=cfg.fwd_expect_shard or None,
+                 forbid_full=cfg.fwd_forbid_full or None)
         if cfg.fwd_expect_shard:
             hlo = compiled.as_text()
             metrics['no_replicated_residual'] = bool(
@@ -298,6 +334,11 @@ def _probe_train(cfg: ProbeConfig) -> Dict:
         # the train step's donation is always usable (state outputs match
         # their donated inputs leaf-for-leaf): zero aliases = donation died
         metrics['donation_ok'] = ev['aliases'] > 0
+        _capture(cfg.name, f'{cfg.name}/train_step', 'train_step',
+                 jaxpr=jaxpr, compiled=compiled, donation='alias')
+    else:
+        _capture(cfg.name, f'{cfg.name}/train_step', 'train_step',
+                 jaxpr=jaxpr)
     return metrics
 
 
@@ -356,8 +397,12 @@ def _probe_augment(cfg: ProbeConfig) -> Dict:
         'target': jnp.asarray(rng.randint(0, num_classes, B)),
         'erase_mask': jnp.zeros((B, L), bool),
     }, mesh)
+    _capture(cfg.name, f'{cfg.name}/image_augment', 'augment',
+             jaxpr=closed, compiled=compiled)
     nf_fn = functools.partial(augment_naflex_batch, mean=(0.5,) * 3, std=(0.5,) * 3)
     nf_compiled = jax.jit(nf_fn, donate_argnums=(0,)).lower(nf).compile()
+    _capture(cfg.name, f'{cfg.name}/naflex_augment', 'augment',
+             compiled=nf_compiled, donation='alias')
     ev = donation_evidence(nf_compiled)
     metrics['naflex_donation_aliases'] = ev['aliases']
     # the (B, L, D) float patches round-trip f32 -> f32 at unchanged shape:
@@ -429,6 +474,8 @@ def _probe_naflex(cfg: ProbeConfig) -> Dict:
     ev = donation_evidence(compiled)
     metrics['donation_aliases'] = ev['aliases']
     metrics['donation_ok'] = ev['aliases'] > 0
+    _capture(cfg.name, f'{cfg.name}/train_step', 'train_step',
+             jaxpr=jaxpr, compiled=compiled, donation='alias')
     return metrics
 
 
@@ -453,6 +500,10 @@ def _probe_serve(cfg: ProbeConfig) -> Dict:
     report = eng.donation_report(cfg.model)
     metrics['serve_donation_declared'] = bool(report) and all(
         r['declared'] for r in report.values())
+    for bucket in sorted(exes):
+        _capture(cfg.name, f'{cfg.name}/bucket{bucket}', 'serve_bucket',
+                 compiled=exes[bucket], donation='declared',
+                 declared=bool(report.get(bucket, {}).get('declared')))
     return metrics
 
 
@@ -555,8 +606,16 @@ def _probe_quant(cfg: ProbeConfig) -> Dict:
     def fwd_q(qs, xx):
         return nnx.merge(graphdef, dequantize_tree(qs))(xx)
 
-    _, _, fp_fwd_args = _exe_stats(jax.jit(fwd_fp).lower(state, x).compile())
-    _, _, q_fwd_args = _exe_stats(jax.jit(fwd_q).lower(qstate, x).compile())
+    for bucket, exe in sorted(eng_q.aot_executables(cfg.model).items()):
+        _capture(cfg.name, f'{cfg.name}/bucket{bucket}', 'serve_bucket',
+                 compiled=exe, donation='declared',
+                 declared=bool(report.get(bucket, {}).get('declared')))
+
+    fp_fwd_compiled = jax.jit(fwd_fp).lower(state, x).compile()
+    q_fwd_compiled = jax.jit(fwd_q).lower(qstate, x).compile()
+    _capture(cfg.name, f'{cfg.name}/fwd_int8', 'fwd', compiled=q_fwd_compiled)
+    _, _, fp_fwd_args = _exe_stats(fp_fwd_compiled)
+    _, _, q_fwd_args = _exe_stats(q_fwd_compiled)
 
     hbm_fp = fp_args + fp_fwd_args
     hbm_q = q_args + q_fwd_args
@@ -666,6 +725,8 @@ def _probe_elastic(cfg: ProbeConfig) -> Dict:
                          'target': jnp.asarray(rng.randint(0, num_classes, bs))},
                         mesh_to)
     compiled = task_to.lower_train_step(batch, lr=0.1)
+    _capture(cfg.name, f'{cfg.name}/train_step_postresize', 'train_step',
+             compiled=compiled, donation='alias')
     ev = donation_evidence(compiled)
     metrics['donation_aliases'] = ev['aliases']
     metrics['donation_ok'] = ev['aliases'] > 0

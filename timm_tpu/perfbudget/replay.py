@@ -142,6 +142,10 @@ _TINY = {'model': 'test_vit', 'img_size': 32, 'batch': 8,
 _VITB = {'model': 'vit_base_patch16_224', 'img_size': 224, 'batch': 128}
 
 REPLAY_STEPS: Tuple[Dict, ...] = (
+    dict(id='analysis', item=None, kind='analysis',
+         title='static-analysis gate: source/jaxpr/HLO rules + zoo abstract-trace '
+               '(a bench round never measures a repo the analyzers reject)',
+         dry=dict(tiers=('A',), zoo='smoke'), live=dict()),
     dict(id='baseline', item=1, kind='train',
          title='baseline train-step throughput (the --save-self measurement)',
          dry=dict(_TINY), live=dict(_VITB)),
@@ -573,8 +577,35 @@ def _run_kernels(spec: Dict, live: bool) -> Dict:
             'verdict_lines': [format_verdict_line(r) for r in verdicts]}
 
 
+def _run_analysis(spec: Dict) -> Dict:
+    """Static-analysis gate (timm_tpu/analysis) as a checklist step. The dry
+    arm runs the Tier A source rules plus the zoo smoke subset (cheap, no
+    probe lowering — tier-1 smokes it every run); the live arm runs EVERY
+    rule, including the jaxpr/HLO passes over the freshly lowered probe
+    programs. Any violation or analyzer error fails the step: the checklist
+    refuses to measure a repo the analyzers reject."""
+    from ..analysis import AnalysisContext, get, run_analysis, select
+    from ..analysis.zoo import SMOKE_FAMILIES
+
+    tiers = spec.get('tiers')
+    rules = select(tiers=list(tiers) if tiers else None)
+    zoo_families = None
+    if spec.get('zoo') == 'smoke':
+        rules = rules + [get('zoo-abstract-trace')]
+        zoo_families = SMOKE_FAMILIES
+    report = run_analysis(AnalysisContext(zoo_families=zoo_families), rules)
+    return {'status': 'ok' if report.exit_code == 0 else 'failed',
+            'exit_code': report.exit_code,
+            'violations': len(report.violations),
+            'waived': len(report.waived),
+            'errors': report.errors,
+            'rules': {n: r['status'] for n, r in report.rules.items()}}
+
+
 def _run_step(step: Dict, dry_run: bool, trace_dir: Optional[str]) -> Dict:
     spec = step['dry'] if dry_run else step['live']
+    if step['kind'] == 'analysis':
+        return _run_analysis(spec)
     if step['kind'] == 'train':
         return _run_train(spec)
     if step['kind'] == 'flash':

@@ -83,8 +83,10 @@ def configure_compile_cache(
         # an early jit (imports, probes) still takes effect
         from jax._src import compilation_cache as _cc
         _cc.reset_cache()
-    except Exception:
-        pass
+    except Exception as e:
+        # best-effort: the private reset hook moves between jax versions;
+        # without it the cache still works for jits issued after configure
+        _logger.debug(f'compile-cache reset hook unavailable: {e}')
     return cache_dir
 
 

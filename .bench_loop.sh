@@ -31,6 +31,15 @@ while true; do
       BENCH_TOTAL_BUDGET=5400 python bench.py --replay --save-self >> /tmp/bench_loop.log 2>&1
       echo "[$(date -u +%FT%TZ)] bench.py --replay rc=$? (one-shot)" >> /tmp/bench_loop.log
       touch /tmp/bench_replay_done
+    elif [ ! -f /tmp/bench_autotune_done ]; then
+      # autotune top-K live verification: time the solver's predicted top
+      # configs on real hardware and persist the fitted correction factor
+      # into BENCH_SELF.json (autotune.load_correction reads it from there).
+      # One-shot like the full replay, but queued separately so loops that
+      # already replayed before this step existed still verify it.
+      BENCH_TOTAL_BUDGET=3600 python bench.py --replay --replay-steps autotune --save-self >> /tmp/bench_loop.log 2>&1
+      echo "[$(date -u +%FT%TZ)] bench.py --replay-steps autotune rc=$? (one-shot)" >> /tmp/bench_loop.log
+      touch /tmp/bench_autotune_done
     fi
     sleep 2700
   else

@@ -71,6 +71,11 @@ def pytest_configure(config):
         'analysis: unified static-analysis suite — source/jaxpr/HLO rules, '
         'pragma waivers, planted-violation fixtures, CLI exit codes, zoo '
         'abstract-trace smoke (runs in tier-1)')
+    config.addinivalue_line(
+        'markers',
+        'autotune: config autotuner — legal-space enumeration, roofline '
+        'ranking, estimator/probed agreement, elastic re-solve, bucket-'
+        'ladder DP (runs in tier-1)')
 
 
 @pytest.fixture(scope='session')

@@ -1,0 +1,120 @@
+"""Serve bucket-ladder proposal: minimize padded-FLOPs waste.
+
+The serve engine pads every dispatched batch up to its bucket
+(`serve/bucketing.select_bucket`), so each request of size ``s`` costs
+``bucket(s)`` rows of compute. Given the request-size histogram a running
+engine accumulates, the optimal ladder of at most ``max_buckets`` rungs
+minimizes ``sum_s count[s] * bucket(s)`` — computed rows, which is padded
+FLOPs up to the per-row constant.
+
+This is the classic 1-D DP: since an optimal ladder only ever needs rungs
+at (divisor-rounded-up) observed sizes, sort the distinct sizes and let
+``best[i][k]`` = min cost of covering the first i sizes with k rungs where
+the k-th rung sits exactly at size i. O(n^2 * k) for n distinct sizes —
+trivially small against real histograms, and small enough to brute-force
+check in tests.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ['propose_buckets', 'ladder_cost', 'ladder_waste']
+
+
+def _round_up(n: int, divisor: int) -> int:
+    return -(-int(n) // max(1, int(divisor))) * max(1, int(divisor))
+
+
+def ladder_cost(buckets: Sequence[int], histogram: Dict[int, int]) -> int:
+    """Total computed rows: every request of size s pays its smallest
+    covering rung (requests above the top rung split; the overflow part pays
+    full rungs — same accounting `select_bucket` + chunking implies)."""
+    rungs = sorted(int(b) for b in buckets)
+    if not rungs:
+        raise ValueError('empty bucket ladder')
+    top = rungs[-1]
+    total = 0
+    for size, count in histogram.items():
+        s, c = int(size), int(count)
+        if s <= 0 or c <= 0:
+            continue
+        full, rem = divmod(s, top)
+        rows = full * top
+        if rem:
+            rows += next(b for b in rungs if b >= rem)
+        total += c * max(rows, rungs[0])
+    return total
+
+
+def ladder_waste(buckets: Sequence[int], histogram: Dict[int, int]) -> float:
+    """Fraction of computed rows that is padding (0.0 = perfect ladder)."""
+    useful = sum(int(s) * int(c) for s, c in histogram.items()
+                 if int(s) > 0 and int(c) > 0)
+    cost = ladder_cost(buckets, histogram)
+    return (cost - useful) / cost if cost else 0.0
+
+
+def propose_buckets(
+        histogram: Dict[int, int],
+        *,
+        max_buckets: int = 5,
+        divisor: int = 1,
+        max_bucket: Optional[int] = None,
+) -> Tuple[int, ...]:
+    """The ladder (at most ``max_buckets`` rungs, every rung a multiple of
+    ``divisor``) minimizing `ladder_cost` against the histogram.
+
+    Candidate rungs are the distinct observed sizes rounded up to the
+    divisor (an optimal rung always sits at one — lowering a rung onto the
+    next observed size below it never increases any request's cost), capped
+    at ``max_bucket`` when given. Deterministic: ties prefer fewer, smaller
+    rungs."""
+    sizes = sorted({min(_round_up(s, divisor), _round_up(max_bucket, divisor))
+                    if max_bucket else _round_up(s, divisor)
+                    for s, c in histogram.items() if int(s) > 0 and int(c) > 0})
+    if not sizes:
+        raise ValueError('propose_buckets: empty request-size histogram')
+    max_buckets = max(1, int(max_buckets))
+
+    # weight[j] = requests whose (capped, divisor-rounded) size is sizes[j]
+    weight = [0] * len(sizes)
+    for s, c in histogram.items():
+        if int(s) <= 0 or int(c) <= 0:
+            continue
+        r = _round_up(s, divisor)
+        if max_bucket:
+            r = min(r, _round_up(max_bucket, divisor))
+        weight[sizes.index(r)] += int(c)
+
+    n = len(sizes)
+    INF = float('inf')
+    # best[k][i]: min rows covering sizes[0..i] with k rungs, top rung at i
+    best = [[INF] * n for _ in range(max_buckets + 1)]
+    back: List[List[Optional[Tuple[int, int]]]] = \
+        [[None] * n for _ in range(max_buckets + 1)]
+    # prefix weights for O(1) range sums
+    pref = [0]
+    for w in weight:
+        pref.append(pref[-1] + w)
+
+    for i in range(n):
+        best[1][i] = sizes[i] * pref[i + 1]
+    for k in range(2, max_buckets + 1):
+        for i in range(k - 1, n):
+            for j in range(k - 2, i):
+                cand = best[k - 1][j] + sizes[i] * (pref[i + 1] - pref[j + 1])
+                if cand < best[k][i]:
+                    best[k][i] = cand
+                    back[k][i] = (k - 1, j)
+
+    # the ladder must cover the largest observed size: top rung at n-1
+    k_best = min(range(1, max_buckets + 1), key=lambda k: (best[k][n - 1], k))
+    rungs = []
+    k, i = k_best, n - 1
+    while True:
+        rungs.append(sizes[i])
+        step = back[k][i]
+        if step is None:
+            break
+        k, i = step
+    return tuple(sorted(rungs))

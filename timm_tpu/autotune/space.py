@@ -1,0 +1,331 @@
+"""Legal-config enumeration for the autotuner.
+
+The search space is {fsdp x tp x batch_size x grad_accum x block_scan x
+remat} at a FIXED global batch (the same invariant elastic resume holds).
+A point is legal iff:
+
+  * mesh divisibility — ``fsdp * tp`` divides the per-slice device count
+    (the exact rule `parallel.mesh.create_mesh` raises on);
+  * batch divisibility — the loader batch shards evenly over the product of
+    ALL mesh axes AND divides the global batch with ``accum <= max_accum``
+    (the `shard_batch` / `rescale_for_devices` contract);
+  * partition-rule legality — an axis must actually shard something: with
+    ``fsdp > 1`` at least one param resolves to a spec containing 'fsdp',
+    with ``tp > 1`` at least one to 'model' (a mesh axis that shards nothing
+    is pure collective overhead — the degraded-placement regime
+    `parallel/sharding.py` warns about);
+  * HBM fit — per-device params + grads + optimizer state + activations
+    (the `param_bytes_per_device` / `activation_bytes_per_device`
+    calculators) stay under the budget.
+
+Illegal points are not silently dropped: every pruned point becomes a
+:class:`Rejection` carrying the same loud nearest-legal suggestion style
+``shard_batch`` and ``rescale_for_devices`` pioneered, so `--autotune`
+output explains WHY a config the user hoped for is absent.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    'CandidateConfig', 'LegalPoint', 'Rejection', 'enumerate_configs',
+    'mesh_axis_points', 'batch_splits', 'OPT_SLOTS',
+]
+
+# AdamW carries two fp32 slots (m, v) per param shard; the HBM estimate and
+# the analytic weight-traffic model both key off this.
+OPT_SLOTS = 2
+
+# Full remat saves only the per-block input (seq_len x width) instead of the
+# ~(4 + mlp_ratio) working tensors activation_bytes_per_device counts, and
+# buys it back with ~one extra forward (see cost.REMAT_FLOPS_FACTOR).
+def _remat_fraction(mlp_ratio: float) -> float:
+    return 1.0 / (4.0 + float(mlp_ratio))
+
+
+@dataclasses.dataclass(frozen=True)
+class CandidateConfig:
+    """One point of the search space. ``fsdp``/``tp`` use 1 (not 0/None) for
+    'axis omitted' — `flags()` converts back to the train.py convention."""
+    fsdp: int = 1
+    tp: int = 1
+    batch_size: int = 8
+    grad_accum: int = 1
+    block_scan: bool = True
+    remat: bool = False
+
+    @property
+    def global_batch(self) -> int:
+        return self.batch_size * self.grad_accum
+
+    def label(self) -> str:
+        bits = [f'fsdp={self.fsdp}', f'tp={self.tp}',
+                f'b={self.batch_size}', f'accum={self.grad_accum}']
+        bits.append('scan' if self.block_scan else 'no-scan')
+        if self.remat:
+            bits.append('remat')
+        return ' '.join(bits)
+
+    def flags(self) -> str:
+        """The train.py flag string that reproduces this point."""
+        parts = [f'-b {self.batch_size}', f'--grad-accum-steps {self.grad_accum}']
+        if self.fsdp > 1:
+            parts.append(f'--fsdp {self.fsdp}')
+        if self.tp > 1:
+            parts.append(f'--tp {self.tp}')
+        if self.block_scan:
+            parts.append('--block-scan')
+        if self.remat:
+            parts.append('--grad-checkpointing')
+        return ' '.join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class LegalPoint:
+    """A legal candidate plus the per-device byte estimates the legality
+    check already computed (the cost model reuses them instead of
+    re-deriving)."""
+    config: CandidateConfig
+    param_bytes_full: int       # one full (unsharded) copy of the params
+    param_bytes: int            # per-device resident param bytes (sharded)
+    opt_bytes: int              # per-device optimizer slots (OPT_SLOTS * sharded)
+    act_bytes: int              # per-device activation residency at batch_size
+    hbm_bytes: int              # the budget the point was admitted under
+
+
+@dataclasses.dataclass(frozen=True)
+class Rejection:
+    point: str                  # human label of the pruned point / axis pair
+    reason: str
+    suggestion: str = ''
+
+    def __str__(self) -> str:
+        s = f'{self.point}: {self.reason}'
+        return f'{s} ({self.suggestion})' if self.suggestion else s
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def mesh_axis_points(
+        n_devices: int,
+        num_slices: int = 1,
+        allow_tp: bool = True,
+        fsdp_candidates: Optional[Sequence[int]] = None,
+        tp_candidates: Optional[Sequence[int]] = None,
+) -> Tuple[List[Tuple[int, int]], List[Rejection]]:
+    """All (fsdp, tp) pairs with ``fsdp * tp`` dividing the per-slice device
+    count. Explicit candidate lists may contain illegal sizes — those come
+    back as Rejections with the nearest legal pair (resolve_elastic_axes'
+    largest-divisor clamp) as the suggestion."""
+    from ..parallel.mesh import resolve_elastic_axes
+
+    per_slice = max(1, int(n_devices) // max(1, int(num_slices)))
+    fs = sorted(set(int(f) for f in (fsdp_candidates or _divisors(per_slice))))
+    ts = sorted(set(int(t) for t in (tp_candidates or _divisors(per_slice)))) \
+        if allow_tp else [1]
+    points, rejected = [], []
+    for f in fs:
+        for t in ts:
+            if f < 1 or t < 1:
+                continue
+            if per_slice % max(f * t, 1) == 0:
+                points.append((f, t))
+            else:
+                cf, ct = resolve_elastic_axes(n_devices, fsdp=f, tp=t,
+                                              num_slices=num_slices)
+                rejected.append(Rejection(
+                    point=f'fsdp={f} tp={t}',
+                    reason=f'fsdp*tp = {f * t} does not divide the {per_slice} '
+                           f'devices per slice (create_mesh would refuse)',
+                    suggestion=f'nearest legal axes: fsdp={cf or 1} tp={ct or 1}'))
+    return points, rejected
+
+
+def batch_splits(global_batch: int, n_shards: int,
+                 max_accum: int = 64) -> List[Tuple[int, int]]:
+    """All (batch_size, accum) decompositions holding ``global_batch``
+    constant with the batch sharding evenly over ``n_shards`` devices —
+    exactly the candidate set `rescale_for_devices` picks one element of."""
+    g, n = int(global_batch), int(n_shards)
+    return [(b, g // b) for b in range(n, g + 1, n)
+            if g % b == 0 and g // b <= int(max_accum)]
+
+
+def _tree_bytes(params, mesh, rules) -> Tuple[int, int, bool, bool]:
+    """(full_bytes, sharded_bytes, any_fsdp_sharded, any_tp_sharded) under
+    the rule table — one `path_specs` pass instead of two calculators."""
+    import jax
+    import numpy as np
+
+    from ..parallel.sharding import _kp_str, path_specs
+
+    specs = path_specs(params, mesh, rules)
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    full = shard = 0
+    any_fsdp = any_tp = False
+    for kp, leaf in flat:
+        shape = getattr(leaf, 'shape', ()) or (1,)
+        nbytes = int(np.prod(shape)) * np.dtype(leaf.dtype).itemsize
+        full += nbytes
+        spec = specs[_kp_str(kp)]
+        div = 1
+        for ax in spec:
+            if ax is None:
+                continue
+            for a in (ax if isinstance(ax, tuple) else (ax,)):
+                div *= int(mesh.shape[a])
+                any_fsdp = any_fsdp or a == 'fsdp'
+                any_tp = any_tp or a == 'model'
+        shard += nbytes // div
+    return full, shard, any_fsdp, any_tp
+
+
+def enumerate_configs(
+        *,
+        n_devices: int,
+        global_batch: int,
+        params=None,
+        model_dims: Optional[Tuple[int, int, int]] = None,
+        hbm_budget_bytes: Optional[int] = None,
+        num_slices: int = 1,
+        max_accum: int = 64,
+        allow_tp: bool = True,
+        allow_remat: bool = True,
+        include_block_scan: bool = True,
+        fsdp_candidates: Optional[Sequence[int]] = None,
+        tp_candidates: Optional[Sequence[int]] = None,
+        rules=None,
+        mlp_ratio: float = 4.0,
+        devices: Optional[Sequence] = None,
+) -> Tuple[List[LegalPoint], List[Rejection]]:
+    """Enumerate every legal search-space point for a fixed global batch.
+
+    ``params`` is a (possibly abstract — `nnx.eval_shape`) param pytree; when
+    given, partition-rule legality and per-device byte estimates are computed
+    against a REAL mesh built for each (fsdp, tp) pair, so every emitted
+    point is guaranteed to survive `create_mesh` + `build_param_shardings`.
+    ``model_dims`` = (seq_len, width, depth) feeds the activation calculator;
+    without it activation bytes are reported as 0 (weight-only HBM check).
+
+    Returns (legal_points, rejections); rejections carry loud nearest-legal
+    suggestions in the `shard_batch` style.
+    """
+    from ..parallel.mesh import create_mesh
+    from ..parallel.sharding import activation_bytes_per_device
+
+    import jax
+
+    n_devices = int(n_devices)
+    legal: List[LegalPoint] = []
+    rejected: List[Rejection] = []
+
+    pairs, rejected_pairs = mesh_axis_points(
+        n_devices, num_slices=num_slices, allow_tp=allow_tp,
+        fsdp_candidates=fsdp_candidates, tp_candidates=tp_candidates)
+    rejected.extend(rejected_pairs)
+
+    splits = batch_splits(global_batch, n_devices, max_accum=max_accum)
+    if not splits:
+        g, n = int(global_batch), n_devices
+        lo, hi = (g // n) * n, -(-g // n) * n
+        nearest = str(hi) if lo <= 0 or lo == hi else f'{lo} or {hi}'
+        rejected.append(Rejection(
+            point=f'global_batch={g}',
+            reason=f'no loader batch size b satisfies b % {n} == 0, '
+                   f'{g} % b == 0 and {g} // b <= {max_accum} (grad-accum cap)',
+            suggestion=f'nearest legal global batch: {nearest} '
+                       f'(multiples of the mesh batch-shard count {n})'))
+        return legal, rejected
+
+    dev_list = list(devices) if devices is not None else list(jax.devices())
+    can_mesh = params is not None and n_devices <= len(dev_list)
+
+    scan_opts = (True, False) if include_block_scan else (True,)
+    remat_opts = (False, True) if allow_remat else (False,)
+    remat_frac = _remat_fraction(mlp_ratio)
+
+    for fsdp, tp in pairs:
+        mesh = None
+        full = shard = 0
+        any_fsdp = any_tp = False
+        if can_mesh:
+            mesh = create_mesh(devices=dev_list[:n_devices],
+                               num_slices=num_slices,
+                               fsdp=fsdp if fsdp > 1 else None,
+                               tp=tp if tp > 1 else None)
+            full, shard, any_fsdp, any_tp = _tree_bytes(params, mesh, rules)
+            if fsdp > 1 and not any_fsdp:
+                rejected.append(Rejection(
+                    point=f'fsdp={fsdp} tp={tp}',
+                    reason=f'no param shards over the fsdp axis under the rule '
+                           f'table (every dim indivisible by {fsdp} or below '
+                           f'the min shard size) — the axis is pure overhead',
+                    suggestion='use a smaller fsdp, or tp instead'))
+                continue
+            if tp > 1 and not any_tp:
+                rejected.append(Rejection(
+                    point=f'fsdp={fsdp} tp={tp}',
+                    reason=f'no param shards over the model axis under the rule '
+                           f'table (head/hidden dims indivisible by {tp}) — '
+                           f'tensor parallelism buys nothing here',
+                    suggestion='use a tp that divides the head count and MLP '
+                               'hidden dim, or fsdp instead'))
+                continue
+        opt_bytes = OPT_SLOTS * shard
+
+        for batch_size, accum in splits:
+            act = act_remat = 0
+            if mesh is not None and model_dims is not None:
+                seq_len, width, depth = model_dims
+                _, act = activation_bytes_per_device(
+                    mesh, batch_size=batch_size, seq_len=seq_len, width=width,
+                    depth=depth, mlp_ratio=mlp_ratio)
+                act_remat = int(act * remat_frac)
+            for block_scan in scan_opts:
+                for remat in remat_opts:
+                    cfg = CandidateConfig(fsdp=fsdp, tp=tp,
+                                          batch_size=batch_size,
+                                          grad_accum=accum,
+                                          block_scan=block_scan, remat=remat)
+                    act_eff = act_remat if remat else act
+                    # resident: sharded params + grads (same placement) +
+                    # optimizer slots + live activations
+                    hbm = shard * 2 + opt_bytes + act_eff
+                    if hbm_budget_bytes is not None and hbm > hbm_budget_bytes:
+                        biggest = _largest_fitting_batch(
+                            shard, opt_bytes, act_eff, batch_size,
+                            hbm_budget_bytes, n_devices, global_batch,
+                            max_accum)
+                        fix = ['enable --grad-checkpointing (remat)'] if not remat else []
+                        if fsdp < n_devices:
+                            fix.append('raise --fsdp')
+                        if biggest:
+                            fix.append(f'largest fitting batch size: {biggest}')
+                        rejected.append(Rejection(
+                            point=cfg.label(),
+                            reason=f'estimated {hbm / 2**30:.2f} GiB/device exceeds '
+                                   f'the {hbm_budget_bytes / 2**30:.2f} GiB HBM budget',
+                            suggestion='; '.join(fix)))
+                        continue
+                    legal.append(LegalPoint(
+                        config=cfg, param_bytes_full=full, param_bytes=shard,
+                        opt_bytes=opt_bytes, act_bytes=act_eff, hbm_bytes=hbm))
+    return legal, rejected
+
+
+def _largest_fitting_batch(shard: int, opt_bytes: int, act: int,
+                           batch_size: int, budget: int, n_shards: int,
+                           global_batch: int, max_accum: int) -> Optional[int]:
+    """Largest legal loader batch whose (linearly scaled) activation bytes
+    fit the budget — the 'nearest legal' arm of an HBM rejection."""
+    fixed = shard * 2 + opt_bytes
+    if act <= 0 or fixed >= budget:
+        return None
+    per_sample = act / max(batch_size, 1)
+    cap = int((budget - fixed) / per_sample)
+    fitting = [b for b, _ in batch_splits(global_batch, n_shards, max_accum)
+               if b <= cap]
+    return max(fitting) if fitting else None

@@ -125,6 +125,14 @@ def resolve_elastic_axes(
     ``create_mesh(fsdp=..., tp=...)`` is guaranteed to accept the result.
     Returns ``(fsdp, tp)`` with None where the axis should be omitted,
     matching create_mesh's treatment of ``fsdp=1``/``tp=1``.
+
+    This largest-divisor policy is the DOCUMENTED FALLBACK of elastic resume:
+    `plan_elastic_resume` first asks the autotune solver
+    (`timm_tpu.autotune.resolve_config_for_topology`) to re-solve
+    (fsdp, tp, batch, accum) by cost rank for the new topology — a still-legal
+    requested config passes through unchanged — and lands here whenever the
+    solver refuses (no model dims, no legal point, any solver error). The
+    clamp is topology-only: it guarantees a mesh, not a good one.
     """
     per_slice = max(1, int(n_devices) // max(1, int(num_slices)))
 

@@ -40,8 +40,11 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import logging
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
+
+_logger = logging.getLogger(__name__)
 
 __all__ = ['ProbeConfig', 'DEFAULT_MATRIX', 'probe_config', 'run_matrix',
            'donation_evidence', 'capture_programs']
@@ -58,7 +61,7 @@ class ProbeConfig:
     block_scan: Optional[bool] = None     # None = model default
     grad_accum: int = 1
     opt: str = 'adamw'
-    collect: str = 'full'   # 'trace' | 'full' | 'fwd' | 'serve' | 'quant' | 'augment' | 'naflex' | 'kernels' | 'elastic'
+    collect: str = 'full'   # 'trace' | 'full' | 'fwd' | 'serve' | 'quant' | 'augment' | 'naflex' | 'kernels' | 'elastic' | 'autotune'
     buckets: Tuple[int, ...] = (2, 4)     # serve only
     seq_len: int = 25                     # naflex packed probe only
     fused_update: bool = False            # route the step through fused_adamw
@@ -135,6 +138,15 @@ DEFAULT_MATRIX: Tuple[ProbeConfig, ...] = (
     ProbeConfig(name='elastic_resize', model='test_vit',
                 model_kwargs=(('num_classes', 10), ('img_size', 32)),
                 batch_size=8, fsdp=4, collect='elastic'),
+    # autotune solver-output legality: the analytic tier enumerates the full
+    # {fsdp x tp x batch x accum x scan x remat} space for global batch
+    # batch_size*grad_accum (deterministic candidate/rejection counts and a
+    # deterministic winner), then the WINNING config's real train step is
+    # lowered once — its donation + sharding ride the same 'full'-collect
+    # machinery every other train probe budgets
+    ProbeConfig(name='autotune', model='test_vit',
+                model_kwargs=(('num_classes', 10), ('img_size', 32)),
+                batch_size=8, grad_accum=8, collect='autotune'),
 )
 
 
@@ -169,12 +181,25 @@ def _capture(config: str, name: str, kind: str, *,
                              jaxpr=jaxpr, compiled=compiled, expect=expect))
 
 
-def _cost_analysis(compiled) -> Dict[str, float]:
+# configs whose cost_analysis() already raised once this process — the
+# warning fires once per config, not once per retry/rerank.
+_COST_WARNED: set = set()
+
+
+def _cost_analysis(compiled, name: str = '') -> Dict[str, float]:
     """Normalize `compiled.cost_analysis()` across jax versions (dict or
-    [dict]); returns {} when the backend reports nothing."""
+    [dict]); returns {} when the backend reports nothing. A raising backend
+    is logged once per config name — an autotune/budget consumer ranking on
+    partially-missing costs must be able to see WHY in the log."""
     try:
         ca = compiled.cost_analysis()
-    except Exception:
+    except Exception as e:
+        if name not in _COST_WARNED:
+            _COST_WARNED.add(name)
+            _logger.warning(
+                'perfbudget: cost_analysis() raised for config %r '
+                '(%s: %s) — flops/bytes_accessed will be missing',
+                name or '<unnamed>', type(e).__name__, e)
         return {}
     if isinstance(ca, (list, tuple)):
         ca = ca[0] if ca else {}
@@ -268,7 +293,7 @@ def _probe_train(cfg: ProbeConfig) -> Dict:
         metrics['trace_ms'] = round((time.perf_counter() - t0) * 1e3, 3)
         metrics['jaxpr_eqns'] = count_jaxpr_eqns(closed)
         compiled = jax.jit(fwd).lower(state, x).compile()
-        ca = _cost_analysis(compiled)
+        ca = _cost_analysis(compiled, cfg.name)
         if 'flops' in ca:
             metrics['flops'] = float(ca['flops'])
         if 'bytes accessed' in ca:
@@ -324,7 +349,7 @@ def _probe_train(cfg: ProbeConfig) -> Dict:
 
     if cfg.collect == 'full':
         compiled = task.lower_train_step(batch, lr=0.1)
-        ca = _cost_analysis(compiled)
+        ca = _cost_analysis(compiled, cfg.name)
         if 'flops' in ca:
             metrics['flops'] = float(ca['flops'])
         if 'bytes accessed' in ca:
@@ -383,7 +408,7 @@ def _probe_augment(cfg: ProbeConfig) -> Dict:
     metrics['trace_ms'] = round((time.perf_counter() - t0) * 1e3, 3)
     metrics['jaxpr_eqns'] = count_jaxpr_eqns(closed)
     compiled = jax.jit(fn).lower(raw).compile()
-    ca = _cost_analysis(compiled)
+    ca = _cost_analysis(compiled, cfg.name)
     if 'flops' in ca:
         metrics['flops'] = float(ca['flops'])
     if 'bytes accessed' in ca:
@@ -466,7 +491,7 @@ def _probe_naflex(cfg: ProbeConfig) -> Dict:
     metrics['param_bytes_sharded'] = int(shard)
 
     compiled = task.lower_train_step(batch, lr=0.1)
-    ca = _cost_analysis(compiled)
+    ca = _cost_analysis(compiled, cfg.name)
     if 'flops' in ca:
         metrics['flops'] = float(ca['flops'])
     if 'bytes accessed' in ca:
@@ -491,7 +516,7 @@ def _probe_serve(cfg: ProbeConfig) -> Dict:
     flops = 0.0
     have_flops = False
     for bucket in sorted(exes):
-        ca = _cost_analysis(exes[bucket])
+        ca = _cost_analysis(exes[bucket], f'{cfg.name}/bucket{bucket}')
         if 'flops' in ca:
             flops += float(ca['flops'])
             have_flops = True
@@ -558,7 +583,7 @@ def _probe_quant(cfg: ProbeConfig) -> Dict:
 
     def _exe_stats(exe):
         """(cost-model bytes-accessed | None, flops, compiled argument bytes)."""
-        ca = _cost_analysis(exe)
+        ca = _cost_analysis(exe, cfg.name)
         accessed = float(ca['bytes accessed']) if 'bytes accessed' in ca else None
         flops = float(ca.get('flops', 0.0))
         try:
@@ -747,6 +772,38 @@ def _probe_kernels(cfg: ProbeConfig) -> Dict:
     return dict(kernel_metrics())
 
 
+def _probe_autotune(cfg: ProbeConfig) -> Dict:
+    """Pin the autotune solver's output legality: enumerate + rank the full
+    space analytically (no lowering) for global batch ``batch_size *
+    grad_accum``, then probe the WINNER's real train step through
+    `_probe_train` so its flops/bytes/donation land in the same budget file
+    every other train config uses."""
+    from ..autotune import autotune
+
+    result = autotune(cfg.model, cfg.kwargs(),
+                      global_batch=cfg.batch_size * cfg.grad_accum,
+                      probe_anchor=False, correction=1.0)
+    w = result.winner
+    metrics: Dict = {
+        'autotune_candidates': len(result.ranked),
+        'autotune_rejections': len(result.rejections),
+        'autotune_winner_fsdp': int(w.fsdp),
+        'autotune_winner_tp': int(w.tp),
+        'autotune_winner_batch_size': int(w.batch_size),
+        'autotune_winner_grad_accum': int(w.grad_accum),
+        'autotune_winner_global_batch_ok':
+            w.global_batch == cfg.batch_size * cfg.grad_accum,
+    }
+    winner_metrics = _probe_train(dataclasses.replace(
+        cfg, batch_size=w.batch_size, fsdp=w.fsdp, tp=w.tp,
+        grad_accum=w.grad_accum, block_scan=w.block_scan, collect='full'))
+    metrics.update(winner_metrics)
+    # the winner must be a config we can actually run: its real step lowered,
+    # compiled, and kept donation alive
+    metrics['autotune_winner_legal'] = bool(winner_metrics.get('donation_ok'))
+    return metrics
+
+
 def probe_config(cfg: ProbeConfig) -> Dict:
     """Probe one config; global mesh is saved/restored so probes compose with
     whatever mesh the calling process (tests, bench) had active."""
@@ -754,6 +811,8 @@ def probe_config(cfg: ProbeConfig) -> Dict:
 
     saved = mesh_mod.peek_global_mesh()
     try:
+        if cfg.collect == 'autotune':
+            return _probe_autotune(cfg)
         if cfg.collect == 'serve':
             return _probe_serve(cfg)
         if cfg.collect == 'quant':

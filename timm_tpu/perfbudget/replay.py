@@ -218,6 +218,13 @@ REPLAY_STEPS: Tuple[Dict, ...] = (
          dry=dict(model='test_naflexvit', seq_lens=(16, 25, 36), batch=4),
          live=dict(model='naflexvit_base_patch16_gap', seq_lens=(576, 784, 1024),
                    batch=16, pallas=True)),
+    dict(id='autotune', item=None, kind='autotune',
+         title='autotune top-K verification: rank the config space analytically, '
+               'time the top-K predicted configs\' real steps, and fit the '
+               'predicted->measured correction factor (live runs persist it to '
+               'BENCH_SELF.json, where autotune.load_correction picks it up)',
+         dry=dict(_TINY, global_batch=64, top_k=2, steps=2),
+         live=dict(_VITB, global_batch=1024, top_k=3, steps=10)),
 )
 
 
@@ -602,6 +609,82 @@ def _run_analysis(spec: Dict) -> Dict:
             'rules': {n: r['status'] for n, r in report.rules.items()}}
 
 
+def _run_autotune(spec: Dict, live: bool) -> Dict:
+    """Verify the autotuner's predicted top-K against real step timings.
+
+    Ranks the space analytically (the same zero-lowering tier the elastic
+    re-solve uses), times the top-K distinct (fsdp, tp, batch) configs' real
+    jitted steps via `_build_tiny_step` (measured global-step time =
+    micro-step time x accum), and fits the predicted->measured correction
+    factor as the geomean of the K ratios. Live runs hand the fitted factor
+    back for persistence into BENCH_SELF.json ('_autotune_doc'); dry runs
+    exercise the full path but never persist — a CPU-fitted factor must not
+    leak into real solver runs."""
+    import math
+    import time as _time
+
+    import jax
+
+    from ..autotune import autotune
+
+    model_kwargs = dict(spec.get('model_kwargs', {}))
+    top_k = int(spec.get('top_k', 3))
+    result = autotune(
+        spec['model'], dict(model_kwargs, img_size=spec['img_size']),
+        global_batch=int(spec['global_batch']),
+        probe_anchor=False, correction=1.0,
+        allow_remat=False, include_block_scan=False)
+
+    # dedupe scan/remat variants: the timed step is always scanned, no remat
+    chosen, seen = [], set()
+    for rp in result.ranked:
+        key = (rp.point.config.fsdp, rp.point.config.tp,
+               rp.point.config.batch_size)
+        if key not in seen:
+            seen.add(key)
+            chosen.append(rp)
+        if len(chosen) >= top_k:
+            break
+
+    measured = []
+    for rp in chosen:
+        cfg = rp.point.config
+        run_one_step, _n, _meta = _build_tiny_step(dict(
+            spec, batch=cfg.batch_size, fsdp=cfg.fsdp if cfg.fsdp > 1 else 0,
+            tp=cfg.tp if cfg.tp > 1 else 0))
+        jax.block_until_ready(run_one_step())   # compile + warm
+        t0 = _time.perf_counter()
+        for _ in range(int(spec.get('steps', 3))):
+            loss = run_one_step()
+        jax.block_until_ready(loss)
+        micro_ms = (_time.perf_counter() - t0) * 1e3 / int(spec.get('steps', 3))
+        measured.append({'config': cfg.label(),
+                         'predicted_ms': round(rp.cost.step_ms, 4),
+                         'measured_ms': round(micro_ms * cfg.grad_accum, 4)})
+
+    ratios = [m['measured_ms'] / m['predicted_ms'] for m in measured
+              if m['predicted_ms'] > 0 and m['measured_ms'] > 0]
+    correction = math.exp(sum(math.log(r) for r in ratios) / len(ratios)) \
+        if ratios else 1.0
+    by_measured = sorted(range(len(measured)),
+                         key=lambda i: measured[i]['measured_ms'])
+    out: Dict = {
+        'tier': result.tier,
+        'candidates': len(result.ranked),
+        'top_k': [m['config'] for m in measured],
+        'measured': measured,
+        'winner_confirmed': bool(by_measured and by_measured[0] == 0),
+        'correction': round(correction, 4),
+    }
+    if live:
+        out['_autotune_doc'] = {'correction': out['correction'],
+                                'fitted_at': _now(),
+                                'model': spec['model'],
+                                'global_batch': int(spec['global_batch']),
+                                'measured': measured}
+    return out
+
+
 def _run_step(step: Dict, dry_run: bool, trace_dir: Optional[str]) -> Dict:
     spec = step['dry'] if dry_run else step['live']
     if step['kind'] == 'analysis':
@@ -620,6 +703,8 @@ def _run_step(step: Dict, dry_run: bool, trace_dir: Optional[str]) -> Dict:
         return _run_naflex(spec)
     if step['kind'] == 'kernels':
         return _run_kernels(spec, live=not dry_run)
+    if step['kind'] == 'autotune':
+        return _run_autotune(spec, live=not dry_run)
     raise ValueError(f"unknown replay step kind {step['kind']!r}")
 
 
@@ -642,11 +727,16 @@ def run_replay(dry_run: bool = True, self_path: Optional[str] = None,
     replay_doc: Dict = {'dry_run': bool(dry_run), 'started_at': _now(),
                         'steps': [], 'total': len(steps),
                         'completed': 0, 'failed': 0, 'skipped': 0}
+    autotune_doc: Dict = {}
 
     def persist():
         if self_path:
             doc = load_self_doc(self_path)
             doc['replay'] = replay_doc
+            if autotune_doc:
+                # the live autotune step's fitted correction factor —
+                # autotune.load_correction reads it on every later solve
+                doc['autotune'] = autotune_doc
             save_self_doc(self_path, doc)
 
     persist()
@@ -657,6 +747,7 @@ def run_replay(dry_run: bool = True, self_path: Optional[str] = None,
             rec: Dict = {'id': step['id'], 'item': step['item'], 'title': step['title']}
             try:
                 result = _run_step(step, dry_run, trace_dir)
+                autotune_doc.update(result.pop('_autotune_doc', {}))
                 rec['status'] = result.pop('status', 'ok')
                 key = 'reason' if rec['status'] == 'skipped' else 'result'
                 rec[key] = result.get('reason') if rec['status'] == 'skipped' else result

@@ -143,7 +143,8 @@ def _checkpoint_global_batch(path):
 
 
 def plan_elastic_resume(devices, batch_size, grad_accum, fsdp=None, tp=None,
-                        resume='', num_slices=1, max_accum=64):
+                        resume='', num_slices=1, max_accum=64,
+                        model='', model_kwargs=None):
     """Plan a restart on the live topology, holding the global batch constant.
 
     ``devices`` is what is actually there now (``jax.device_count()``), not
@@ -151,17 +152,20 @@ def plan_elastic_resume(devices, batch_size, grad_accum, fsdp=None, tp=None,
     are this restart's requested values (normally the same flags as the dead
     run); ``resume`` is the resolved checkpoint path ('' for a fresh start —
     the plan then only validates/clamps the fresh run's own configuration).
+
+    With ``model`` given, the autotune solver re-solves
+    (fsdp, tp, batch_size, accum) for the new topology instead of clamping
+    ("first, do no harm": a requested config that is still legal is returned
+    unchanged — the 8<->4 drill parity bound is untouched — and only an
+    illegal request is re-solved by cost rank).  The largest-divisor clamp
+    (`resolve_elastic_axes`) + `rescale_for_devices` path below stays as the
+    documented fallback whenever the solver refuses: no model given, no ViT
+    dims, no legal point, or any solver error (each fallback is a note).
     """
     from ..parallel.mesh import resolve_elastic_axes
 
     devices = int(devices)
     notes = []
-    fsdp_eff, tp_eff = resolve_elastic_axes(devices, fsdp=fsdp, tp=tp,
-                                            num_slices=num_slices)
-    if fsdp and fsdp_eff != fsdp:
-        notes.append(f'fsdp clamped {fsdp} -> {fsdp_eff} for {devices} devices')
-    if tp and tp_eff != tp:
-        notes.append(f'tp clamped {tp} -> {tp_eff} for {devices} devices')
 
     global_batch = int(batch_size) * int(grad_accum)
     source = ''
@@ -175,6 +179,41 @@ def plan_elastic_resume(devices, batch_size, grad_accum, fsdp=None, tp=None,
             if ckpt_bs:
                 batch_size = ckpt_bs   # prefer the dead run's loader batch
             source = resume
+
+    if model:
+        try:
+            from ..autotune import resolve_config_for_topology
+            cfg = resolve_config_for_topology(
+                devices, global_batch, model=model, model_kwargs=model_kwargs,
+                fsdp=fsdp, tp=tp, prefer_batch_size=batch_size,
+                num_slices=num_slices, max_accum=max_accum)
+        except Exception as e:   # noqa: BLE001 — fallback must note WHY
+            cfg = None
+            notes.append(f'autotune re-solve unavailable ({type(e).__name__}: '
+                         f'{e}) — falling back to the largest-divisor clamp')
+        if cfg is not None and cfg.global_batch == global_batch:
+            # 1 = axis omitted, same convention resolve_elastic_axes uses
+            fsdp_eff = cfg.fsdp if cfg.fsdp > 1 else None
+            tp_eff = cfg.tp if cfg.tp > 1 else None
+            if (cfg.fsdp, cfg.tp, cfg.batch_size, cfg.grad_accum) != (
+                    int(fsdp or 1), int(tp or 1), int(batch_size), int(grad_accum)):
+                notes.append(
+                    f'autotune re-solved for {devices} devices: '
+                    f'fsdp={cfg.fsdp} tp={cfg.tp} batch_size={cfg.batch_size} '
+                    f'accum={cfg.grad_accum} (global batch {global_batch} '
+                    f'invariant; requested config was illegal here)')
+            return ElasticPlan(devices=devices, fsdp=fsdp_eff, tp=tp_eff,
+                               batch_size=cfg.batch_size,
+                               grad_accum=cfg.grad_accum,
+                               global_batch=global_batch, source=source,
+                               notes=tuple(notes))
+
+    fsdp_eff, tp_eff = resolve_elastic_axes(devices, fsdp=fsdp, tp=tp,
+                                            num_slices=num_slices)
+    if fsdp and fsdp_eff != fsdp:
+        notes.append(f'fsdp clamped {fsdp} -> {fsdp_eff} for {devices} devices')
+    if tp and tp_eff != tp:
+        notes.append(f'tp clamped {tp} -> {tp_eff} for {devices} devices')
 
     new_bs, new_accum = rescale_for_devices(
         global_batch, devices, prefer_batch_size=batch_size,

@@ -125,6 +125,7 @@ class InferenceEngine:
         self.stats: Dict = {
             'submitted': 0, 'completed': 0, 'failed': 0, 'steps': 0,
             'padded_slots': 0, 'steps_by_bucket': Counter(),
+            'request_sizes': Counter(),   # dispatched-batch size histogram
             'prewarm': {}, 'max_inflight': 0,
         }
 
@@ -331,6 +332,15 @@ class InferenceEngine:
                                    f'{timeout}s at shutdown')
             self._thread = None
         self._started = False
+        advisory = self.bucket_advisory()
+        if advisory:
+            _logger.info(
+                f'serve: bucket ladder {advisory["current"]} wasted '
+                f'{advisory["current_waste"]:.1%} of computed rows over '
+                f'{advisory["requests"]} dispatches; '
+                f'autotune.propose_buckets suggests {advisory["proposed"]} '
+                f'({advisory["proposed_waste"]:.1%} waste). Advisory only — '
+                f'restart with buckets={tuple(advisory["proposed"])} to apply.')
 
     def __enter__(self) -> 'InferenceEngine':
         return self.start()
@@ -382,6 +392,7 @@ class InferenceEngine:
             self._inflight.append(_Inflight(out, requests, bucket, time.perf_counter()))
             self.stats['steps'] += 1
             self.stats['steps_by_bucket'][bucket] += 1
+            self.stats['request_sizes'][len(requests)] += 1
             self.stats['padded_slots'] += bucket - len(requests)
             self.stats['max_inflight'] = max(self.stats['max_inflight'], len(self._inflight))
         except Exception as e:
@@ -413,6 +424,29 @@ class InferenceEngine:
         """Point-in-time copy of engine + pool counters (drill reporting)."""
         out = dict(self.stats)
         out['steps_by_bucket'] = dict(self.stats['steps_by_bucket'])
+        out['request_sizes'] = dict(self.stats['request_sizes'])
         out['pool'] = dict(self.pool.stats)
         out['resident'] = list(self.pool.resident_names)
         return out
+
+    def bucket_advisory(self, max_buckets: int = 5) -> Optional[Dict]:
+        """Compare the declared bucket ladder against the optimal ladder for
+        the dispatched-batch size histogram (`autotune.propose_buckets`).
+        Returns None until traffic exists or when the declared ladder is
+        already optimal; advisory only — ladders are compile-time surface."""
+        hist = {s: c for s, c in self.stats['request_sizes'].items() if c > 0}
+        if not hist:
+            return None
+        from ..autotune import ladder_waste, propose_buckets
+        proposed = propose_buckets(hist, max_buckets=max(len(self.buckets),
+                                                         max_buckets))
+        current_waste = ladder_waste(self.buckets, hist)
+        proposed_waste = ladder_waste(proposed, hist)
+        if tuple(proposed) == tuple(sorted(self.buckets)) \
+                or proposed_waste >= current_waste:
+            return None
+        return {'current': tuple(sorted(self.buckets)),
+                'proposed': tuple(proposed),
+                'current_waste': round(current_waste, 4),
+                'proposed_waste': round(proposed_waste, 4),
+                'requests': int(sum(hist.values()))}

@@ -98,6 +98,16 @@ def make_parser():
                             'sharding constraints on the residual stream. Composes with '
                             '--fsdp (fsdp*tp must divide the per-slice device count); '
                             '0 disables (env TIMM_TPU_TP is the fallback default)')
+    group.add_argument('--autotune', action='store_true', default=False,
+                       help='enumerate legal {fsdp x tp x batch x accum x scan x remat} '
+                            'configs for the live topology, rank them on the compiled-'
+                            'cost roofline, print the table, and apply the winner '
+                            'before building the mesh (the global batch '
+                            'batch_size * grad_accum_steps is held exactly constant)')
+    group.add_argument('--autotune-probe-top-k', type=int, default=0, metavar='K',
+                       help="with --autotune: lower the top-K candidates' REAL train "
+                            'steps and re-rank the shortlist on their compiled costs '
+                            '(K extra compiles; 0 = estimator tier only)')
     group.add_argument('--amp', action='store_true', default=False,
                        help='bf16 compute (the TPU-native AMP)')
     group.add_argument('--amp-dtype', default='bfloat16', type=str)
@@ -291,6 +301,18 @@ class SyntheticLoader:
                    rng.randint(0, self.num_classes, self.batch_size))
 
 
+def _solver_model_kwargs(args):
+    """create_model kwargs for the autotune solver's abstract
+    (`nnx.eval_shape`) model build — the pre-mesh surfaces (--autotune, the
+    elastic re-solve) run before the real factory_kwargs are assembled."""
+    kw = dict(args.model_kwargs)
+    if args.num_classes is not None:
+        kw.setdefault('num_classes', args.num_classes)
+    if args.img_size is not None:
+        kw.setdefault('img_size', args.img_size)
+    return kw
+
+
 def main():
     from timm_tpu import create_model
     from timm_tpu.loss import BinaryCrossEntropy, JsdCrossEntropy, LabelSmoothingCrossEntropy, SoftTargetCrossEntropy
@@ -337,7 +359,8 @@ def main():
         plan = plan_elastic_resume(
             devices=jax.device_count(),
             batch_size=args.batch_size, grad_accum=args.grad_accum_steps,
-            fsdp=args.fsdp or None, tp=args.tp or None, resume=elastic_resume)
+            fsdp=args.fsdp or None, tp=args.tp or None, resume=elastic_resume,
+            model=args.model, model_kwargs=_solver_model_kwargs(args))
         args.fsdp, args.tp = plan.fsdp or 0, plan.tp or 0
         args.batch_size, args.grad_accum_steps = plan.batch_size, plan.grad_accum
         for note in plan.notes:
@@ -347,6 +370,21 @@ def main():
             f'tp={plan.tp}; global batch {plan.global_batch} = '
             f'{plan.batch_size} x {plan.grad_accum}'
             + (f' (held constant from {os.path.basename(plan.source)})' if plan.source else ''))
+
+    if args.autotune:
+        # rank every legal config for the live topology at the (possibly
+        # elastic-recovered) global batch, then apply the winner's flags —
+        # all before the mesh exists, so the run IS the winning config
+        from timm_tpu.autotune import apply_to_args, autotune, format_table
+        result = autotune(
+            args.model, _solver_model_kwargs(args),
+            global_batch=args.batch_size * args.grad_accum_steps,
+            probe_top_k=args.autotune_probe_top_k,
+            log=lambda m: _logger.info(f'[autotune] {m}'))
+        for line in format_table(result).splitlines():
+            _logger.info(f'[autotune] {line}')
+        for note in apply_to_args(args, result):
+            _logger.info(f'[autotune] applied {note}')
 
     mesh = create_mesh(fsdp=args.fsdp if args.fsdp else None,
                        tp=args.tp if args.tp else None)

@@ -168,11 +168,11 @@ def main():
 
     if args.results_file and results:
         if args.results_format == 'json':
-            with open(args.results_file, 'w') as f:
+            with open(args.results_file, 'w') as f:  # timm-tpu-lint: disable=process-zero-io single-process benchmark driver; no pod launch path
                 json.dump(results, f, indent=2)
         else:
             keys = max(results, key=len).keys()
-            with open(args.results_file, 'w') as f:
+            with open(args.results_file, 'w') as f:  # timm-tpu-lint: disable=process-zero-io single-process benchmark driver; no pod launch path
                 dw = csv_mod.DictWriter(f, fieldnames=keys)
                 dw.writeheader()
                 for r in results:

@@ -71,7 +71,7 @@ def main():
         except subprocess.TimeoutExpired:
             results.append({'model': name, 'ok': False, 'seconds': args.timeout, 'error': 'timeout'})
         print(f'[{i + 1}/{len(model_names)}] {name}: {"OK" if results[-1]["ok"] else "FAIL"}')
-        with open(args.results_file, 'w') as f:
+        with open(args.results_file, 'w') as f:  # timm-tpu-lint: disable=process-zero-io single-process bulk driver; children are processes, not a pod
             json.dump(results, f, indent=2)
     print(f'Wrote {args.results_file}')
 

@@ -76,6 +76,11 @@ def pytest_configure(config):
         'autotune: config autotuner — legal-space enumeration, roofline '
         'ranking, estimator/probed agreement, elastic re-solve, bucket-'
         'ladder DP (runs in tier-1)')
+    config.addinivalue_line(
+        'markers',
+        'multihost: multi-process pod runtime — KV-store consensus, '
+        'process-local sharded checkpoints, host-loss kill drill '
+        '(runs in tier-1)')
 
 
 @pytest.fixture(scope='session')

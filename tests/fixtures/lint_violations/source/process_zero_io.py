@@ -1,0 +1,24 @@
+"""Planted process-zero-io violation: a driver-style summary write with no
+primary-process guard — on a pod every host would race this file."""
+import json
+
+rank = 0
+
+
+def write_summary(output_dir, metrics):
+    with open(output_dir + '/summary.json', 'w') as f:
+        json.dump(metrics, f)
+
+
+def write_guarded(output_dir, metrics, args=None):
+    # the guarded spellings the rule must accept
+    if rank == 0:
+        with open(output_dir + '/args.yaml', 'w') as f:
+            f.write('ok')
+    if is_primary(args):
+        with open(output_dir + '/best.json', 'w') as f:
+            json.dump(metrics, f)
+
+
+def is_primary(args=None):
+    return rank == 0

@@ -1,0 +1,8 @@
+"""Waived twin of process_zero_io.py: the same unguarded write carrying an
+explicit reason — suppressed, but kept in the report's audit trail."""
+import json
+
+
+def write_summary(output_dir, metrics):
+    with open(output_dir + '/summary.json', 'w') as f:  # timm-tpu-lint: disable=process-zero-io fixture twin: single-process tool by design
+        json.dump(metrics, f)

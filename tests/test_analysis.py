@@ -38,7 +38,7 @@ MIGRATED = {'donation-declared', 'partition-rules', 'kernel-registered',
             'fp32-softmax', 'silent-except'}
 NEW = {'host-sync', 'traced-branch', 'pragma-syntax', 'large-literal',
        'dtype-promotion', 'donation-alias', 'replicated-residual',
-       'baked-constant', 'zoo-abstract-trace'}
+       'baked-constant', 'zoo-abstract-trace', 'process-zero-io'}
 
 
 # ---- 1. pragma semantics ----------------------------------------------------
@@ -161,7 +161,7 @@ def test_tier_a_clean_at_head():
     report = run_analysis(AnalysisContext(), select(tiers=['A']))
     assert report.exit_code == EXIT_CLEAN, report.format_text()
     assert set(report.rules) >= (MIGRATED | {'host-sync', 'traced-branch',
-                                             'pragma-syntax'})
+                                             'pragma-syntax', 'process-zero-io'})
 
 
 # ---- 4. planted violations --------------------------------------------------
@@ -177,6 +177,7 @@ def _run_rule(rule_name, subdir):
     ('host-sync', 'host_sync.py'),
     ('traced-branch', 'traced_branch.py'),
     ('fp32-softmax', 'fp32_softmax.py'),
+    ('process-zero-io', 'process_zero_io.py'),
 ])
 def test_planted_source_violation_fails_and_waiver_suppresses(rule_name, filename):
     report = _run_rule(rule_name, 'source')

@@ -9,14 +9,20 @@ final params/optimizer state must match an uninterrupted run to ≤1e-6.
 
 The drill pins each child's topology via XLA_FLAGS
 (--xla_force_host_platform_device_count), so these tests spawn grandchildren
-and are the slowest resilience drills — but they are the acceptance criteria,
-so they stay in tier-1.
+and are the slowest resilience drills. Since the multi-host PR they run under
+`-m slow` (~5 min of subprocess wall time for properties that are otherwise
+covered fast): the in-process twins below exercise the same planner decisions
+(plan_elastic_resume clamp + re-solve, rescale_for_devices, loader-position
+conversion against a real recovery checkpoint), and the process-boundary +
+`--resume auto --elastic` acceptance stays in tier-1 via the multi-host kill
+drill (tests/test_multihost.py), whose resume leg replans 2 processes -> 1.
 """
 import json
 import os
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 pytestmark = pytest.mark.elastic
@@ -34,15 +40,80 @@ def _drill(mode, workdir):
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+@pytest.mark.slow
 def test_elastic_shrink_8_to_4(tmp_path):
+    """Full subprocess acceptance drill (see module docstring for why this is
+    `-m slow`): fast twin `test_elastic_plan_shrink_in_process` below."""
     out = _drill('elastic8to4', tmp_path)
     assert out['saved_global_batch'] == 8  # geometry recorded by the dead run
     assert out['max_param_diff'] <= 1e-6, out
     assert out['recovery_pruned'], out  # end-of-epoch save reaped the recovery file
 
 
+@pytest.mark.slow
 def test_elastic_grow_4_to_8(tmp_path):
+    """Full subprocess acceptance drill (see module docstring for why this is
+    `-m slow`): fast twin `test_elastic_plan_grow_in_process` below."""
     out = _drill('elastic4to8', tmp_path)
     assert out['saved_global_batch'] == 8
     assert out['max_param_diff'] <= 1e-6, out
     assert out['recovery_pruned'], out
+
+
+# ---------------------------------------------------------------------------
+# fast in-process twins of the subprocess drills: the same planner decisions
+# against a real recovery checkpoint, no grandchildren
+# ---------------------------------------------------------------------------
+
+def _write_recovery(tmp_path, global_batch=8, batch_size=8, name='recovery-0-3.npz'):
+    from timm_tpu.resilience import atomic_write_npz
+    path = str(tmp_path / name)
+    atomic_write_npz(path, {
+        'state_dict.w': np.zeros((2, 2), np.float32),
+        '_resume.global_batch': np.asarray(global_batch),
+        '_resume.batch_size': np.asarray(batch_size),
+        '_resume.loader_batches': np.asarray(3),
+    }, meta={'epoch': 0})
+    return path
+
+
+def test_elastic_plan_shrink_in_process(tmp_path):
+    """8 -> 4 devices: same decisions the `elastic8to4` drill asserts via
+    train.py — global batch held constant from the dead run's recovery state,
+    fsdp=4 still legal on 4 devices, loader batch preserved (bit-deterministic
+    resume order), loader position convertible exactly."""
+    from timm_tpu.resilience import convert_loader_position, plan_elastic_resume
+    path = _write_recovery(tmp_path, global_batch=8, batch_size=8)
+    plan = plan_elastic_resume(4, batch_size=8, grad_accum=1, fsdp=4,
+                               resume=path)
+    assert plan.global_batch == 8 and plan.source == path
+    assert plan.batch_size == 8 and plan.grad_accum == 1
+    assert plan.fsdp == 4
+    assert convert_loader_position(3, 8, plan.batch_size) == (3, True)
+
+
+def test_elastic_plan_grow_in_process(tmp_path):
+    """4 -> 8 devices: growing the mesh must not inflate the global batch —
+    the invariant the `elastic4to8` drill enforces end-to-end."""
+    from timm_tpu.resilience import plan_elastic_resume
+    path = _write_recovery(tmp_path, global_batch=8, batch_size=8)
+    plan = plan_elastic_resume(8, batch_size=8, grad_accum=1, fsdp=4,
+                               resume=path)
+    assert plan.global_batch == 8
+    assert plan.batch_size * plan.grad_accum == 8
+    assert plan.batch_size % 8 == 0  # still shards over all 8 devices
+
+
+def test_elastic_plan_clamps_and_rescales(tmp_path):
+    """The clamp/rescale fallback paths: a dead run's fsdp=8 on a 4-device
+    restart clamps to the largest divisor, and an accum run re-solves
+    batch_size x accum while keeping the recovered global batch."""
+    from timm_tpu.resilience import plan_elastic_resume, rescale_for_devices
+    path = _write_recovery(tmp_path, global_batch=16, batch_size=8)
+    plan = plan_elastic_resume(4, batch_size=8, grad_accum=2, fsdp=8,
+                               resume=path)
+    assert plan.fsdp == 4 and any('clamped' in n for n in plan.notes)
+    assert plan.batch_size * plan.grad_accum == 16
+    assert rescale_for_devices(16, 4, prefer_batch_size=8) == (8, 2)
+    with pytest.raises(ValueError, match='[Nn]earest legal'):
+        rescale_for_devices(6, 4)

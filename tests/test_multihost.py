@@ -1,0 +1,338 @@
+"""Multi-process pod runtime tests: KV-store consensus, process-local sharded
+checkpoints, crash-safe manifest commits, and the 2-process host-loss drill.
+
+The subprocess drill (`test_kill_host_drill_2process`) is the tier-1
+acceptance gate: real cluster bring-up over `jax.distributed.initialize`,
+SIGKILL of one host mid-epoch, survivor consensus via the coordination
+service's KV store, and an elastic single-process resume from the
+host-sharded checkpoint that matches the uninterrupted baseline. Everything
+else here is its fast in-process decomposition.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from timm_tpu.resilience import durable
+
+pytestmark = pytest.mark.multihost
+
+FIXTURES = os.path.join(os.path.dirname(__file__), 'fixtures')
+
+
+# ---------------------------------------------------------------------------
+# KV-store consensus (all_hosts_flag with a name)
+# ---------------------------------------------------------------------------
+
+class FakeKV:
+    """Stand-in for the coordination-service client: a dict with timeouts."""
+
+    def __init__(self, fail_set=False):
+        self.store = {}
+        self.fail_set = fail_set
+        self.sets = []
+
+    def key_value_set(self, k, v):
+        if self.fail_set:
+            raise RuntimeError('coordinator unreachable')
+        self.sets.append(k)
+        self.store[k] = v
+
+    def blocking_key_value_get(self, k, timeout_ms):
+        if k in self.store:
+            return self.store[k]
+        raise TimeoutError(f'timeout waiting for {k}')
+
+
+@pytest.fixture
+def three_process_world(monkeypatch):
+    """Pretend this host is process 0 of 3 for the KV consensus path (pure
+    gRPC bookkeeping — no device collectives are touched)."""
+    import jax
+    monkeypatch.setattr(jax, 'process_count', lambda: 3)
+    monkeypatch.setattr(jax, 'process_index', lambda: 0)
+    yield
+
+
+def _consensus(client, local, mode, name, timeout_s=0.01):
+    from timm_tpu.parallel.distributed import _kv_flag_consensus
+    return _kv_flag_consensus(client, local, mode, name, timeout_s)
+
+
+def _prefill(client, name, values):
+    """Publish peer votes for the NEXT consensus round of `name`."""
+    from timm_tpu.parallel.distributed import _FLAG_SEQ
+    seq = _FLAG_SEQ.get(name, 0)
+    for p, v in values.items():
+        client.store[f'timm_tpu/flag/{name}/{seq}/p{p}'] = v
+
+
+def test_kv_consensus_any_and_all(three_process_world):
+    kv = FakeKV()
+    _prefill(kv, 't-any', {1: '0', 2: '1'})
+    assert _consensus(kv, False, 'any', 't-any') is True  # one host voted stop
+    _prefill(kv, 't-all', {1: '1', 2: '1'})
+    assert _consensus(kv, True, 'all', 't-all') is True
+    _prefill(kv, 't-all2', {1: '1', 2: '0'})
+    assert _consensus(kv, True, 'all', 't-all2') is False
+
+
+def test_kv_consensus_lost_peer_semantics(three_process_world):
+    # peer 2 never publishes: lost host => 'any' stops the pod, 'all' blocks
+    # the commit — both degradations are safe, neither deadlocks
+    kv = FakeKV()
+    _prefill(kv, 't-lost-any', {1: '0'})
+    assert _consensus(kv, False, 'any', 't-lost-any') is True
+    _prefill(kv, 't-lost-all', {1: '1'})
+    assert _consensus(kv, True, 'all', 't-lost-all') is False
+
+
+def test_kv_consensus_coordinator_unreachable(three_process_world):
+    kv = FakeKV(fail_set=True)
+    assert _consensus(kv, False, 'any', 't-down') is True
+    assert _consensus(kv, True, 'all', 't-down') is False
+
+
+def test_kv_consensus_rounds_use_fresh_keys(three_process_world):
+    # the KV store never forgets: per-name sequence numbers must isolate
+    # consecutive rounds or round 2 would read round 1's stale votes
+    kv = FakeKV()
+    _prefill(kv, 't-seq', {1: '1', 2: '1'})
+    assert _consensus(kv, True, 'all', 't-seq') is True
+    # round 2: peers have NOT voted yet — stale round-1 keys must not count
+    assert _consensus(kv, True, 'all', 't-seq') is False
+    assert len(set(kv.sets)) == len(kv.sets) == 2  # fresh key each round
+
+
+def test_all_hosts_flag_single_process_identity():
+    from timm_tpu.parallel import all_hosts_flag
+    assert all_hosts_flag(True, mode='any', name='t-id') is True
+    assert all_hosts_flag(False, mode='any', name='t-id') is False
+    assert all_hosts_flag(True, mode='all') is True
+    assert all_hosts_flag(False, mode='all') is False
+
+
+# ---------------------------------------------------------------------------
+# process-local sharded checkpoints (in-process, simulated 2-process split)
+# ---------------------------------------------------------------------------
+
+def _two_process_snapshots(arrays):
+    """Split a state dict into two process snapshots along axis 0 (chunked
+    like a 2-way batch/fsdp sharding would be); host scalars go to p0."""
+    snaps = []
+    for p in range(2):
+        chunks, specs = [], {}
+        for k, v in arrays.items():
+            v = np.asarray(v)
+            specs[k] = {'shape': list(v.shape), 'dtype': str(v.dtype)}
+            if v.ndim == 0 or v.shape[0] % 2:
+                if p == 0:
+                    chunks.append((k, [0] * v.ndim, list(v.shape), v))
+                continue
+            h = v.shape[0] // 2
+            start = [p * h] + [0] * (v.ndim - 1)
+            stop = [(p + 1) * h] + list(v.shape[1:])
+            chunks.append((k, start, stop, v[p * h:(p + 1) * h]))
+        snaps.append({'process_index': p, 'process_count': 2,
+                      'chunks': chunks, 'specs': specs})
+    return snaps
+
+
+def _state():
+    rng = np.random.RandomState(7)
+    return {
+        'state_dict.w': rng.randn(8, 6).astype(np.float32),
+        'optimizer.mu.w': rng.randn(8, 6).astype(np.float32),
+        'epoch': np.asarray(2),
+        '_resume.num_updates': np.asarray(11),
+        '_resume.global_batch': np.asarray(16),
+    }
+
+
+def test_sharded_roundtrip_two_process(tmp_path):
+    arrays = _state()
+    path = str(tmp_path / 'recovery-2-11.npz')
+    ok_barrier = lambda ok, mode, name=None: True  # noqa: E731
+    for snap in _two_process_snapshots(arrays):
+        durable.write_sharded_checkpoint(path, snap, meta={'epoch': 2}, barrier=ok_barrier)
+    ok, reason = durable.verify_checkpoint(path)
+    assert ok, reason
+    loaded, meta = durable.load_verified(path)
+    assert meta['epoch'] == 2
+    for k, v in arrays.items():
+        np.testing.assert_array_equal(loaded[k], v)
+    # sharded checkpoints surface under their logical name in dir scans
+    assert durable.find_checkpoints(str(tmp_path)) == [path]
+    assert durable.read_checkpoint_scalar(path, '_resume.global_batch') == 16
+
+
+def test_sharded_commit_requires_all_barrier(tmp_path):
+    """Manifest-commit ordering: a failed 'all' barrier (dead peer) must leave
+    the PREVIOUS checkpoint as the newest valid one — the manifest is the
+    commit record, shard files alone are litter."""
+    old = str(tmp_path / 'recovery-0-1.npz')
+    new = str(tmp_path / 'recovery-0-3.npz')
+    ok_barrier = lambda ok, mode, name=None: True  # noqa: E731
+    dead_barrier = lambda ok, mode, name=None: False  # noqa: E731
+    for snap in _two_process_snapshots(_state()):
+        durable.write_sharded_checkpoint(old, snap, meta={'epoch': 0}, barrier=ok_barrier)
+    # the next save: shards land, the barrier fails (host died) => no commit
+    p0_only = _two_process_snapshots(_state())[0]
+    assert durable.write_sharded_checkpoint(new, p0_only, meta={'epoch': 0},
+                                            barrier=dead_barrier) is None
+    assert not os.path.exists(durable.manifest_path(new))
+    assert os.path.exists(durable.shard_file_path(new, 0, 2))  # litter stays
+    assert durable.resolve_auto_resume(str(tmp_path)) == old
+    # startup sweep removes the orphan shard; the committed one survives
+    removed = durable.sweep_orphan_shards(str(tmp_path))
+    assert durable.shard_file_path(new, 0, 2) in removed
+    assert durable.verify_checkpoint(old)[0]
+
+
+def test_sharded_corrupt_shard_falls_back(tmp_path):
+    ok_barrier = lambda ok, mode, name=None: True  # noqa: E731
+    old = str(tmp_path / 'recovery-0-1.npz')
+    new = str(tmp_path / 'recovery-0-3.npz')
+    for p_snap in _two_process_snapshots(_state()):
+        durable.write_sharded_checkpoint(old, p_snap, meta={'epoch': 0}, barrier=ok_barrier)
+        durable.write_sharded_checkpoint(new, p_snap, meta={'epoch': 0}, barrier=ok_barrier)
+    # flip bytes in one committed shard: verification must reject the WHOLE
+    # sharded checkpoint and fall back to the older valid one
+    victim = durable.shard_file_path(new, 1, 2)
+    with open(victim, 'r+b') as f:
+        f.seek(os.path.getsize(victim) // 2)
+        f.write(b'\xff\xff\xff\xff')
+    ok, reason = durable.verify_checkpoint(new)
+    assert not ok and 'shard' in reason
+    _, _, used = durable.load_with_fallback(new, search_dir=str(tmp_path))
+    assert used == old
+
+
+def test_sharded_remove_and_copy(tmp_path):
+    ok_barrier = lambda ok, mode, name=None: True  # noqa: E731
+    src = str(tmp_path / 'last.npz')
+    dst = str(tmp_path / 'checkpoint-0.npz')
+    for snap in _two_process_snapshots(_state()):
+        durable.write_sharded_checkpoint(src, snap, meta={'epoch': 0}, barrier=ok_barrier)
+    for p in range(2):
+        durable.copy_sharded_checkpoint(src, dst, p, 2, barrier=ok_barrier)
+    assert durable.verify_checkpoint(dst)[0]
+    durable.remove_checkpoint_files(dst)  # primary removes everything
+    assert not os.path.exists(durable.manifest_path(dst))
+    assert not os.path.exists(durable.shard_file_path(dst, 0, 2))
+    assert durable.verify_checkpoint(src)[0]  # source untouched
+
+
+# ---------------------------------------------------------------------------
+# single-process byte-identity regression (the refactor must not change the
+# on-disk format of plain checkpoints — manifest vs the checked-in HEAD one)
+# ---------------------------------------------------------------------------
+
+def _head_fixture_state():
+    """EXACT recipe used to generate fixtures/durable_manifest_head.json at
+    HEAD, before the sharded-checkpoint refactor touched durable.py."""
+    rng = np.random.RandomState(1234)
+    state = {}
+    state['state_dict.blocks.0.attn.qkv.kernel'] = rng.standard_normal((8, 24)).astype(np.float32)
+    state['state_dict.head.bias'] = rng.standard_normal((10,)).astype(np.float32)
+    state['optimizer.mu.head.bias'] = rng.standard_normal((10,)).astype(np.float32)
+    state['epoch'] = np.asarray(3)
+    state['_resume.num_updates'] = np.asarray(17)
+    state['ema.pos_embed'] = rng.standard_normal((1, 4, 8)).astype(np.float16)
+    return state
+
+
+def test_single_process_save_byte_identical_to_head(tmp_path):
+    with open(os.path.join(FIXTURES, 'durable_manifest_head.json')) as f:
+        head = json.load(f)
+    path = str(tmp_path / 'last.npz')
+    durable.atomic_write_npz(path, _head_fixture_state(),
+                             meta={'epoch': 3, 'metric': 0.5})
+    with open(durable.manifest_path(path)) as f:
+        now = json.load(f)
+    assert now['arrays'] == head['arrays'], (
+        'single-process checkpoint bytes changed: per-array SHA-256 no longer '
+        'matches the pre-refactor HEAD manifest')
+    assert now['schema_version'] == head['schema_version']
+    assert now['meta'] == head['meta']
+
+
+def test_head_single_process_checkpoint_loads_unchanged(tmp_path):
+    """A checkpoint written in the HEAD (pre-refactor) format — plain npz +
+    manifest, no 'format' key — must verify and load through the new code."""
+    path = str(tmp_path / 'last.npz')
+    state = _head_fixture_state()
+    durable.atomic_write_npz(path, state, meta={'epoch': 3, 'metric': 0.5})
+    manifest = durable.read_manifest(path)
+    assert not durable.is_sharded_manifest(manifest)
+    ok, reason = durable.verify_checkpoint(path)
+    assert ok, reason
+    loaded, meta = durable.load_verified(path)
+    assert meta['epoch'] == 3
+    for k, v in state.items():
+        np.testing.assert_array_equal(loaded[k], v)
+    assert durable.read_checkpoint_scalar(path, '_resume.num_updates') == 17
+
+
+# ---------------------------------------------------------------------------
+# loader position under process-count change (global-batch invariant)
+# ---------------------------------------------------------------------------
+
+def test_loader_position_invariant_under_process_count_change():
+    """`_resume.batch_size` stores the GLOBAL batch, so a 2-process -> 1-
+    process restart needs NO conversion (same global batch => same loader
+    position), and a halved global batch doubles the position exactly."""
+    from timm_tpu.resilience import convert_loader_position
+    same, exact = convert_loader_position(5, 16, 16)
+    assert (same, exact) == (5, True)
+    doubled, exact = convert_loader_position(5, 16, 8)
+    assert (doubled, exact) == (10, True)
+    halved, exact = convert_loader_position(5, 8, 16)
+    assert (halved, exact) == (2, False)  # partial batch re-seen, never skipped
+
+
+def test_synthetic_loader_process_shards_union_to_global():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'train_mod', os.path.join(os.path.dirname(__file__), '..', 'train.py'))
+    train_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(train_mod)
+    single = train_mod.SyntheticLoader(32, 8, 16, 10, seed=3)
+    shard0 = train_mod.SyntheticLoader(32, 8, 16, 10, seed=3, process_index=0, process_count=2)
+    shard1 = train_mod.SyntheticLoader(32, 8, 16, 10, seed=3, process_index=1, process_count=2)
+    assert len(single) == len(shard0) == len(shard1)
+    for (x, y), (x0, y0), (x1, y1) in zip(single, shard0, shard1):
+        np.testing.assert_array_equal(np.concatenate([x0, x1]), x)
+        np.testing.assert_array_equal(np.concatenate([y0, y1]), y)
+    with pytest.raises(ValueError):
+        train_mod.SyntheticLoader(32, 9, 16, 10, process_count=2)
+
+
+def test_kill_host_fault_spec():
+    from timm_tpu.resilience import FaultInjector
+    fi = FaultInjector('kill_host@6:1')
+    assert fi.kill_host_process == 1
+    assert not fi.kill_host_at(6, process_index=0)
+    assert fi.kill_host_at(6, process_index=1)
+    assert not fi.kill_host_at(6, process_index=1)  # fires exactly once
+    assert FaultInjector('kill_host@2').kill_host_process == 0
+    with pytest.raises(ValueError):
+        FaultInjector('kill_host@2:-1')
+
+
+# ---------------------------------------------------------------------------
+# the real thing: 2-process cluster, host killed mid-epoch (tier-1 gate)
+# ---------------------------------------------------------------------------
+
+def test_kill_host_drill_2process(tmp_path):
+    """Full acceptance drill (see timm_tpu/resilience/multihost.py): sharded
+    save -> SIGKILL host 1 mid-epoch -> survivor stops via KV consensus and
+    exits 0 -> uncommitted shard litter is ignored -> fresh single-process
+    cluster resumes `--resume auto --elastic` -> final params match the
+    uninterrupted baseline to 1e-6."""
+    from timm_tpu.resilience import run_kill_drill
+    result = run_kill_drill(str(tmp_path), processes=2, kill_update=4,
+                            timeout=240, log=lambda m: print(f'[drill] {m}'))
+    assert result['ok'], (result['checks'], result['details'])
+    assert result['details']['max_param_diff'] <= 1e-6

@@ -370,6 +370,98 @@ def traced_branch(ctx: AnalysisContext) -> List[Finding]:
     return findings
 
 
+# ---- process-zero-io --------------------------------------------------------
+
+_RANK_NAMES = ('rank', 'local_rank', 'process_index', 'process_id')
+
+
+def _mentions_rank(node: ast.expr) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in _RANK_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _RANK_NAMES:
+            return True
+    return False
+
+
+def _is_primary_guard(test: ast.expr) -> bool:
+    """True when an `if` test gates on the primary process: a call to
+    `is_primary(...)`, or a comparison of a rank/process_index value
+    against 0 (`rank == 0`, `jax.process_index() == 0`, ...)."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if ((isinstance(f, ast.Name) and f.id == 'is_primary')
+                    or (isinstance(f, ast.Attribute) and f.attr == 'is_primary')):
+                return True
+        if isinstance(n, ast.Compare):
+            sides = [n.left] + list(n.comparators)
+            if (any(isinstance(s, ast.Constant) and s.value == 0 for s in sides)
+                    and any(_mentions_rank(s) for s in sides)):
+                return True
+    return False
+
+
+def _open_for_write(node: ast.Call) -> bool:
+    if not (isinstance(node.func, ast.Name) and node.func.id == 'open'):
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == 'mode':
+            mode = kw.value
+    return (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+            and any(c in mode.value for c in 'wax'))
+
+
+def _unguarded_writes(tree: ast.Module) -> Iterable[ast.Call]:
+    def visit(node, guarded: bool):
+        if isinstance(node, ast.If) and _is_primary_guard(node.test):
+            # the else-branch of a primary guard is explicitly NOT primary
+            for ch in node.body:
+                visit(ch, True)
+            for ch in node.orelse:
+                visit(ch, guarded)
+            return
+        if isinstance(node, ast.Call) and _open_for_write(node) and not guarded:
+            yield_list.append(node)
+        for ch in ast.iter_child_nodes(node):
+            visit(ch, guarded)
+
+    yield_list: List[ast.Call] = []
+    visit(tree, False)
+    return yield_list
+
+
+@rule('process-zero-io', 'A',
+      'top-level driver scripts write non-shard files only on the primary '
+      'process: every open-for-write sits under an `is_primary()` / '
+      '`rank == 0` guard or carries a waiver — on a pod, N hosts racing one '
+      'summary/args/results file corrupt it (per-process shard writes live '
+      'in the durable library, not in drivers)')
+def process_zero_io(ctx: AnalysisContext) -> List[Finding]:
+    pkg_dir = ctx.source_dir(_PACKAGE)
+    if pkg_dir != ctx.root:
+        files = [os.path.join(ctx.root, f) for f in sorted(os.listdir(ctx.root))
+                 if f.endswith('.py')]
+    else:
+        # fixture layout: the flat planted-violation directory IS the root
+        files = ctx.walk_files()
+    findings = []
+    for path in files:
+        tree = ctx.ast_of(path)
+        if tree is None:
+            continue
+        for call in _unguarded_writes(tree):
+            findings.append(ctx.finding(
+                'process-zero-io', path, call.lineno,
+                'file write outside an `is_primary()` / `rank == 0` guard — '
+                'every pod host would race this write; guard it or waive '
+                'with `# timm-tpu-lint: disable=process-zero-io <reason>`'))
+    return findings
+
+
 # ---- pragma-syntax ----------------------------------------------------------
 
 @rule('pragma-syntax', 'A',

@@ -1,10 +1,11 @@
 from .mesh import (
-    batch_axes, create_mesh, data_sharding, get_global_mesh, nonmodel_batch_axes, peek_global_mesh,
+    batch_axes, create_mesh, data_sharding, get_global_mesh, mesh_process_count,
+    nonmodel_batch_axes, peek_global_mesh, place_global,
     replicate_sharding, resolve_elastic_axes, set_global_mesh, shard_batch,
 )
 from .distributed import (
-    all_hosts_flag, init_distributed_device, is_distributed_env, is_primary, reduce_tensor,
-    world_info,
+    all_hosts_flag, barrier_timeout_s, coordination_client, init_distributed_device,
+    is_distributed_env, is_primary, reduce_tensor, world_info,
 )
 from .sharding import (
     PartitionRule, abstract_init_sharded, activation_bytes_per_device, build_opt_shardings,

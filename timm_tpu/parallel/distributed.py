@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Optional, Tuple
+import threading
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -19,9 +20,16 @@ import jax.numpy as jnp
 _logger = logging.getLogger(__name__)
 
 __all__ = ['is_distributed_env', 'init_distributed_device', 'world_info', 'is_primary',
-           'reduce_tensor', 'all_hosts_flag']
+           'reduce_tensor', 'all_hosts_flag', 'coordination_client', 'barrier_timeout_s']
 
 _INITIALIZED = False
+
+# Per-name monotonic sequence numbers for the KV-store consensus path. Every
+# process calls a named consensus at the same points in the same order (it is
+# a collective by contract), so independently-maintained counters agree and
+# each round reads fresh keys even though the KV store never forgets.
+_FLAG_SEQ: Dict[str, int] = {}
+_FLAG_LOCK = threading.Lock()
 
 
 def is_distributed_env() -> bool:
@@ -42,7 +50,13 @@ def init_distributed_device(args=None) -> Tuple[int, int, int]:
     init_distributed_device(args) mutating args.{distributed,world_size,rank,local_rank}.
     """
     global _INITIALIZED
-    if is_distributed_env() and not _INITIALIZED:
+    forced = bool(getattr(args, 'distributed', False))
+    if not _INITIALIZED and coordination_client() is not None:
+        # train.py's _bootstrap_distributed (or a host harness) already ran
+        # jax.distributed.initialize() — importing timm_tpu touches the XLA
+        # backend, so the bring-up must happen before this module can load
+        _INITIALIZED = True
+    if (is_distributed_env() or forced) and not _INITIALIZED:
         coord = os.environ.get('COORDINATOR_ADDRESS') or os.environ.get('JAX_COORDINATOR_ADDRESS')
         kwargs = {}
         if coord:
@@ -51,9 +65,16 @@ def init_distributed_device(args=None) -> Tuple[int, int, int]:
                 kwargs['num_processes'] = int(os.environ['NUM_PROCESSES'])
             if os.environ.get('PROCESS_ID'):
                 kwargs['process_id'] = int(os.environ['PROCESS_ID'])
-        jax.distributed.initialize(**kwargs)
-        _INITIALIZED = True
-        _logger.info(f'Initialized multi-host JAX: process {jax.process_index()}/{jax.process_count()}')
+        try:
+            jax.distributed.initialize(**kwargs)
+            _INITIALIZED = True
+            _logger.info(f'Initialized multi-host JAX: process {jax.process_index()}/{jax.process_count()}')
+        except Exception:
+            if not forced or is_distributed_env():
+                raise
+            # --distributed without any cluster env: fall back to single-process
+            _logger.warning('--distributed requested but no coordinator/cluster '
+                            'env detected; continuing single-process')
 
     world_size = jax.process_count()
     rank = jax.process_index()
@@ -75,14 +96,86 @@ def is_primary(args=None) -> bool:
     return jax.process_index() == 0
 
 
-def all_hosts_flag(local_flag: bool, mode: str = 'any') -> bool:
+def coordination_client():
+    """The distributed coordination-service client, or None outside a
+    multi-process run. Its key-value RPCs are plain gRPC — thread-safe and,
+    unlike device collectives, they FAIL (timeout) instead of deadlocking
+    when a peer process has died. That makes them the only safe transport
+    for consensus in the presence of host loss."""
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client
+    except Exception:
+        return None
+
+
+def barrier_timeout_s() -> float:
+    """How long a named consensus waits for a peer before declaring it lost
+    (TIMM_TPU_BARRIER_TIMEOUT seconds, default 20)."""
+    try:
+        return float(os.environ.get('TIMM_TPU_BARRIER_TIMEOUT', '20'))
+    except ValueError:
+        return 20.0
+
+
+def _kv_flag_consensus(client, local_flag: bool, mode: str, name: str,
+                       timeout_s: Optional[float]) -> bool:
+    """Named consensus over the coordination service's KV store.
+
+    Dead-peer semantics: a peer that never publishes its flag within the
+    timeout is treated as LOST, which resolves to True under mode='any'
+    (a lost host means the pod must stop) and False under mode='all'
+    (an unconfirmed shard means the manifest must not commit). Both
+    degradations are safe: the worst case is an extra recovery cycle or a
+    skipped checkpoint commit, never a deadlock or a torn manifest."""
+    with _FLAG_LOCK:
+        seq = _FLAG_SEQ.get(name, 0)
+        _FLAG_SEQ[name] = seq + 1
+    rank, world = jax.process_index(), jax.process_count()
+    timeout_ms = max(1, int(1000 * (barrier_timeout_s() if timeout_s is None else timeout_s)))
+
+    def key(p: int) -> str:
+        return f'timm_tpu/flag/{name}/{seq}/p{p}'
+
+    try:
+        client.key_value_set(key(rank), '1' if local_flag else '0')
+    except Exception:  # coordinator unreachable: behave like a lost peer
+        return mode == 'any'
+    result_any, result_all, lost = bool(local_flag), bool(local_flag), False
+    for p in range(world):
+        if p == rank:
+            continue
+        try:
+            v = client.blocking_key_value_get(key(p), timeout_ms)
+            result_any = result_any or v == '1'
+            result_all = result_all and v == '1'
+        except Exception:
+            lost = True
+    if mode == 'any':
+        return True if lost else result_any
+    return False if lost else result_all
+
+
+def all_hosts_flag(local_flag: bool, mode: str = 'any',
+                   name: Optional[str] = None,
+                   timeout_s: Optional[float] = None) -> bool:
     """Cross-host boolean consensus for HOST-LOCAL signals (a SIGTERM may be
     delivered to only some hosts of a pod, but every host must act on the
     same step or the next collective deadlocks). Single-process: identity.
-    Multi-host: a tiny allgather; every host must call this at the same point
-    in its step sequence (it is a collective). `mode` is 'any' or 'all'."""
+    `mode` is 'any' or 'all'. Every host must call this at the same point in
+    its step sequence.
+
+    With a `name`, consensus runs over the coordination service's KV store
+    (see `_kv_flag_consensus`): it survives a dead peer by timing out and
+    resolving 'any'->True / 'all'->False instead of hanging. Without a name
+    (or outside jax.distributed.initialize) it is a device allgather, which
+    requires every host alive."""
     if jax.process_count() <= 1:
         return bool(local_flag)
+    if name is not None:
+        client = coordination_client()
+        if client is not None:
+            return _kv_flag_consensus(client, local_flag, mode, name, timeout_s)
     from jax.experimental import multihost_utils
     flags = multihost_utils.process_allgather(jnp.asarray([1 if local_flag else 0], jnp.int32))
     import numpy as np

@@ -25,6 +25,7 @@ Mesh shapes:
 """
 from __future__ import annotations
 
+import math
 import os
 from typing import Optional, Sequence, Tuple
 
@@ -35,7 +36,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     'create_mesh', 'data_sharding', 'replicate_sharding', 'shard_batch',
     'get_global_mesh', 'set_global_mesh', 'peek_global_mesh', 'batch_axes',
-    'nonmodel_batch_axes', 'resolve_elastic_axes',
+    'nonmodel_batch_axes', 'resolve_elastic_axes', 'place_global',
+    'mesh_process_count',
 ]
 
 _GLOBAL_MESH: Optional[Mesh] = None
@@ -77,8 +79,18 @@ def create_mesh(
         tp = int(os.environ.get('TIMM_TPU_TP', '1') or 1)
     tp = max(1, tp)
     if num_slices is None:
-        # group by process/slice when running multi-host
-        slice_ids = {getattr(d, 'slice_index', 0) for d in devices}
+        # group by slice when the platform reports one (TPU pods); otherwise
+        # one DCN group per host process — this is what makes the 'dcn' axis
+        # real for multi-process CPU clusters, where devices carry a
+        # process_index but no slice_index. jax.devices() is process-major,
+        # so reshape(num_slices, -1) puts each process's devices in one row.
+        slice_ids = {getattr(d, 'slice_index', None) for d in devices}
+        if len(slice_ids) == 1 and getattr(devices[0], 'platform', '') == 'cpu':
+            # multi-process CPU clusters report one slice (or none), but the
+            # cross-process links are gRPC — DCN-class, not ICI. Group by
+            # process so the 'dcn' axis is real. Single-slice TPU pods keep
+            # their all-ICI mesh (one slice, no dcn axis).
+            slice_ids = {getattr(d, 'process_index', 0) for d in devices}
         num_slices = len(slice_ids)
     # trailing axes (closest ICI neighbours) host the most collective-hungry
     # parallelism: fsdp before model, model innermost
@@ -194,11 +206,37 @@ def replicate_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def mesh_process_count(mesh: Mesh) -> int:
+    """How many distinct host processes own devices of this mesh (1 for every
+    single-process run, regardless of device count)."""
+    return len({getattr(d, 'process_index', 0) for d in mesh.devices.flat})
+
+
+def place_global(x, sharding: NamedSharding):
+    """`jax.device_put` that also works for non-fully-addressable shardings.
+
+    In a multi-process run a sharding spanning other hosts' devices cannot be
+    device_put from host data; `make_array_from_callback` builds the global
+    array from the locally-addressable pieces instead (each process supplies
+    only the index slices its own devices need). Single-process shardings take
+    the plain device_put fast path, byte-for-byte identical to before."""
+    if getattr(sharding, 'is_fully_addressable', True):
+        return jax.device_put(x, sharding)
+    xnp = np.asarray(x)
+    return jax.make_array_from_callback(xnp.shape, sharding, lambda idx: xnp[idx])
+
+
 def shard_batch(batch, mesh: Optional[Mesh] = None):
     """Place a host batch (pytree of arrays) sharded over the mesh batch axes
     (their product for multi-axis ('data', 'fsdp'[, 'model']) meshes).
     Non-array leaves pass through; 0-d arrays are replicated (a rank-0 value
     has no batch dim to shard — seq_len/step counters in dict batches).
+
+    Multi-process meshes: each process passes its PROCESS-LOCAL batch (the
+    loaders shard by process_index); the global batch is assembled via
+    `jax.make_array_from_process_local_data`, with the global batch dim =
+    local rows x participating processes. Device order is process-major, so
+    process p contributes rows [p*local, (p+1)*local) of the global batch.
 
     Raises a loud ValueError when the global batch is not divisible by the
     total batch-shard count — the alternative is an opaque XLA reshape error
@@ -207,22 +245,34 @@ def shard_batch(batch, mesh: Optional[Mesh] = None):
     axes = batch_axes(mesh)
     sizes = [(a, int(mesh.shape[a])) for a in axes]
     n_shards = int(np.prod([s for _, s in sizes]))
+    n_procs = mesh_process_count(mesh)
 
     def put(x):
         ndim = getattr(x, 'ndim', None)
         if ndim is None:
             return x
         if ndim == 0:
-            return jax.device_put(x, replicate_sharding(mesh))
-        if x.shape[0] % n_shards != 0:
+            return place_global(x, replicate_sharding(mesh))
+        global_b = x.shape[0] * n_procs
+        if global_b % n_shards != 0:
             b = x.shape[0]
-            lo, hi = (b // n_shards) * n_shards, -(-b // n_shards) * n_shards
+            step = n_shards * n_procs // math.gcd(n_shards, n_procs)
+            lo, hi = (global_b // step) * step, -(-global_b // step) * step
             nearest = f'{hi}' if lo == 0 else f'{lo} or {hi}'
+            local_hint = '' if n_procs == 1 else (
+                f' ({lo // n_procs} or {hi // n_procs} local rows per process)')
             raise ValueError(
-                f'Global batch dim {b} is not divisible by the mesh batch-shard '
+                f'Global batch dim {global_b} ({b} local rows x {n_procs} process(es)) '
+                f'is not divisible by the mesh batch-shard '
                 f'count {n_shards}: the batch shards over the product of ALL mesh axes '
-                f'({_mesh_axes_str(sizes)}). Nearest legal global batch: {nearest}. '
+                f'({_mesh_axes_str(sizes)}). Nearest legal global batch: '
+                f'{nearest}{local_hint}. '
                 f'Pad the batch or pick a batch size that divides evenly — e.g. '
                 f'validate.py pads the final partial batch.')
-        return jax.device_put(x, data_sharding(mesh, ndim=ndim))
+        sharding = data_sharding(mesh, ndim=ndim)
+        if n_procs > 1:
+            xnp = np.asarray(x)
+            return jax.make_array_from_process_local_data(
+                sharding, xnp, (global_b,) + xnp.shape[1:])
+        return jax.device_put(x, sharding)
     return jax.tree.map(put, batch)

@@ -225,6 +225,15 @@ REPLAY_STEPS: Tuple[Dict, ...] = (
                'BENCH_SELF.json, where autotune.load_correction picks it up)',
          dry=dict(_TINY, global_batch=64, top_k=2, steps=2),
          live=dict(_VITB, global_batch=1024, top_k=3, steps=10)),
+    dict(id='multihost', item=None, kind='multihost',
+         title='multi-process pod drill: 2-process CPU cluster over '
+               'jax.distributed, SIGKILL one host mid-epoch — survivor '
+               'consensus + crash-safe manifest commit (dry = kill leg only; '
+               'live adds the baseline-parity and elastic-resume legs)',
+         dry=dict(processes=2, kill_update=4, compare=False, resume=False,
+                  timeout=240),
+         live=dict(processes=2, kill_update=4, compare=True, resume=True,
+                   timeout=600)),
 )
 
 
@@ -685,6 +694,32 @@ def _run_autotune(spec: Dict, live: bool) -> Dict:
     return out
 
 
+def _run_multihost(spec: Dict) -> Dict:
+    """Run the host-loss kill drill (timm_tpu.resilience.multihost) as a bench
+    step: real 2-process cluster bring-up, SIGKILL mid-epoch, survivor KV
+    consensus, crash-safe manifest commit. A failed check fails the step."""
+    import shutil
+    import tempfile
+
+    from ..resilience.multihost import run_kill_drill
+
+    workdir = spec.get('workdir') or tempfile.mkdtemp(prefix='bench_multihost_')
+    result = run_kill_drill(
+        workdir,
+        processes=int(spec.get('processes', 2)),
+        kill_update=int(spec.get('kill_update', 4)),
+        compare=bool(spec.get('compare', False)),
+        resume=bool(spec.get('resume', False)),
+        timeout=float(spec.get('timeout', 420)))
+    if not result['ok']:
+        failed = sorted(k for k, v in result['checks'].items() if not v)
+        raise RuntimeError(
+            f'kill drill failed checks {failed} (logs kept in {workdir})')
+    if not spec.get('workdir'):
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {'checks': result['checks'], 'details': result['details']}
+
+
 def _run_step(step: Dict, dry_run: bool, trace_dir: Optional[str]) -> Dict:
     spec = step['dry'] if dry_run else step['live']
     if step['kind'] == 'analysis':
@@ -705,6 +740,8 @@ def _run_step(step: Dict, dry_run: bool, trace_dir: Optional[str]) -> Dict:
         return _run_kernels(spec, live=not dry_run)
     if step['kind'] == 'autotune':
         return _run_autotune(spec, live=not dry_run)
+    if step['kind'] == 'multihost':
+        return _run_multihost(spec)
     raise ValueError(f"unknown replay step kind {step['kind']!r}")
 
 

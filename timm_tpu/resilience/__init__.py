@@ -8,10 +8,11 @@ See README "Fault tolerance" for the knobs:
 """
 from .durable import (
     SCHEMA_VERSION, CorruptCheckpointError, atomic_copy, atomic_write_bytes,
-    atomic_write_json, atomic_write_npz, checkpoint_progress_key, find_checkpoints,
-    load_verified, load_with_fallback, manifest_path, read_manifest,
-    resolve_auto_resume, set_durable_write_listener, snapshot_to_host,
-    verify_checkpoint,
+    atomic_write_json, atomic_write_npz, checkpoint_progress_key, copy_sharded_checkpoint,
+    find_checkpoints, is_sharded_manifest, load_verified, load_with_fallback, manifest_path,
+    read_checkpoint_scalar, read_manifest, remove_checkpoint_files, resolve_auto_resume,
+    set_durable_write_listener, shard_file_path, snapshot_process_shards, snapshot_to_host,
+    sweep_orphan_shards, verify_checkpoint, write_sharded_checkpoint,
 )
 from .elastic import (
     AsyncCheckpointWriter, ElasticPlan, convert_loader_position,
@@ -19,6 +20,7 @@ from .elastic import (
 )
 from .faultinject import FaultInjector, fault_selftest, get_fault_injector, set_fault_injector
 from .hoststate import RESUME_PREFIX, capture_host_rng, restore_host_rng
+from .multihost import cluster_env, free_port, run_kill_drill
 from .preemption import GracefulShutdown, TrainingPreempted
 from .retry import (
     DEFAULT_POISON_BUDGET, SkipBudget, TooManyBadSamples, backoff_delays, retry_io,

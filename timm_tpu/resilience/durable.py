@@ -32,6 +32,9 @@ __all__ = [
     'manifest_path', 'read_manifest', 'verify_checkpoint', 'load_verified',
     'find_checkpoints', 'load_with_fallback', 'resolve_auto_resume',
     'checkpoint_progress_key', 'set_durable_write_listener', 'snapshot_to_host',
+    'is_sharded_manifest', 'shard_file_path', 'snapshot_process_shards',
+    'write_sharded_checkpoint', 'copy_sharded_checkpoint',
+    'remove_checkpoint_files', 'sweep_orphan_shards', 'read_checkpoint_scalar',
 ]
 
 SCHEMA_VERSION = 1
@@ -207,6 +210,295 @@ def atomic_copy(src: str, dst: str, with_sidecars: bool = True):
                 atomic_write_bytes(side_dst, f.read())
 
 
+# ---- process-local sharded checkpoints --------------------------------------
+#
+# Multi-process (pod) saves invert the gather-everything-to-host-0
+# process_allgather: each process durably writes ONLY its addressable shards
+# (`<name>.shard<p>-of-<P>.npz`, tmp→fsync→rename, per-chunk SHA-256 in the
+# shard's own sidecar manifest), then process 0 commits ONE global manifest
+# (`<name>.manifest.json`, format='sharded': shard list, global array specs,
+# meta) — and only after an all_hosts_flag(mode='all') barrier confirms every
+# shard landed. There is no `<name>.npz` data file in sharded format; the
+# global manifest IS the checkpoint's commit record, so a crash (or host
+# loss) between shard write and manifest commit leaves the previous
+# checkpoint as the newest valid one. Shard files are themselves ordinary
+# npz+manifest pairs, so the existing verification machinery validates each
+# shard byte-for-byte.
+
+_SHARD_RE = re.compile(r'\.shard(\d+)-of-(\d+)\.npz$')
+
+
+def shard_file_path(path: str, process_index: int, process_count: int) -> str:
+    base, _ = os.path.splitext(path)
+    return f'{base}.shard{process_index}-of-{process_count}.npz'
+
+
+def is_sharded_manifest(manifest: Optional[dict]) -> bool:
+    return bool(manifest) and manifest.get('format') == 'sharded'
+
+
+def snapshot_process_shards(arrays: Dict, process_index: Optional[int] = None,
+                            process_count: Optional[int] = None) -> Dict:
+    """Device→host snapshot of THIS process's unique chunks of a checkpoint
+    state dict — the sharded twin of `snapshot_to_host`, run on the step
+    thread at submit time (the next train step deletes donated buffers).
+
+    Chunk selection: for every jax.Array, each addressable shard with
+    replica_id == 0 contributes (its global index slices, its host copy) —
+    the union across processes covers each array exactly once with no
+    cross-host communication. Host-side numpy values (`_resume.*` extras,
+    epoch/metric scalars) are recorded by process 0 only."""
+    import jax  # deferred: numpy-only module otherwise
+
+    p = jax.process_index() if process_index is None else int(process_index)
+    n = jax.process_count() if process_count is None else int(process_count)
+    chunks = []  # (key, start, stop, host chunk)
+    specs = {}
+    for k, v in arrays.items():
+        if hasattr(v, 'addressable_shards') and hasattr(v, 'sharding'):
+            specs[k] = {'shape': list(v.shape), 'dtype': str(v.dtype)}
+            for sh in v.addressable_shards:
+                if sh.replica_id != 0:
+                    continue
+                start = [0 if s.start is None else int(s.start) for s in sh.index]
+                stop = [v.shape[i] if s.stop is None else int(s.stop)
+                        for i, s in enumerate(sh.index)]
+                # np.array copies: np.asarray would be a zero-copy VIEW of
+                # the device buffer, and the next train step donates it —
+                # an async write would then hash/serialize mutating bytes
+                chunks.append((k, start, stop, np.array(sh.data)))
+        else:
+            arr = np.array(v)
+            specs[k] = {'shape': list(arr.shape), 'dtype': str(arr.dtype)}
+            if p == 0:
+                chunks.append((k, [0] * arr.ndim, list(arr.shape), arr))
+    return {'process_index': p, 'process_count': n,
+            'chunks': chunks, 'specs': specs}
+
+
+def _write_shard_file(spath: str, snapshot: Dict, parent: str,
+                      tmp_dir: Optional[str] = None) -> str:
+    """Durably write one process's shard npz + its sidecar manifest. The shard
+    manifest uses the ordinary npz-manifest schema (per-chunk SHA-256 under
+    'arrays'), plus a 'shard' section mapping chunk keys back to (array key,
+    start, stop) for reassembly."""
+    from .faultinject import get_fault_injector
+
+    _notify_write(spath)
+    data, chunk_meta = {}, {}
+    for j, (key, start, stop, arr) in enumerate(snapshot['chunks']):
+        ck = f'{key}::{j}'
+        data[ck] = arr
+        chunk_meta[ck] = {'key': key, 'start': list(start), 'stop': list(stop)}
+    d = os.path.dirname(os.path.abspath(spath))
+    fd, tmp = tempfile.mkstemp(prefix='.' + os.path.basename(spath) + '.', suffix='.tmp',
+                               dir=tmp_dir or d)
+    try:
+        with os.fdopen(fd, 'wb') as f:
+            np.savez(f, **data)
+            f.flush()
+            os.fsync(f.fileno())
+        injector = get_fault_injector()
+        if injector is not None and injector.take('truncate_ckpt'):
+            size = os.path.getsize(tmp)
+            with open(tmp, 'r+b') as f:
+                f.truncate(max(size // 2, 1))
+            _logger.warning(f'[fault-inject] truncated shard write: {spath}')
+        os.replace(tmp, spath)
+        _fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    manifest = {
+        'schema_version': SCHEMA_VERSION,
+        'file': os.path.basename(spath),
+        'arrays': {ck: {'sha256': _array_digest(v), 'shape': list(v.shape),
+                        'dtype': str(v.dtype)}
+                   for ck, v in data.items()},
+        'shard': {'process': snapshot['process_index'],
+                  'count': snapshot['process_count'],
+                  'parent': os.path.basename(parent),
+                  'chunks': chunk_meta},
+        'meta': {},
+    }
+    mpath = manifest_path(spath)
+    atomic_write_json(mpath, manifest, tmp_dir=tmp_dir)
+    return mpath
+
+
+def write_sharded_checkpoint(path: str, snapshot: Dict, meta: Optional[dict] = None,
+                             tmp_dir: Optional[str] = None,
+                             barrier=None) -> Optional[str]:
+    """Write this process's shard of the checkpoint at `path` and, on process
+    0, commit the global manifest — but ONLY after an all-hosts 'all' barrier
+    confirms every shard landed. Returns the global manifest path on the
+    committing process, '' on other processes, and None when the barrier
+    failed (a peer died or its write failed): then NO manifest is committed
+    and the previous checkpoint remains the newest valid one."""
+    from ..parallel.distributed import all_hosts_flag
+
+    if barrier is None:
+        barrier = all_hosts_flag
+    p, n = snapshot['process_index'], snapshot['process_count']
+    spath = shard_file_path(path, p, n)
+    ok, err = True, None
+    try:
+        _write_shard_file(spath, snapshot, parent=path, tmp_dir=tmp_dir)
+    except BaseException as e:  # still vote False so peers do not commit
+        ok, err = False, e
+    landed = barrier(ok, mode='all', name=f'ckpt-commit:{os.path.basename(path)}')
+    if err is not None:
+        raise err
+    if not landed:
+        _logger.warning(
+            f'[durable] shard barrier failed for {path}: manifest NOT committed '
+            f'(previous checkpoint remains newest valid)')
+        return None
+    if p != 0:
+        return ''
+    manifest = {
+        'schema_version': SCHEMA_VERSION,
+        'format': 'sharded',
+        'file': None,
+        'shards': [os.path.basename(shard_file_path(path, i, n)) for i in range(n)],
+        'process_count': n,
+        'arrays': dict(snapshot['specs']),
+        'meta': dict(meta or {}),
+    }
+    mpath = manifest_path(path)
+    atomic_write_json(mpath, manifest, tmp_dir=tmp_dir)
+    return mpath
+
+
+def copy_sharded_checkpoint(src: str, dst: str, process_index: int,
+                            process_count: int, barrier=None) -> Optional[str]:
+    """Sharded twin of `atomic_copy`: each process copies ITS shard (data +
+    sidecar, with file/parent fields renamed), then process 0 commits the
+    destination's global manifest after the all-hosts barrier — same ordering
+    contract as `write_sharded_checkpoint`."""
+    from ..parallel.distributed import all_hosts_flag
+
+    if barrier is None:
+        barrier = all_hosts_flag
+    s_src = shard_file_path(src, process_index, process_count)
+    s_dst = shard_file_path(dst, process_index, process_count)
+    ok, err = True, None
+    try:
+        with open(s_src, 'rb') as f:
+            atomic_write_bytes(s_dst, f.read())
+        sm = read_manifest(s_src) or {}
+        sm['file'] = os.path.basename(s_dst)
+        sm.setdefault('shard', {})['parent'] = os.path.basename(dst)
+        atomic_write_json(manifest_path(s_dst), sm)
+    except BaseException as e:
+        ok, err = False, e
+    landed = barrier(ok, mode='all', name=f'ckpt-copy:{os.path.basename(dst)}')
+    if err is not None:
+        raise err
+    if not landed:
+        _logger.warning(f'[durable] shard-copy barrier failed for {dst}: '
+                        f'manifest NOT committed')
+        return None
+    if process_index != 0:
+        return ''
+    gm = read_manifest(src)
+    if not is_sharded_manifest(gm):
+        raise CorruptCheckpointError(f'{src}: source global manifest missing/not sharded')
+    gm = dict(gm)
+    gm['shards'] = [os.path.basename(shard_file_path(dst, i, process_count))
+                    for i in range(process_count)]
+    mpath = manifest_path(dst)
+    atomic_write_json(mpath, gm)
+    side_src = os.path.splitext(src)[0] + '.json'
+    if os.path.exists(side_src):
+        with open(side_src, 'rb') as f:
+            atomic_write_bytes(os.path.splitext(dst)[0] + '.json', f.read())
+    return mpath
+
+
+def remove_checkpoint_files(path: str, process_index: Optional[int] = None):
+    """Remove a checkpoint and every file belonging to it. For sharded
+    checkpoints a non-primary process (process_index > 0) removes only its
+    own shard; process 0 (or single-process callers) removes the manifest,
+    sidecars, and ALL listed shards. Missing files are ignored."""
+    manifest = read_manifest(path)
+    targets: List[str] = []
+    if is_sharded_manifest(manifest):
+        d = os.path.dirname(os.path.abspath(path))
+        shards = [os.path.join(d, n) for n in manifest.get('shards', [])]
+        if process_index is not None and process_index > 0:
+            n = int(manifest.get('process_count', len(shards)) or len(shards))
+            own = shard_file_path(path, process_index, n)
+            targets = [own, manifest_path(own)]
+        else:
+            targets = [path, manifest_path(path), os.path.splitext(path)[0] + '.json']
+            for sp in shards:
+                targets += [sp, manifest_path(sp)]
+    else:
+        if process_index is not None and process_index > 0:
+            return  # plain checkpoints are single-writer: nothing local to remove
+        targets = [path, manifest_path(path), os.path.splitext(path)[0] + '.json']
+    for t in targets:
+        try:
+            os.unlink(t)
+        except OSError:
+            pass
+
+
+def sweep_orphan_shards(directory: str) -> List[str]:
+    """Startup sweep: shard files whose parent checkpoint never committed its
+    global manifest (host died between shard write and commit) are litter —
+    remove them so they can never shadow a valid checkpoint. Returns the
+    removed shard paths."""
+    removed: List[str] = []
+    if not directory or not os.path.isdir(directory):
+        return removed
+    for n in sorted(os.listdir(directory)):
+        m = _SHARD_RE.search(n)
+        if not m or not n.endswith('.npz'):
+            continue
+        parent = os.path.join(directory, n[:m.start()] + '.npz')
+        ok, _ = verify_checkpoint(parent)
+        if ok:
+            continue
+        sp = os.path.join(directory, n)
+        for t in (sp, manifest_path(sp)):
+            try:
+                os.unlink(t)
+            except OSError:
+                pass
+        removed.append(sp)
+        _logger.warning(f'Startup sweep: removed orphan shard {sp} '
+                        f'(parent checkpoint never committed)')
+    return removed
+
+
+def read_checkpoint_scalar(path: str, key: str):
+    """Read one host scalar (e.g. '_resume.global_batch') from a checkpoint
+    without loading the full state: plain npz → direct read; sharded → the
+    chunk lives in process 0's shard (host values are recorded by process 0).
+    Returns None when absent/unreadable."""
+    try:
+        manifest = read_manifest(path)
+        if is_sharded_manifest(manifest):
+            n = int(manifest.get('process_count', 1) or 1)
+            spath = shard_file_path(path, 0, n)
+            with np.load(spath, allow_pickle=False) as data:
+                for ck in data.files:
+                    if ck == key or ck.startswith(key + '::'):
+                        return np.asarray(data[ck])
+            return None
+        with np.load(path, allow_pickle=False) as data:
+            if key in data.files:
+                return np.asarray(data[key])
+    except Exception:
+        return None
+    return None
+
+
 def read_manifest(path: str) -> Optional[dict]:
     mpath = manifest_path(path)
     if not os.path.exists(mpath):
@@ -219,12 +511,45 @@ def read_manifest(path: str) -> Optional[dict]:
         return None
 
 
+def _verify_sharded(path: str, manifest: dict) -> Tuple[bool, str]:
+    """Sharded verification: every listed shard must exist and pass the
+    ordinary npz+manifest hash check, and the union of chunk slices must
+    cover every declared array exactly (element-count check; chunks are
+    disjoint by construction — replica_id-0 dedupe)."""
+    if int(manifest.get('schema_version', 0)) > SCHEMA_VERSION:
+        return False, f'schema_version {manifest.get("schema_version")} > {SCHEMA_VERSION}'
+    d = os.path.dirname(os.path.abspath(path))
+    declared = manifest.get('arrays', {})
+    covered = {k: 0 for k in declared}
+    for n in manifest.get('shards', []):
+        spath = os.path.join(d, n)
+        ok, reason = verify_checkpoint(spath)
+        if not ok:
+            return False, f'shard {n}: {reason}'
+        sm = read_manifest(spath) or {}
+        for ck, info in sm.get('shard', {}).get('chunks', {}).items():
+            k = info['key']
+            if k not in covered:
+                return False, f'shard {n} declares unknown array {k!r}'
+            covered[k] += int(np.prod([b - a for a, b in
+                                       zip(info['start'], info['stop'])], dtype=np.int64))
+    for k, info in declared.items():
+        want = int(np.prod(info['shape'], dtype=np.int64))
+        if covered[k] != want:
+            return False, (f'array {k!r} coverage {covered[k]}/{want} elements '
+                           f'across shards')
+    return True, 'ok'
+
+
 def verify_checkpoint(path: str) -> Tuple[bool, str]:
-    """Return (ok, reason). With a manifest: schema + per-array SHA-256 check.
-    Without one (legacy/foreign checkpoint): accept iff the npz itself loads."""
+    """Return (ok, reason). With a manifest: schema + per-array SHA-256 check
+    (for sharded checkpoints: every shard verifies + full coverage). Without
+    one (legacy/foreign checkpoint): accept iff the npz itself loads."""
+    manifest = read_manifest(path)
+    if is_sharded_manifest(manifest):
+        return _verify_sharded(path, manifest)
     if not os.path.exists(path):
         return False, 'missing'
-    manifest = read_manifest(path)
     try:
         with np.load(path, allow_pickle=False) as data:
             if manifest is None:
@@ -247,15 +572,36 @@ def verify_checkpoint(path: str) -> Tuple[bool, str]:
     return True, 'ok'
 
 
+def _load_sharded(path: str, manifest: dict) -> Dict[str, np.ndarray]:
+    """Reassemble full host arrays from the shard files (shared filesystem:
+    every process reads all shards). The caller re-places the result under
+    the LIVE mesh's shardings — which is how a sharded save composes with
+    elastic re-placement onto a different topology."""
+    d = os.path.dirname(os.path.abspath(path))
+    state = {k: np.empty(info['shape'], dtype=np.dtype(info['dtype']))
+             for k, info in manifest.get('arrays', {}).items()}
+    for n in manifest.get('shards', []):
+        spath = os.path.join(d, n)
+        sm = read_manifest(spath) or {}
+        chunk_meta = sm.get('shard', {}).get('chunks', {})
+        with np.load(spath, allow_pickle=False) as data:
+            for ck, info in chunk_meta.items():
+                idx = tuple(slice(a, b) for a, b in zip(info['start'], info['stop']))
+                state[info['key']][idx] = data[ck]
+    return state
+
+
 def load_verified(path: str) -> Tuple[Dict[str, np.ndarray], dict]:
     """Load a checkpoint after integrity verification; raises
     CorruptCheckpointError with the reason on failure. Returns (state, meta)."""
     ok, reason = verify_checkpoint(path)
     if not ok:
         raise CorruptCheckpointError(f'{path}: {reason}')
+    manifest = read_manifest(path)
+    if is_sharded_manifest(manifest):
+        return _load_sharded(path, manifest), manifest.get('meta', {})
     with np.load(path, allow_pickle=False) as data:
         state = {k: data[k] for k in data.files}
-    manifest = read_manifest(path)
     return state, (manifest or {}).get('meta', {})
 
 
@@ -275,7 +621,10 @@ def checkpoint_progress_key(path: str) -> Tuple[float, int, float]:
     try:
         mtime = os.path.getmtime(path)
     except OSError:
-        mtime = 0.0
+        try:  # sharded checkpoints have no data file: rank by manifest mtime
+            mtime = os.path.getmtime(manifest_path(path))
+        except OSError:
+            mtime = 0.0
     m = _RECOVERY_RE.search(name)
     if m:
         return float(m.group(1)), int(m.group(2)) + 1, mtime
@@ -299,11 +648,24 @@ def checkpoint_progress_key(path: str) -> Tuple[float, int, float]:
 
 
 def find_checkpoints(directory: str) -> List[str]:
-    """All checkpoint files in `directory`, newest-first by training progress."""
+    """All checkpoint files in `directory`, newest-first by training progress.
+    Shard files are components, not checkpoints — excluded; sharded
+    checkpoints (global manifest, no data file) are surfaced under their
+    logical `.npz` name."""
     if not directory or not os.path.isdir(directory):
         return []
-    names = [n for n in os.listdir(directory)
-             if n.endswith('.npz') and not n.startswith('.') and n != 'tmp.npz']
+    listing = os.listdir(directory)
+    names = [n for n in listing
+             if n.endswith('.npz') and not n.startswith('.') and n != 'tmp.npz'
+             and not _SHARD_RE.search(n)]
+    for n in listing:
+        if not n.endswith('.manifest.json') or n.startswith('.'):
+            continue
+        base = n[:-len('.manifest.json')] + '.npz'
+        if base in names or _SHARD_RE.search(base):
+            continue
+        if is_sharded_manifest(read_manifest(os.path.join(directory, base))):
+            names.append(base)
     paths = [os.path.join(directory, n) for n in names]
     return sorted(paths, key=checkpoint_progress_key, reverse=True)
 

@@ -122,15 +122,11 @@ def _checkpoint_global_batch(path):
     files predate the ``_resume.global_batch`` key).  Returns (None, None)
     when neither source exists.
     """
-    try:
-        with np.load(path) as z:
-            keys = set(z.files)
-            if '_resume.global_batch' in keys:
-                gb = int(z['_resume.global_batch'])
-                bs = int(z['_resume.batch_size']) if '_resume.batch_size' in keys else None
-                return gb, bs
-    except (OSError, ValueError):
-        pass
+    from .durable import read_checkpoint_scalar
+    gb = read_checkpoint_scalar(path, '_resume.global_batch')
+    if gb is not None:
+        bs = read_checkpoint_scalar(path, '_resume.batch_size')
+        return int(gb), (int(bs) if bs is not None else None)
     sidecar = os.path.splitext(path)[0] + '.json'
     try:
         with open(sidecar, encoding='utf-8') as f:

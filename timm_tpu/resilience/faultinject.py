@@ -14,6 +14,12 @@ Spec grammar (comma-separated, via `train.py --fault-inject`, `bench.py
                     (one-shot, like sigterm@N); the restarting harness reads
                     `resize_devices` = D and relaunches with that forced
                     device count (`--elastic` resume rebuilds the mesh)
+  kill_host@N[:P]   host-loss drill: SIGKILL process P (default 0) at global
+                    update N — no recovery save, no clean exit, exactly what
+                    a preempted/failed pod host looks like. Every process can
+                    carry the same spec; only the one whose
+                    `jax.process_index()` == P dies (single-process runs with
+                    P=0 kill themselves)
 
 The injector is deliberately dumb: hooks call `take`/`nan_at`/`sigterm_at`/
 `io_error_tick` at the natural fault site, so the tests and manual drills
@@ -32,7 +38,7 @@ _logger = logging.getLogger(__name__)
 __all__ = ['FaultInjector', 'get_fault_injector', 'set_fault_injector', 'fault_selftest']
 
 _KINDS_ONESHOT = ('truncate_ckpt',)
-_KINDS_AT = ('nan_grads', 'sigterm', 'resize')
+_KINDS_AT = ('nan_grads', 'sigterm', 'resize', 'kill_host')
 _KINDS_EVERY = ('io_error',)
 
 
@@ -48,13 +54,21 @@ class FaultInjector:
         self._every: Dict[str, int] = {}        # kind -> period M
         self._ticks: Dict[str, int] = {}
         self.resize_devices: Optional[int] = None
+        self.kill_host_process: int = 0
         for part in filter(None, (p.strip() for p in self.spec.split(','))):
             if '@' in part:
                 kind, _, n = part.partition('@')
                 if kind not in _KINDS_AT:
                     raise ValueError(f'unknown @-fault {kind!r} in spec {spec!r}')
                 n, _, suffix = n.partition(':')
-                if kind == 'resize':
+                if kind == 'kill_host':
+                    # kill_host@N:P — the :P suffix is the target process
+                    # index (default 0), not a window; fires exactly once
+                    if suffix and int(suffix) < 0:
+                        raise ValueError(f'kill_host process index must be >= 0: {part!r}')
+                    self.kill_host_process = int(suffix) if suffix else 0
+                    self._at[kind] = (int(n), 1)
+                elif kind == 'resize':
                     # resize@N:D — the :D suffix is the restart's forced
                     # device count, not a window; the fault fires exactly once
                     if not suffix or int(suffix) < 1:
@@ -111,6 +125,19 @@ class FaultInjector:
         with self._lock:
             if self._at_window('resize', update_idx) and not self._fired.get('resize'):
                 self._fired['resize'] = True
+                return True
+        return False
+
+    def kill_host_at(self, update_idx: int, process_index: int = 0) -> bool:
+        """True exactly once when `kill_host@N[:P]` is armed, update N is
+        reached, AND this is process P. The caller SIGKILLs itself — no
+        recovery save, no consensus: the survivors must detect the loss via
+        the KV-store consensus timeout and stop on their own."""
+        if process_index != self.kill_host_process:
+            return False
+        with self._lock:
+            if self._at_window('kill_host', update_idx) and not self._fired.get('kill_host'):
+                self._fired['kill_host'] = True
                 return True
         return False
 
@@ -215,6 +242,14 @@ def fault_selftest(spec: str = '', tmp_dir: Optional[str] = None) -> dict:
         fi = FaultInjector('resize@4:2')
         checks['resize'] = (fi.resize_devices == 2 and not fi.resize_at(3)
                             and fi.resize_at(4) and not fi.resize_at(4))
+        # 6. kill_host@N:P targets exactly process P, fires exactly once
+        fi = FaultInjector('kill_host@6:1')
+        checks['kill_host'] = (fi.kill_host_process == 1
+                               and not fi.kill_host_at(6, process_index=0)
+                               and not fi.kill_host_at(5, process_index=1)
+                               and fi.kill_host_at(6, process_index=1)
+                               and not fi.kill_host_at(6, process_index=1)
+                               and FaultInjector('kill_host@2').kill_host_process == 0)
     finally:
         set_fault_injector(prev)
         if tmp_dir is None:

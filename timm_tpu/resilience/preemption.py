@@ -114,11 +114,14 @@ class GracefulShutdown:
     def should_stop(self, update_idx: int) -> bool:
         """Poll between updates. Single-process: the local flag. Multi-host:
         cross-host ANY-consensus at a fixed update cadence so every host stops
-        at the same step."""
+        at the same step. The consensus is NAMED, so it rides the coordination
+        service's KV store when available: a dead peer resolves to True (host
+        loss ⇒ the pod stops and recovers) instead of deadlocking the way a
+        device collective would."""
         import jax
         if jax.process_count() <= 1:
             return self.requested
         if (update_idx + 1) % self.consensus_every != 0:
             return False
         from ..parallel import all_hosts_flag
-        return all_hosts_flag(self.requested, mode='any')
+        return all_hosts_flag(self.requested, mode='any', name='preemption-consensus')

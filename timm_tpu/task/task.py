@@ -476,19 +476,31 @@ class TrainingTask:
         state.update(flat_other)
         return state
 
+    @staticmethod
+    def _place(tree, shardings):
+        """device_put a host pytree under `shardings` (a matching tree or one
+        sharding for every leaf). Multi-process meshes route through
+        `place_global`, which builds non-fully-addressable global arrays from
+        each host's local pieces; single-process this IS jax.device_put."""
+        from ..parallel.mesh import place_global
+        if isinstance(shardings, jax.sharding.Sharding):
+            return jax.tree.map(lambda x: place_global(x, shardings), tree)
+        return jax.tree.map(place_global, tree, shardings)
+
     def load_checkpoint_state(self, state: Dict[str, np.ndarray], strict: bool = True, load_opt: bool = True):
         """Restore from a flat checkpoint dict; loaded leaves are re-placed
         under THIS task's shardings, so a checkpoint saved on any mesh shape
-        (single-device, data-only, data×fsdp) loads on any other."""
+        (single-device, data-only, data×fsdp, multi-process sharded) loads on
+        any other."""
         params = unflatten_into(nnx.state(self.model, nnx.Param), state, 'state_dict', strict=strict)
-        nnx.update(self.model, jax.device_put(params, self._param_shardings))
+        nnx.update(self.model, self._place(params, self._param_shardings))
         if self.ema_params is not None and any(k.startswith('state_dict_ema.') for k in state):
             ema = unflatten_into(self.ema_params, state, 'state_dict_ema', strict=strict)
-            self.ema_params = jax.device_put(ema, self._param_shardings)
+            self.ema_params = self._place(ema, self._param_shardings)
         if load_opt and self.opt_state is not None and any(k.startswith('optimizer.') for k in state):
             opt = unflatten_into(self.opt_state, state, 'optimizer', strict=strict)
-            self.opt_state = jax.device_put(opt, self._opt_shardings)
+            self.opt_state = self._place(opt, self._opt_shardings)
         if any(k.startswith('model_state.') for k in state):
             other = nnx.state(self.model, nnx.Not(nnx.Param))
             other = unflatten_into(other, state, 'model_state', strict=False)
-            nnx.update(self.model, jax.device_put(other, replicate_sharding(self.mesh)))
+            nnx.update(self.model, self._place(other, replicate_sharding(self.mesh)))

@@ -36,6 +36,10 @@ from ..resilience import (
     atomic_copy, atomic_write_json, atomic_write_npz, manifest_path, snapshot_to_host,
     verify_checkpoint,
 )
+from ..resilience.durable import (
+    copy_sharded_checkpoint, find_checkpoints, remove_checkpoint_files,
+    snapshot_process_shards, sweep_orphan_shards, write_sharded_checkpoint,
+)
 
 _logger = logging.getLogger(__name__)
 
@@ -56,10 +60,21 @@ class CheckpointSaver:
             decreasing: bool = False,
             max_history: int = 10,
             async_writer=None,
+            process_index: int = 0,
+            process_count: int = 1,
     ):
         self.task = task
         self.args = args
         self.async_writer = async_writer  # resilience.AsyncCheckpointWriter or None
+        # multi-process (pod) mode: EVERY process owns a saver; each writes
+        # only its addressable shards (durable.write_sharded_checkpoint),
+        # process 0 commits manifests/sidecars after the all-hosts barrier.
+        # Retention/best bookkeeping must stay process-deterministic: all
+        # processes call save_* with the same (epoch, metric) sequence.
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.sharded = self.process_count > 1
+        self.primary = self.process_index == 0
         self.checkpoint_files: List[Tuple[str, float]] = []
         self.best_epoch: Optional[int] = None
         self.best_metric: Optional[float] = None
@@ -80,11 +95,17 @@ class CheckpointSaver:
     def _cleanup_startup(self):
         """Sweep artifacts of a previous crash: orphaned tmp files from
         interrupted atomic writes, the legacy non-atomic `tmp.npz`, async
-        staging dirs from a writer thread killed mid-flight, and recovery
-        files that fail integrity verification."""
+        staging dirs from a writer thread killed mid-flight, shard files whose
+        global manifest never committed (host died between shard write and
+        commit), and recovery files that fail integrity verification. In
+        multi-process mode only process 0 sweeps (shared filesystem — one
+        janitor; missing-file unlinks are ignored anyway)."""
+        if self.sharded and not self.primary:
+            return
         for d in {self.checkpoint_dir, self.recovery_dir}:
             if not d or not os.path.isdir(d):
                 continue
+            sweep_orphan_shards(d)
             for name in os.listdir(d):
                 path = os.path.join(d, name)
                 if name.startswith('.async-stage-') and os.path.isdir(path):
@@ -99,6 +120,16 @@ class CheckpointSaver:
                         _logger.warning(f'Removing corrupt recovery file {path}: {reason}')
                         self._unlink(path)
                         self._unlink(manifest_path(path))
+                elif (name.startswith(self.recovery_prefix)
+                      and name.endswith('.manifest.json') and '.shard' not in name
+                      and not os.path.exists(os.path.join(d, name[:-len('.manifest.json')] + self.extension))):
+                    # sharded recovery checkpoint (manifest only, no data
+                    # file): drop it wholesale if any shard is missing/corrupt
+                    logical = os.path.join(d, name[:-len('.manifest.json')] + self.extension)
+                    ok, reason = verify_checkpoint(logical)
+                    if not ok:
+                        _logger.warning(f'Removing corrupt sharded recovery {logical}: {reason}')
+                        remove_checkpoint_files(logical)
 
     def _stage_for(self, directory: str) -> Optional[str]:
         """Staging dir for async temp files (same filesystem as the
@@ -137,33 +168,65 @@ class CheckpointSaver:
         meta = {'epoch': epoch, 'metric': metric}
         if extra_state and '_resume.num_updates' in extra_state:
             meta['num_updates'] = int(np.asarray(extra_state['_resume.num_updates']))
-        if self.async_writer is not None:
+        snap = None
+        if self.sharded:
+            # sharded mode: extract this process's chunks NOW (same donated-
+            # buffer constraint as snapshot_to_host, and cheap: local shards
+            # only — no process_allgather anywhere on the save path)
+            snap = snapshot_process_shards(state, self.process_index, self.process_count)
+        elif self.async_writer is not None:
             # must happen NOW: the next train step deletes donated buffers
             state = snapshot_to_host(state)
         args_doc = None
-        if self.args is not None:
+        if self.args is not None and (not self.sharded or self.primary):
             args_doc = {
                 'epoch': epoch, 'metric': metric, 'arch': getattr(self.args, 'model', None),
                 'args': {k: str(v) for k, v in vars(self.args).items()}}
         stage = self._stage_for(os.path.dirname(save_path))
 
         def commit():
+            landed = True
             if stage is not None:
                 os.makedirs(stage, exist_ok=True)
-            atomic_write_npz(save_path, state, meta=meta, tmp_dir=stage)
-            if args_doc is not None:
-                atomic_write_json(save_path.replace(self.extension, '.json'), args_doc,
-                                  tmp_dir=stage)
+            if snap is not None:
+                committed = write_sharded_checkpoint(save_path, snap, meta=meta,
+                                                     tmp_dir=stage)
+                landed = committed is not None
+                if landed and args_doc is not None:
+                    atomic_write_json(save_path.replace(self.extension, '.json'), args_doc,
+                                      tmp_dir=stage)
+            else:
+                atomic_write_npz(save_path, state, meta=meta, tmp_dir=stage)
+                if args_doc is not None:
+                    atomic_write_json(save_path.replace(self.extension, '.json'), args_doc,
+                                      tmp_dir=stage)
             if stage is not None:
                 try:
                     os.rmdir(stage)  # empty after a clean write; litter keeps it
                 except OSError:
                     pass
+            return landed
         return commit
 
     def _save(self, save_path: str, epoch: int, metric: Optional[float] = None,
               extra_state: Optional[Dict[str, np.ndarray]] = None):
         self._snapshot(save_path, epoch, metric, extra_state)()
+
+    def _copy(self, src: str, dst: str):
+        """Sharded-aware checkpoint copy (each process copies its own shard,
+        process 0 commits the destination manifest after the barrier)."""
+        if self.sharded:
+            copy_sharded_checkpoint(src, dst, self.process_index, self.process_count)
+        else:
+            atomic_copy(src, dst)
+
+    def _remove(self, path: str):
+        """Sharded-aware checkpoint removal (non-primary removes only its own
+        shard; process 0 removes manifest + sidecars + every shard)."""
+        if self.sharded:
+            remove_checkpoint_files(path, process_index=self.process_index)
+        else:
+            remove_checkpoint_files(path)
 
     def save_checkpoint(self, epoch: int, metric: Optional[float] = None):
         assert epoch >= 0
@@ -186,7 +249,7 @@ class CheckpointSaver:
                 ops.append(self._cleanup_checkpoints(1))
             filename = '-'.join([self.save_prefix, str(epoch)]) + self.extension
             save_path = os.path.join(self.checkpoint_dir, filename)
-            ops.append(lambda: atomic_copy(last_save_path, save_path))
+            ops.append(lambda: self._copy(last_save_path, save_path))
             self.checkpoint_files.append((save_path, metric))
             self.checkpoint_files = sorted(
                 self.checkpoint_files, key=lambda x: x[1] if x[1] is not None else -float('inf'),
@@ -201,7 +264,7 @@ class CheckpointSaver:
                 self.best_epoch = epoch
                 self.best_metric = metric
                 best_save_path = os.path.join(self.checkpoint_dir, 'model_best' + self.extension)
-                ops.append(lambda: atomic_copy(last_save_path, best_save_path))
+                ops.append(lambda: self._copy(last_save_path, best_save_path))
 
         def commit():
             for op in ops:
@@ -222,14 +285,8 @@ class CheckpointSaver:
 
         def remove():
             for d in to_delete:
-                try:
-                    _logger.debug(f'Cleaning checkpoint: {d}')
-                    os.remove(d[0])
-                    for side in (d[0].replace(self.extension, '.json'), manifest_path(d[0])):
-                        if os.path.exists(side):
-                            os.remove(side)
-                except OSError:
-                    _logger.error(f'Exception removing checkpoint {d}')
+                _logger.debug(f'Cleaning checkpoint: {d}')
+                self._remove(d[0])
         return remove
 
     def save_recovery(self, epoch: int, batch_idx: int = 0,
@@ -240,14 +297,13 @@ class CheckpointSaver:
         prev_to_remove = self.prev_recovery_file
 
         def commit():
-            commit_write()
-            if prev_to_remove and os.path.exists(prev_to_remove):
-                try:
-                    os.remove(prev_to_remove)
-                    self._unlink(manifest_path(prev_to_remove))
-                    self._unlink(prev_to_remove.replace(self.extension, '.json'))
-                except OSError:
-                    _logger.error(f'Exception removing {prev_to_remove}')
+            if not commit_write():
+                # sharded commit barrier failed (peer lost): the previous
+                # recovery must stay — it is still the newest VALID checkpoint
+                return
+            if prev_to_remove and (os.path.exists(prev_to_remove)
+                                   or os.path.exists(manifest_path(prev_to_remove))):
+                self._remove(prev_to_remove)
 
         self._dispatch(commit, label=f'recovery-{epoch}-{batch_idx}', key='recovery')
         self.prev_recovery_file = self.curr_recovery_file
@@ -256,9 +312,15 @@ class CheckpointSaver:
 
     def _recovery_files(self) -> List[str]:
         """Recovery files newest-first by numeric (epoch, batch_idx) — the
-        seed's lexicographic sort ranked recovery-1-999 above recovery-1-1000."""
-        recovery_path = os.path.join(self.recovery_dir, self.recovery_prefix)
-        files = glob.glob(recovery_path + '*' + self.extension)
+        seed's lexicographic sort ranked recovery-1-999 above recovery-1-1000.
+        Sharded recovery checkpoints (manifest, no data file) are surfaced by
+        durable.find_checkpoints under their logical `.npz` name."""
+        if self.sharded:
+            files = [f for f in find_checkpoints(self.recovery_dir)
+                     if os.path.basename(f).startswith(self.recovery_prefix)]
+        else:
+            recovery_path = os.path.join(self.recovery_dir, self.recovery_prefix)
+            files = glob.glob(recovery_path + '*' + self.extension)
 
         def key(f):
             m = _RECOVERY_RE.search(f)
@@ -272,9 +334,7 @@ class CheckpointSaver:
         for f in self._recovery_files():
             m = _RECOVERY_RE.search(f)
             if m and int(m.group(1)) <= completed_epoch:
-                self._unlink(f)
-                self._unlink(manifest_path(f))
-                self._unlink(f.replace(self.extension, '.json'))
+                self._remove(f)
 
     def find_recovery(self) -> str:
         """Newest recovery checkpoint that passes integrity verification."""

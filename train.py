@@ -12,6 +12,7 @@ import argparse
 import json
 import logging
 import os
+import sys
 import time
 from collections import OrderedDict
 from datetime import datetime
@@ -114,6 +115,13 @@ def make_parser():
     group.add_argument('--device', default=None, type=str,
                        help='pin the JAX platform (tpu/cpu); default = auto '
                             '(reference train.py --device)')
+    group.add_argument('--distributed', action='store_true', default=False,
+                       help='multi-process pod runtime: call jax.distributed.initialize() '
+                            'before any device op (coordinator/rank from the cluster env: '
+                            'COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID, or '
+                            'auto-detected on TPU pods). Shards the input pipeline by '
+                            'process and switches checkpoints to one-shard-file-per-'
+                            'process (README "Multi-host training")')
     # optimizer
     group = parser.add_argument_group('Optimizer parameters')
     group.add_argument('--opt', default='sgd', type=str, metavar='OPTIMIZER')
@@ -282,23 +290,39 @@ def _parse_distill(spec):
 
 
 class SyntheticLoader:
-    """Deterministic random image/label batches for smoke runs."""
+    """Deterministic random image/label batches for smoke runs.
 
-    def __init__(self, length, batch_size, img_size, num_classes, seed=0):
+    `batch_size` is the GLOBAL batch. Multi-process runs draw the same global
+    batch from the seeded stream on every host and each process yields its own
+    contiguous row slice, so the union across processes is bit-identical to a
+    single-process run — the property the multi-host kill drill asserts on.
+    """
+
+    def __init__(self, length, batch_size, img_size, num_classes, seed=0,
+                 process_index=0, process_count=1):
+        if batch_size % process_count != 0:
+            raise ValueError(
+                f'synthetic batch size {batch_size} not divisible by '
+                f'{process_count} processes')
         self.length = max(1, length // batch_size)
         self.batch_size = batch_size
         self.img_size = img_size
         self.num_classes = num_classes
         self.seed = seed
+        self.process_index = process_index
+        self.process_count = process_count
 
     def __len__(self):
         return self.length
 
     def __iter__(self):
         rng = np.random.RandomState(self.seed)
+        local = self.batch_size // self.process_count
+        lo = self.process_index * local
         for _ in range(self.length):
-            yield (rng.rand(self.batch_size, self.img_size, self.img_size, 3).astype(np.float32),
-                   rng.randint(0, self.num_classes, self.batch_size))
+            x = rng.rand(self.batch_size, self.img_size, self.img_size, 3).astype(np.float32)
+            y = rng.randint(0, self.num_classes, self.batch_size)
+            yield x[lo:lo + local], y[lo:lo + local]
 
 
 def _solver_model_kwargs(args):
@@ -313,11 +337,54 @@ def _solver_model_kwargs(args):
     return kw
 
 
+def _bootstrap_distributed(args):
+    """Cluster bring-up for --distributed / pod launches. Must run before ANY
+    timm_tpu import: importing the package pulls in flax, which touches the
+    XLA backend, and jax.distributed.initialize() refuses to run after the
+    first backend touch. init_distributed_device() later detects the already-
+    initialized runtime and only fills in args.{world_size,rank,...}."""
+    coord = os.environ.get('COORDINATOR_ADDRESS') or os.environ.get('JAX_COORDINATOR_ADDRESS')
+    env_cluster = (bool(coord)
+                   or int(os.environ.get('SLURM_NTASKS') or 1) > 1
+                   or int(os.environ.get('OMPI_COMM_WORLD_SIZE') or 1) > 1)
+    if not (getattr(args, 'distributed', False) or env_cluster):
+        return
+    kwargs = {}
+    if coord:
+        kwargs['coordinator_address'] = coord
+        if os.environ.get('NUM_PROCESSES'):
+            kwargs['num_processes'] = int(os.environ['NUM_PROCESSES'])
+        if os.environ.get('PROCESS_ID'):
+            kwargs['process_id'] = int(os.environ['PROCESS_ID'])
+    try:
+        if 'jax_cpu_collectives_implementation' in jax.config.values:
+            # CPU clusters (tests, local drills): cross-process collectives
+            # need the gloo transport; harmless no-op on TPU backends
+            jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+        jax.distributed.initialize(**kwargs)
+        _logger.info(f'Initialized multi-host JAX: process '
+                     f'{jax.process_index()}/{jax.process_count()}')
+    except Exception:
+        if env_cluster:
+            raise
+        _logger.warning('--distributed requested but no coordinator/cluster '
+                        'env detected; continuing single-process')
+
+
 def main():
+    args, args_text = _parse_args()
+    if args.device:
+        # must land before the first device op; env JAX_PLATFORMS loses to the
+        # axon plugin's sitecustomize registration, jax.config wins
+        jax.config.update('jax_platforms', args.device)
+    _bootstrap_distributed(args)
+
     from timm_tpu import create_model
     from timm_tpu.loss import BinaryCrossEntropy, JsdCrossEntropy, LabelSmoothingCrossEntropy, SoftTargetCrossEntropy
     from timm_tpu.optim import create_optimizer_v2, optimizer_kwargs
-    from timm_tpu.parallel import create_mesh, init_distributed_device, set_global_mesh, shard_batch
+    from timm_tpu.parallel import (
+        create_mesh, init_distributed_device, is_primary, set_global_mesh, shard_batch,
+    )
     from timm_tpu.scheduler import create_scheduler_v2, scheduler_kwargs
     from timm_tpu.task import ClassificationTask
     from timm_tpu.utils import (
@@ -332,18 +399,13 @@ def main():
     )
 
     setup_default_logging()
-    args, args_text = _parse_args()
+    if args.fault_inject:
+        set_fault_injector(args.fault_inject)
+    world_size, rank, _ = init_distributed_device(args)
     # durable compiles: every process reuses the on-disk XLA executable cache
     # (TIMM_TPU_COMPILE_CACHE; see timm_tpu/utils/compile_cache.py)
     from timm_tpu.utils import configure_compile_cache
     configure_compile_cache()
-    if args.fault_inject:
-        set_fault_injector(args.fault_inject)
-    if args.device:
-        # must land before the first device op; env JAX_PLATFORMS loses to the
-        # axon plugin's sitecustomize registration, jax.config wins
-        jax.config.update('jax_platforms', args.device)
-    world_size, rank, _ = init_distributed_device(args)
     random_seed(args.seed, rank)
 
     if args.elastic:
@@ -622,10 +684,13 @@ def main():
         mixup_fn = None
     elif args.synthetic_data or not args.data_dir:
         _logger.info('Using synthetic data')
-        loader_train = SyntheticLoader(args.synthetic_len, args.batch_size, img_size, args.num_classes, args.seed)
+        loader_train = SyntheticLoader(args.synthetic_len, args.batch_size, img_size,
+                                       args.num_classes, args.seed,
+                                       process_index=rank, process_count=world_size)
         loader_eval = SyntheticLoader(max(args.synthetic_len // 4, args.batch_size),
                                       args.validation_batch_size or args.batch_size,
-                                      img_size, args.num_classes, args.seed + 1)
+                                      img_size, args.num_classes, args.seed + 1,
+                                      process_index=rank, process_count=world_size)
         mixup_fn = 'auto'
     else:
         from timm_tpu.data import create_dataset, create_loader
@@ -752,20 +817,27 @@ def main():
     async_writer = None
     if rank == 0:
         output_dir = get_outdir(args.output if args.output else './output/train', exp_name)
+    elif args.experiment:
+        # non-primary hosts resolve the same (shared-FS) dir for auto-resume
+        # and — multi-process — for their own checkpoint shard files
+        output_dir = os.path.join(args.output if args.output else './output/train', exp_name)
+        os.makedirs(output_dir, exist_ok=True)
+    if output_dir is not None and (rank == 0 or world_size > 1):
         if os.environ.get('TIMM_TPU_ASYNC_CKPT', '1') != '0':
             # async checkpointing (default on): the step loop only snapshots
             # state to host; fsync/os.replace run on this writer thread.
             # TIMM_TPU_ASYNC_CKPT=0 restores fully synchronous writes.
+            # Multi-process keeps one writer thread PER PROCESS: each host
+            # writes only its own shard file.
             async_writer = AsyncCheckpointWriter()
         saver = CheckpointSaver(
             task, args=args, checkpoint_dir=output_dir, recovery_dir=output_dir,
             decreasing=args.eval_metric == 'loss', max_history=args.checkpoint_hist,
-            async_writer=async_writer)
+            async_writer=async_writer,
+            process_index=rank, process_count=world_size)
+    if rank == 0 and output_dir is not None:
         with open(os.path.join(output_dir, 'args.yaml'), 'w') as f:
             f.write(args_text)
-    elif args.experiment:
-        # non-primary hosts resolve the same (shared-FS) dir for auto-resume
-        output_dir = os.path.join(args.output if args.output else './output/train', exp_name)
 
     # resume: integrity-verified load with fallback to the newest valid
     # checkpoint; 'auto' resolves recovery/last/checkpoint-* newest-first
@@ -875,7 +947,7 @@ def main():
                 ema_metrics = validate(task, loader_eval, args, mesh, shard_batch, use_ema=True)
                 eval_metrics.update({f'{k}_ema': v for k, v in ema_metrics.items()})
 
-            if output_dir is not None:
+            if output_dir is not None and is_primary(args):
                 update_summary(
                     epoch, train_metrics, eval_metrics,
                     filename=os.path.join(output_dir, 'summary.csv'),
@@ -895,7 +967,8 @@ def main():
 
     if best_metric is not None:
         _logger.info(f'*** Best metric: {best_metric} (epoch {best_epoch})')
-        print(json.dumps({'result': {args.eval_metric: best_metric, 'epoch': best_epoch}}))
+        if is_primary(args):
+            print(json.dumps({'result': {args.eval_metric: best_metric, 'epoch': best_epoch}}))
     return eval_metrics
 
 
@@ -914,6 +987,7 @@ def _recovery_extras(batches_consumed, num_updates, args=None):
         extras['_resume.batch_size'] = np.asarray(args.batch_size)
         extras['_resume.global_batch'] = np.asarray(args.batch_size * args.grad_accum_steps)
         extras['_resume.device_count'] = np.asarray(jax.device_count())
+        extras['_resume.process_count'] = np.asarray(jax.process_count())
     extras.update(capture_host_rng())
     return extras
 
@@ -953,8 +1027,18 @@ def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
     injector = get_fault_injector()
 
     def poll_faults_and_shutdown(batch_idx, update_idx):
-        """After each committed update: deliver injected SIGTERM, then write a
-        step-granular recovery checkpoint and stop if shutdown was requested."""
+        """After each committed update: deliver injected SIGKILL/SIGTERM, then
+        write a step-granular recovery checkpoint and stop if shutdown was
+        requested."""
+        if injector is not None and injector.kill_host_at(num_updates - 1, jax.process_index()):
+            # host-loss drill: die NOW, before any consensus/recovery save —
+            # the victim must never publish its stop vote, so the survivors'
+            # next named consensus times out on it and resolves to stop.
+            # Drain the dispatched step first (its collective sends must land
+            # so survivors can materialize the post-step state on their own).
+            jax.block_until_ready((metrics, task.opt_state))
+            _logger.warning(f'[fault-inject] kill_host at update {num_updates - 1}: SIGKILL')
+            os.kill(os.getpid(), __import__('signal').SIGKILL)
         if injector is not None and injector.sigterm_at(num_updates - 1):
             _logger.warning(f'[fault-inject] SIGTERM at update {num_updates - 1}')
             os.kill(os.getpid(), __import__('signal').SIGTERM)
@@ -1080,9 +1164,24 @@ def train_one_epoch(epoch, task, loader, args, lr_scheduler, mesh, shard_batch,
     return out
 
 
+def _local_rows(arr):
+    """Host-local rows of a (possibly) multi-process sharded array, in batch
+    order. `float()`/eager jnp ops are illegal on non-fully-addressable
+    arrays; metrics therefore reduce the ADDRESSABLE shards (deduped by
+    replica_id, so tensor-parallel replication doesn't double-count) on host
+    and cross-process-average at the end via `reduce_tensor`."""
+    if not isinstance(arr, jax.Array) or arr.is_fully_addressable:
+        return np.asarray(arr)
+    shards = [s for s in arr.addressable_shards if s.replica_id == 0]
+    shards.sort(key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+
 def validate(task, loader, args, mesh, shard_batch, use_ema=False):
-    """Eval loop: metrics are computed on device from the sharded output, so
-    only replicated scalars are fetched (multi-host safe)."""
+    """Eval loop. Each process scores its own addressable rows of the sharded
+    eval output; per-process means are averaged across hosts at the end
+    (every host sees the same batch count, so the mean-of-means is exact)."""
+    from timm_tpu.parallel import reduce_tensor
     from timm_tpu.utils import AverageMeter
     loss_m = AverageMeter()
     top1_m = AverageMeter()
@@ -1098,17 +1197,37 @@ def validate(task, loader, args, mesh, shard_batch, use_ema=False):
             batch = shard_batch({'input': jnp.asarray(input_np), 'target': jnp.asarray(target_np)}, mesh)
             output = task.eval_step({'input': batch['input']}, use_ema=use_ema)
             target = batch['target']
-        logprobs = jax.nn.log_softmax(output.astype(jnp.float32), axis=-1)
-        loss = -jnp.take_along_axis(logprobs, target[:, None], axis=-1).mean()
-        top_pred = jnp.argsort(output, axis=-1)[:, -5:]
-        correct1 = (top_pred[:, -1] == target).mean() * 100.0
-        correct5 = (top_pred == target[:, None]).any(axis=-1).mean() * 100.0
-        n = output.shape[0]
+        out_np = _local_rows(output).astype(np.float32)
+        tgt_np = _local_rows(target)
+        if out_np.shape[0] == 0:
+            continue
+        shifted = out_np - out_np.max(axis=-1, keepdims=True)
+        logprobs = shifted - np.log(np.exp(shifted).sum(axis=-1, keepdims=True))
+        loss = -np.take_along_axis(logprobs, tgt_np[:, None], axis=-1).mean()
+        top_pred = np.argsort(out_np, axis=-1)[:, -5:]
+        correct1 = (top_pred[:, -1] == tgt_np).mean() * 100.0
+        correct5 = (top_pred == tgt_np[:, None]).any(axis=-1).mean() * 100.0
+        n = out_np.shape[0]
         loss_m.update(float(loss), n)
         top1_m.update(float(correct1), n)
         top5_m.update(float(correct5), n)
-    return OrderedDict([('loss', loss_m.avg), ('top1', top1_m.avg), ('top5', top5_m.avg)])
+    return OrderedDict([('loss', float(reduce_tensor(loss_m.avg))),
+                        ('top1', float(reduce_tensor(top1_m.avg))),
+                        ('top5', float(reduce_tensor(top5_m.avg)))])
 
 
 if __name__ == '__main__':
-    main()
+    try:
+        main()
+    except SystemExit as e:
+        # Preemption/abort exits in a multi-process run must NOT run the
+        # distributed client's atexit shutdown barrier: after a host loss it
+        # raises a fatal C++ error that turns a clean exit-0 into SIGABRT.
+        # Recovery state is already durable (the writer drained in main's
+        # finally), so a hard exit loses nothing.
+        if jax.process_count() > 1:
+            logging.shutdown()
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(int(e.code or 0))
+        raise

@@ -313,10 +313,10 @@ def main():
 
     if args.results_file:
         if args.results_format == 'json':
-            with open(args.results_file, 'w') as f:
+            with open(args.results_file, 'w') as f:  # timm-tpu-lint: disable=process-zero-io single-process evaluation driver; no pod launch path
                 json.dump(results, f, indent=2)
         else:
-            with open(args.results_file, 'w') as f:
+            with open(args.results_file, 'w') as f:  # timm-tpu-lint: disable=process-zero-io single-process evaluation driver; no pod launch path
                 dw = csv.DictWriter(f, fieldnames=results[0].keys())
                 dw.writeheader()
                 for r in results:

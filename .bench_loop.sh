@@ -40,6 +40,13 @@ while true; do
       BENCH_TOTAL_BUDGET=3600 python bench.py --replay --replay-steps autotune --save-self >> /tmp/bench_loop.log 2>&1
       echo "[$(date -u +%FT%TZ)] bench.py --replay-steps autotune rc=$? (one-shot)" >> /tmp/bench_loop.log
       touch /tmp/bench_autotune_done
+    elif [ ! -f /tmp/bench_family_sweep_done ]; then
+      # family coverage sweep: re-derive tests/fixtures/coverage_matrix.json
+      # live (every deep-eligible family through the sharded donated step,
+      # serve AOT buckets and device prefetch) and fail the step on drift
+      BENCH_TOTAL_BUDGET=3600 python bench.py --replay --replay-steps family_sweep --save-self >> /tmp/bench_loop.log 2>&1
+      echo "[$(date -u +%FT%TZ)] bench.py --replay-steps family_sweep rc=$? (one-shot)" >> /tmp/bench_loop.log
+      touch /tmp/bench_family_sweep_done
     fi
     sleep 2700
   else

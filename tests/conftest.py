@@ -102,7 +102,8 @@ def analysis_programs():
     from timm_tpu.perfbudget import run_matrix
     from timm_tpu.perfbudget.probe import capture_programs
 
-    names = ('base', 'accum4', 'serve_test_vit', 'tp22', 'elastic_resize')
+    names = ('base', 'accum4', 'serve_test_vit', 'tp22', 'elastic_resize',
+             'stage_scan_convnext', 'stage_scan_swin')
     with capture_programs() as programs:
         measured = run_matrix(names=list(names))
     return {'names': names, 'measured': measured, 'programs': list(programs)}

@@ -156,12 +156,25 @@ def test_report_exit_codes_error_outranks_violations():
 
 def test_tier_a_clean_at_head():
     """The consolidated source rules pass on the live repo — this single run
-    replaces the five in-test lint copies this PR deleted."""
+    replaces the five in-test lint copies this PR deleted. partition-rules
+    sweeps the zoo smoke families here; the all-family sweep is the slow
+    test below."""
     ensure_registered()
-    report = run_analysis(AnalysisContext(), select(tiers=['A']))
+    report = run_analysis(AnalysisContext(zoo_families=SMOKE_FAMILIES),
+                          select(tiers=['A']))
     assert report.exit_code == EXIT_CLEAN, report.format_text()
     assert set(report.rules) >= (MIGRATED | {'host-sync', 'traced-branch',
                                              'pragma-syntax', 'process-zero-io'})
+
+
+@pytest.mark.slow
+def test_partition_rules_disjoint_over_every_registered_family():
+    """The acceptance gate at full width: every param path of every
+    registered family matches exactly one non-catch-all partition rule, with
+    the conv rules active (same sweep as `python -m timm_tpu.analysis`)."""
+    ensure_registered()
+    report = run_analysis(AnalysisContext(), select(names=['partition-rules']))
+    assert report.exit_code == EXIT_CLEAN, report.format_text()
 
 
 # ---- 4. planted violations --------------------------------------------------
@@ -236,6 +249,8 @@ def test_capture_covers_the_expected_programs(analysis_programs):
     assert 'tp22/fwd' in names, names
     assert any(n.startswith('serve_test_vit/bucket') for n in names), names
     assert 'elastic_resize/train_step_postresize' in names, names
+    assert 'stage_scan_convnext/train_step' in names, names
+    assert 'stage_scan_swin/train_step' in names, names
 
 
 def test_tier_bc_rules_clean_on_captured_programs(analysis_programs):

@@ -1,0 +1,73 @@
+"""The checked-in family coverage matrix (analysis/coverage.py).
+
+tests/fixtures/coverage_matrix.json is ISSUE-20's sweep artifact: per-family
+booleans for abstract trace, stage/block scan, sharded donated step, serve
+AOT buckets, and device prefetch. Tier-1 re-derives the 5-family smoke
+subset and diffs it against the fixture — a capability silently regressing
+(or silently appearing unpinned) fails here. The full 51-family recompute
+runs under ``-m slow`` and via ``python -m timm_tpu.analysis.coverage --check``.
+"""
+import pytest
+
+from timm_tpu.analysis.coverage import (
+    COVERAGE_CHECKS,
+    DEEP_CHECKS,
+    SMOKE_COVERAGE_FAMILIES,
+    deep_eligible,
+    diff_matrix,
+    family_coverage,
+    load_matrix,
+)
+
+
+@pytest.fixture(scope='module')
+def matrix():
+    return load_matrix()
+
+
+def test_fixture_shape(matrix):
+    """Schema, check list, and one row per registered family."""
+    import timm_tpu
+    assert matrix['checks'] == list(COVERAGE_CHECKS)
+    fams = matrix['families']
+    assert set(fams) == set(timm_tpu.list_modules())
+    for module, row in fams.items():
+        assert isinstance(row['abstract_trace'], bool), module
+        assert isinstance(row['stage_or_block_scan'], bool), module
+        for c in DEEP_CHECKS:
+            # measured rows carry booleans; shallow rows carry null — a
+            # measured check can never be recorded as "unknown"
+            assert row[c] is None or isinstance(row[c], bool), (module, c)
+            assert (row[c] is None) == (not row['deep']), (module, c)
+
+
+def test_fixture_meets_acceptance_floor(matrix):
+    """ISSUE-20 acceptance: >=14 families green through the sharded donated
+    train step AND serve AOT; every family traces abstractly; a healthy set
+    of scan-capable families."""
+    fams = matrix['families']
+    green = [m for m, r in fams.items()
+             if r['sharded_donated_step'] and r['serve_aot']]
+    assert len(green) >= 14, sorted(green)
+    assert all(r['abstract_trace'] for r in fams.values()), [
+        m for m, r in fams.items() if not r['abstract_trace']]
+    scan = [m for m, r in fams.items() if r['stage_or_block_scan']]
+    assert {'convnext', 'metaformer', 'pvt_v2', 'mambaout',
+            'vision_transformer'} <= set(scan), sorted(scan)
+
+
+def test_smoke_families_match_reality(matrix):
+    """Re-derive the smoke subset live and diff against the fixture. The
+    smoke families are all deep-eligible, so every cell — including the
+    compile-for-real ones — is re-measured here in tier-1."""
+    assert all(deep_eligible(m) for m in SMOKE_COVERAGE_FAMILIES)
+    live = family_coverage(families=SMOKE_COVERAGE_FAMILIES)
+    problems = diff_matrix(matrix['families'], live)
+    assert not problems, '\n'.join(problems)
+
+
+@pytest.mark.slow
+def test_full_matrix_matches_reality(matrix):
+    live = family_coverage()
+    problems = diff_matrix(matrix['families'], live)
+    assert not problems, '\n'.join(problems)

@@ -677,10 +677,12 @@ def test_tp_cross_mesh_checkpoint_in_process(restore_global_mesh, tmp_path):
     ((2,4) fsdp-only, same 8 devices) with bit-exact params and eval logits
     matching within fp reduction-order noise.
 
-    img_size=64 (17 tokens), NOT the usual 32: at the 5-token geometry the
-    (2,2,2)-mesh compiled eval program diverges ~6e-2 from the eager model
-    on identical params (pre-existing; 10+ tokens agree to ~1e-7 — see the
-    PERF.md note). The drill itself runs the 101-token default geometry."""
+    Runs the usual img_size=32 again: the 5-token (2,2,2)-mesh eval
+    divergence that forced this twin onto img_size=64 was bisected to an
+    XLA:CPU SPMD miscompile of the constrained-residual + megatron-MLP add
+    at tiny token extents, and `shard_activation` now skips constraints
+    below its observed-safe floor (constraints._MIN_TOKENS) — see
+    test_tp_tiny_geometry_eval_parity below and the PERF.md note."""
     from jax.tree_util import tree_flatten_with_path
     from timm_tpu.parallel import set_global_mesh
     from timm_tpu.parallel.sharding import _kp_str
@@ -688,22 +690,22 @@ def test_tp_cross_mesh_checkpoint_in_process(restore_global_mesh, tmp_path):
     from timm_tpu.resilience.durable import atomic_write_npz, read_manifest, verify_checkpoint
     from timm_tpu.utils.serialization import flatten_pytree
 
-    def _task64(mesh):
-        model = timm_tpu.create_model('test_vit', num_classes=10, img_size=64)
+    def _task32(mesh):
+        model = timm_tpu.create_model('test_vit', num_classes=10, img_size=32)
         opt = create_optimizer_v2(model, opt='adamw', lr=0.1)
         return ClassificationTask(model, optimizer=opt, mesh=mesh,
                                   train_loss_fn=LabelSmoothingCrossEntropy(0.1))
 
-    def _batch64(mesh):
+    def _batch32(mesh):
         rng = np.random.RandomState(0)
         return shard_batch(
-            {'input': jnp.asarray(rng.rand(16, 64, 64, 3), jnp.float32),
+            {'input': jnp.asarray(rng.rand(16, 32, 32, 3), jnp.float32),
              'target': jnp.asarray(rng.randint(0, 10, 16))}, mesh)
 
     mesh = _tp_mesh()
     set_global_mesh(mesh)
-    task = _task64(mesh)
-    batch = _batch64(mesh)
+    task = _task32(mesh)
+    batch = _batch32(mesh)
     task.train_step(batch, lr=1e-3, step=1)
     logits_tp = np.asarray(task.eval_step({'input': batch['input']}))
 
@@ -724,7 +726,7 @@ def test_tp_cross_mesh_checkpoint_in_process(restore_global_mesh, tmp_path):
 
     mesh_f = _fsdp_mesh(4)
     set_global_mesh(mesh_f)
-    task_f = _task64(mesh_f)
+    task_f = _task32(mesh_f)
     loaded, _meta, used = load_with_fallback(ckpt)
     assert used == ckpt
     task_f.load_checkpoint_state(loaded)
@@ -732,5 +734,41 @@ def test_tp_cross_mesh_checkpoint_in_process(restore_global_mesh, tmp_path):
     b = {k: np.asarray(v) for k, v in flatten_pytree(nnx.state(task_f.model, nnx.Param)).items()}
     assert a.keys() == b.keys()
     assert max(float(np.abs(a[k] - b[k]).max()) for k in a) == 0.0
-    logits_f = np.asarray(task_f.eval_step({'input': _batch64(mesh_f)['input']}))
+    logits_f = np.asarray(task_f.eval_step({'input': _batch32(mesh_f)['input']}))
     np.testing.assert_allclose(logits_f, logits_tp, atol=1e-5)
+
+
+def test_tp_tiny_geometry_eval_parity(restore_global_mesh):
+    """Regression for the PERF.md tiny-geometry tp divergence: the jitted
+    (2,2,2)-mesh eval of test_vit@32 (5 tokens) now matches the eager model
+    to fp noise, because `shard_activation` skips its constraints below the
+    observed-safe token floor. Before the guard this diverged ~6e-2 (an
+    XLA:CPU SPMD miscompile of the constrained residual + megatron-sharded
+    MLP add, corrupting the interior batch shards' patch tokens)."""
+    from timm_tpu.parallel import build_param_shardings, set_global_mesh
+    from timm_tpu.parallel.constraints import _MIN_TOKENS, shard_activation
+
+    mesh = _tp_mesh()
+    set_global_mesh(mesh)
+    model = timm_tpu.create_model('test_vit', num_classes=10, img_size=32)
+    model.eval()
+    graphdef, state = nnx.split(model)
+    sharded = jax.device_put(state, build_param_shardings(state, mesh))
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(8, 32, 32, 3), jnp.float32)
+
+    def fwd(s, xx):
+        return nnx.merge(graphdef, s)(xx)
+
+    eager = fwd(state, x)
+    jitted = jax.jit(fwd)(sharded, shard_batch(x, mesh))
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted), atol=1e-5)
+
+    # the guard itself: below the floor the constraint is an identity even
+    # inside jit; at/above the floor it still pins the tp layout
+    tiny = jnp.zeros((8, _MIN_TOKENS - 1, 64))
+    big = jnp.zeros((8, _MIN_TOKENS, 64))
+    jaxpr_tiny = jax.make_jaxpr(lambda t: shard_activation(t, 'residual'))(tiny)
+    jaxpr_big = jax.make_jaxpr(lambda t: shard_activation(t, 'residual'))(big)
+    assert 'sharding_constraint' not in str(jaxpr_tiny)
+    assert 'sharding_constraint' in str(jaxpr_big)

@@ -13,6 +13,8 @@ import os
 import re
 from typing import Dict, Iterable, List, Set
 
+import numpy as np
+
 from .registry import AnalysisContext, rule
 from .report import Finding
 
@@ -131,9 +133,11 @@ def kernel_registered(ctx: AnalysisContext) -> List[Finding]:
 # ---- partition-rules (was tests/test_sharding.py, 2 tests) ------------------
 
 @rule('partition-rules', 'A',
-      'the default rule table stays disjoint + exhaustive on the ViT family '
-      '(each param path matches exactly one non-catch-all rule), and under '
-      'tp>1 every model-axis rule shards at least one real param',
+      'the default rule table stays disjoint + exhaustive over every swept '
+      'family (each param path matches exactly one non-catch-all rule; the '
+      'tier-1 smoke covers the zoo smoke set, the CLI run all ~51), and '
+      'under tp>1 every model-axis rule shards at least one real param and '
+      'the conv rules place real hierarchical kernels',
       needs_devices=4)
 def partition_rules(ctx: AnalysisContext) -> List[Finding]:
     from flax import nnx
@@ -143,6 +147,7 @@ def partition_rules(ctx: AnalysisContext) -> List[Finding]:
         create_mesh, default_partition_rules, match_rule, path_specs,
     )
     from ..utils.serialization import flatten_pytree
+    from .zoo import family_representative
 
     findings: List[Finding] = []
     rules = default_partition_rules()
@@ -156,16 +161,36 @@ def partition_rules(ctx: AnalysisContext) -> List[Finding]:
         model = timm_tpu.create_model(model_name, **kwargs)
         return flatten_pytree(nnx.state(model, nnx.Param))
 
-    # disjoint + exhaustive: first-match-wins never has to disambiguate
-    for model_name, kwargs in (
-            ('test_vit', dict(num_classes=10, img_size=32)),
-            ('vit_tiny_patch16_224', dict(img_size=64))):
-        for path in paths_for(model_name, **kwargs):
+    def abstract_paths_for(model_name):
+        # nnx.eval_shape constructs without allocating arrays, so sweeping
+        # every family stays milliseconds per family
+        model = nnx.eval_shape(
+            lambda: timm_tpu.create_model(model_name, num_classes=10))
+        return flatten_pytree(nnx.state(model, nnx.Param))
+
+    # disjoint + exhaustive over the swept families: first-match-wins never
+    # has to disambiguate. zoo_families=None (the CLI path) sweeps all
+    # registered families; the tier-1 fixture injects the smoke subset.
+    for module in (ctx.zoo_families or timm_tpu.list_modules()):
+        try:
+            name, _ = family_representative(module)
+            paths = abstract_paths_for(name)
+        except Exception:
+            continue  # a family that cannot construct is zoo-abstract-trace's finding
+        for path in paths:
             n = sum(1 for r in specific if r.matches(path))
             if n != 1:
                 findings.append(Finding(
-                    'partition-rules', f'{model_name}:{path}', 0,
+                    'partition-rules', f'{name}:{path}', 0,
                     f'matched {n} non-catch-all rules (expected exactly 1)'))
+
+    # sized-model exhaustiveness spot check on a real (non-test-size) config
+    for path in abstract_paths_for('vit_tiny_patch16_224'):
+        n = sum(1 for r in specific if r.matches(path))
+        if n != 1:
+            findings.append(Finding(
+                'partition-rules', f'vit_tiny_patch16_224:{path}', 0,
+                f'matched {n} non-catch-all rules (expected exactly 1)'))
 
     # tp exercise: each of the four model-axis rules shards >=1 real param,
     # and the tp kernels also carry fsdp on the other dim (2-D sharding)
@@ -189,6 +214,36 @@ def partition_rules(ctx: AnalysisContext) -> List[Finding]:
         findings.append(Finding(
             'partition-rules', 'blocks.0.attn.qkv.kernel', 0,
             f'tp kernel not 2-D sharded (got spec {qkv})'))
+
+    # conv exercise on the same real 2x2 mesh: a hierarchical family's large
+    # conv kernels shard their OUT-CHANNEL dim over fsdp, depthwise kernels
+    # replicate, and its NHWC MLP Linears (1x1 convs) still pick up tp
+    cpaths = paths_for('test_convnext', num_classes=10)
+    cspecs = path_specs(cpaths, mesh)
+    large_conv = [p for p in cpaths
+                  if p.endswith('.kernel') and len(cpaths[p].shape) == 4
+                  and cpaths[p].shape[-2] > 1
+                  and int(np.prod(cpaths[p].shape)) >= 1024]
+    if not any(tuple(cspecs[p])[-1:] == ('fsdp',) for p in large_conv):
+        findings.append(Finding(
+            'partition-rules', 'test_convnext:conv-out', 0,
+            f'no large conv kernel sharded fsdp on its out-channel dim '
+            f'(candidates: {large_conv[:4]})'))
+    dw = [p for p in cpaths
+          if p.endswith('.kernel') and len(cpaths[p].shape) == 4
+          and cpaths[p].shape[-2] == 1]
+    bad_dw = [p for p in dw if tuple(cspecs[p]) != ()]
+    if not dw or bad_dw:
+        findings.append(Finding(
+            'partition-rules', 'test_convnext:depthwise', 0,
+            f'depthwise conv kernels must replicate (violations: {bad_dw[:4]}, '
+            f'found {len(dw)} dw kernels)'))
+    if not any('model' in tuple(cspecs[p]) for p in cpaths
+               if '.mlp.' in p and p.endswith('.kernel')):
+        findings.append(Finding(
+            'partition-rules', 'test_convnext:mlp-tp', 0,
+            'no convnext MLP kernel carries the model axis — the NHWC '
+            '1x1-conv Linears should reuse the attention-era tp rules'))
     return findings
 
 

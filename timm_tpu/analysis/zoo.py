@@ -20,9 +20,12 @@ from .report import Finding
 __all__ = ['family_representative', 'sweep', 'SMOKE_FAMILIES',
            'SIZE_OVERRIDES']
 
-# families cheap enough for the tier-1 smoke (full sweep: CLI + -m slow)
+# families cheap enough for the tier-1 smoke (full sweep: CLI + -m slow);
+# swin + metaformer keep the hierarchical stage-scan families represented
+# alongside convnext, per ISSUE 20
 SMOKE_FAMILIES: Tuple[str, ...] = (
     'vision_transformer', 'resnet', 'convnext', 'naflexvit', 'mlp_mixer',
+    'swin_transformer', 'metaformer',
 )
 
 # native-input-size overrides where the default cfg size cannot trace:

@@ -56,6 +56,15 @@ class DropPath(nnx.Module):
         self.rngs = rngs.fork() if rngs is not None and self.drop_prob > 0.0 else None
 
     def __call__(self, x):
+        # scan mode (models/_manipulate.scan_stage_stack): the merged block's
+        # DropPath is a structural no-op (rate/rngs neutralized before the
+        # split) and the per-layer (rate, key) ride the scanned inputs — the
+        # scan body pins them here because stage blocks, unlike ViT blocks,
+        # take no drop_path_override argument.
+        ov = getattr(self, '_scan_override', None)
+        if ov is not None:
+            rate, key = ov
+            return drop_path(x, key, rate, self.scale_by_keep)
         if self.deterministic or self.drop_prob == 0.0 or self.rngs is None:
             return x
         return drop_path(x, self.rngs.dropout(), self.drop_prob, self.scale_by_keep)

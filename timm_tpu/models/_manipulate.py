@@ -17,6 +17,7 @@ __all__ = [
     'group_parameters', 'group_with_matcher', 'named_parameters', 'checkpoint_seq',
     'BlockStackError', 'iter_submodules', 'build_block_stack', 'scan_block_stack',
     'drop_path_scan_inputs', 'resolve_block_scan', 'warn_scan_fallback',
+    'build_stage_stack', 'scan_stage_stack', 'plan_stage_stack', 'resolve_stage_scan',
 ]
 
 
@@ -142,14 +143,15 @@ def resolve_block_scan(flag) -> bool:
 _SCAN_FALLBACK_WARNED = set()
 
 
-def warn_scan_fallback(model_name: str, err):
-    """Log (once per model-class/reason) that block_scan fell back to the loop."""
+def warn_scan_fallback(model_name: str, err, what: str = 'block_scan'):
+    """Log (once per model-class/reason) that block/stage scan fell back to
+    the loop."""
     key = (model_name, str(err))
     if key not in _SCAN_FALLBACK_WARNED:
         _SCAN_FALLBACK_WARNED.add(key)
         import logging
         logging.getLogger(__name__).warning(
-            f'{model_name}: block_scan fell back to the Python block loop: {err}')
+            f'{model_name}: {what} fell back to the Python block loop: {err}')
 
 
 def iter_submodules(module):
@@ -329,6 +331,203 @@ def scan_block_stack(blocks, x, call_block=None, *, per_layer=None, remat: bool 
         body = jax.checkpoint(body, policy=remat_policy)
     out, ys = jax.lax.scan(body, x, (stacked, per_layer))
     return (out, ys) if collect else out
+
+
+# ---- stage-level scan (hierarchical models) ---------------------------------
+#
+# Hierarchical models (convnext, swin, metaformer, pvt_v2, regnet, mambaout)
+# run N stages of homogeneous blocks separated by downsample boundaries.
+# Within one stage the block_scan recipe applies unchanged — stack per-layer
+# state, run ONE lax.scan — but two structural wrinkles need planning that
+# ViT stacks never see:
+#
+#   * an EAGER PREFIX: the first block of a stage often differs from the rest
+#     (regnet's stride-2/downsample block, convnext's in_chs != out_chs
+#     shortcut block). Those k blocks run as a Python loop and the
+#     homogeneous suffix scans.
+#   * a PERIOD: swin alternates shifted/unshifted blocks (period 2), so the
+#     graphdefs repeat with period p rather than being all-equal. Blocks are
+#     stacked per offset-column (blocks [j, j+p, j+2p, ...]) and the scan
+#     body runs p merged blocks per step.
+#
+# `plan_stage_stack` searches (eager_prefix, period) in a fixed cheap order;
+# a stage with no valid plan raises BlockStackError and the caller falls back
+# to the loop (logged once per model class — never silently slow).
+
+
+def resolve_stage_scan(flag) -> bool:
+    """Resolve a hierarchical model's ``stage_scan`` constructor arg: an
+    explicit bool wins; None reads the ``TIMM_TPU_STAGE_SCAN`` env toggle
+    (default off, mirroring ``resolve_block_scan``)."""
+    if flag is not None:
+        return bool(flag)
+    import os
+    return os.environ.get('TIMM_TPU_STAGE_SCAN', '').lower() in ('1', 'true', 'yes', 'on')
+
+
+def _stage_block_reprs(blocks):
+    """Masked graphdef repr per block, with DropPath statics neutralized the
+    same way `build_block_stack` does, so a ramped stochastic-depth schedule
+    doesn't read as heterogeneity during planning."""
+    from flax import nnx
+
+    from ..layers.drop import DropPath
+
+    reprs = []
+    for b in blocks:
+        dp_saved = []
+        for sm in iter_submodules(b):
+            if isinstance(sm, DropPath):
+                dp_saved.append((sm, sm.drop_prob, sm.rngs))
+                sm.drop_prob = 0.0
+                sm.rngs = None
+        try:
+            graphdef, _, _ = nnx.split(b, nnx.RngState, ...)
+            reprs.append(_masked_graphdef_repr(graphdef))
+        finally:
+            for sm, p, r in dp_saved:
+                sm.drop_prob = p
+                sm.rngs = r
+    return reprs
+
+
+def plan_stage_stack(blocks) -> Tuple[int, int]:
+    """Find ``(eager_prefix, period)`` for a stage's block list: the first
+    `eager_prefix` blocks run eagerly, the rest scan with period `period`
+    (each offset-column homogeneous, >=2 scan steps). Searched smallest-first
+    so a fully homogeneous stage plans as (0, 1). Raises BlockStackError when
+    no candidate fits."""
+    blocks = list(blocks)
+    if len(blocks) < 2:
+        raise BlockStackError('need at least 2 blocks to scan')
+    types = [type(b) for b in blocks]
+    reprs = _stage_block_reprs(blocks)
+    for prefix in (0, 1):
+        for period in (1, 2):
+            rest = len(blocks) - prefix
+            if rest < 2 * period or rest % period:
+                continue
+            cols_ok = all(
+                all(types[prefix + j + i * period] is types[prefix + j]
+                    and reprs[prefix + j + i * period] == reprs[prefix + j]
+                    for i in range(rest // period))
+                for j in range(period))
+            if cols_ok:
+                return prefix, period
+    raise BlockStackError(
+        'no (eager_prefix, period) plan makes the stage scannable: block '
+        'statics vary beyond a length-1 prefix and period-2 alternation')
+
+
+def build_stage_stack(blocks, period: int = 1, validate: bool = True):
+    """Stack a stage's scannable blocks per offset-column: returns
+    ``(graphdefs, rng_states, stackeds)``, each a length-`period` list, where
+    ``stackeds[j]`` is the stacked state of blocks ``[j, j+period, ...]``.
+    Period 1 is exactly one `build_block_stack`."""
+    blocks = list(blocks)
+    if len(blocks) % period:
+        raise BlockStackError(
+            f'{len(blocks)} blocks do not divide into period-{period} columns')
+    graphdefs, rng_states, stackeds = [], [], []
+    for j in range(period):
+        graphdef, rng_state, stacked = build_block_stack(blocks[j::period], validate=validate)
+        graphdefs.append(graphdef)
+        rng_states.append(rng_state)
+        stackeds.append(stacked)
+    return graphdefs, rng_states, stackeds
+
+
+def _check_no_train_batch_stats(blocks):
+    """Batch-stat modules (BatchNorm & friends expose `use_running_average`)
+    update running mean/var as a side effect of a train-mode call; a scan
+    body cannot write those updates back to the real modules, so scanning
+    would silently freeze the stats. Raise and let the loop handle it."""
+    for b in blocks:
+        for sm in iter_submodules(b):
+            if getattr(sm, 'use_running_average', None) is False:
+                raise BlockStackError(
+                    f'{type(sm).__name__} in training mode: running-stat '
+                    'updates inside a scan body would be silently discarded')
+
+
+def _set_drop_path_overrides(block, rates, keys):
+    """Pin the scanned per-layer (rate, key) onto the merged block's DropPath
+    sites, in the same deterministic `iter_submodules` order
+    `drop_path_scan_inputs` drew them in."""
+    from ..layers.drop import DropPath
+    site = 0
+    for sm in iter_submodules(block):
+        if isinstance(sm, DropPath):
+            sm._scan_override = (rates[site], keys[site])
+            site += 1
+
+
+def scan_stage_stack(blocks, x, call_block=None, *, remat: bool = False,
+                     remat_policy=None, validate: bool = True):
+    """Run one stage's block list as ONE ``jax.lax.scan``: trace/compile cost
+    O(1) in stage depth, with an eager prefix for a heterogeneous first block
+    and period-p column stacking for alternating statics (swin's shift).
+
+    ``call_block(block, x)`` runs one merged block (default ``block(x)``;
+    pvt_v2 passes its static feat_size through a closure). Per-layer DropPath
+    rates/keys ride the scanned inputs exactly as in `scan_block_stack`,
+    except they are pinned onto the merged blocks' DropPath modules (stage
+    blocks take no override argument). ``remat=True`` wraps the body in
+    `jax.checkpoint` — remat-inside-scan replaces `checkpoint_seq`.
+
+    The carry is pinned to the NHWC 'channels' layout on 'model' meshes
+    (rank-3 stages like pvt get 'residual'); without the in-body constraint
+    GSPMD picks one (replicated) layout for the whole while-loop — the
+    involuntary-remat regime PERF.md documents.
+
+    Raises BlockStackError (train-mode batch stats, no valid plan,
+    unstackable states); callers fall back to the bit-identical Python loop.
+    """
+    import jax
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from ..parallel import shard_activation
+
+    blocks = list(blocks)
+    if call_block is None:
+        call_block = lambda blk, xx: blk(xx)
+    if validate:
+        _check_no_train_batch_stats(blocks)
+    prefix, period = plan_stage_stack(blocks)
+    kind = 'channels' if getattr(x, 'ndim', 0) == 4 else 'residual'
+
+    for blk in blocks[:prefix]:
+        x = call_block(blk, x)
+    scanned = blocks[prefix:]
+    graphdefs, rng_states, stackeds = build_stage_stack(scanned, period, validate=validate)
+    n_steps = len(scanned) // period
+
+    dp = drop_path_scan_inputs(scanned)
+    if dp is not None:
+        # [L, S] -> [n_steps, period, S]: lax.scan slices the step axis,
+        # the body indexes the period offset
+        rates, keys = dp
+        dp = (rates.reshape(n_steps, period, -1),
+              keys.reshape((n_steps, period) + keys.shape[1:]))
+
+    x = shard_activation(x, kind)
+
+    def body(carry, xs):
+        layer_states, extra = xs
+        y = carry
+        for j in range(period):
+            blk = nnx.merge(graphdefs[j], rng_states[j], layer_states[j])
+            if extra is not None:
+                _set_drop_path_overrides(blk, extra[0][j], extra[1][j])
+            y = call_block(blk, y)
+            y = shard_activation(y, kind)
+        return y, None
+
+    if remat:
+        body = jax.checkpoint(body, policy=remat_policy)
+    out, _ = jax.lax.scan(body, x, (tuple(stackeds), dp))
+    return out
 
 
 def checkpoint_seq(functions, x, every: int = 1, flatten: bool = False, skip_last: bool = False,

@@ -23,7 +23,10 @@ from ..layers import (
 )
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
-from ._manipulate import checkpoint_seq
+from ._manipulate import (
+    BlockStackError, checkpoint_seq, resolve_stage_scan, scan_stage_stack,
+    warn_scan_fallback,
+)
 from ._registry import generate_default_cfgs, register_model
 
 __all__ = ['ConvNeXt', 'ConvNeXtBlock']
@@ -150,11 +153,17 @@ class ConvNeXtStage(nnx.Module):
             for i in range(depth)
         ])
         self.grad_checkpointing = False
+        self.stage_scan = False
 
     def __call__(self, x):
         if self.downsample_norm is not None:
             x = self.downsample_norm(x)
             x = self.downsample_conv(x)
+        if self.stage_scan:
+            try:
+                return scan_stage_stack(self.blocks, x, remat=self.grad_checkpointing)
+            except BlockStackError as e:
+                warn_scan_fallback(type(self).__name__, e, what='stage_scan')
         if self.grad_checkpointing:
             x = checkpoint_seq(self.blocks, x)
         else:
@@ -187,6 +196,7 @@ class ConvNeXt(nnx.Module):
             norm_eps: Optional[float] = None,
             drop_rate: float = 0.0,
             drop_path_rate: float = 0.0,
+            stage_scan: Optional[bool] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -262,6 +272,7 @@ class ConvNeXt(nnx.Module):
             prev_chs = out_chs
             self.feature_info += [dict(num_chs=prev_chs, reduction=curr_stride, module=f'stages.{i}')]
         self.stages = nnx.List(stages)
+        self.set_stage_scan(resolve_stage_scan(stage_scan))
 
         self.num_features = self.head_hidden_size = prev_chs
         if head_norm_first:
@@ -304,6 +315,14 @@ class ConvNeXt(nnx.Module):
     def set_grad_checkpointing(self, enable: bool = True):
         for s in self.stages:
             s.grad_checkpointing = enable
+
+    def set_stage_scan(self, enable: bool = True):
+        for s in self.stages:
+            s.stage_scan = enable
+
+    # stage scan IS this family's scan-over-layers: generic machinery that
+    # toggles `set_block_scan` (bench replay, probes) reaches it too
+    set_block_scan = set_stage_scan
 
     def get_classifier(self):
         return self.head.fc

@@ -23,6 +23,9 @@ from ..layers import (
     calculate_drop_path_rates, get_act_fn, trunc_normal_, zeros_,
 )
 from ._builder import build_model_with_cfg
+from ._manipulate import (
+    BlockStackError, resolve_stage_scan, scan_stage_stack, warn_scan_fallback,
+)
 from ._features import feature_take_indices
 from ._registry import generate_default_cfgs, register_model
 
@@ -188,6 +191,7 @@ class MambaOutStage(nnx.Module):
         kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         dim_out = dim_out or dim
         self.grad_checkpointing = False
+        self.stage_scan = False
         if downsample == 'conv':
             self.downsample = Downsample(dim, dim_out, norm_layer=norm_layer, **kw)
         elif downsample == 'conv_nf':
@@ -207,6 +211,11 @@ class MambaOutStage(nnx.Module):
     def __call__(self, x):
         if self.downsample is not None:
             x = self.downsample(x)
+        if self.stage_scan:
+            try:
+                return scan_stage_stack(self.blocks, x, remat=self.grad_checkpointing)
+            except BlockStackError as e:
+                warn_scan_fallback(type(self).__name__, e, what='stage_scan')
         remat_blk = nnx.remat(GatedConvBlock.__call__) if self.grad_checkpointing else None
         for blk in self.blocks:
             x = remat_blk(blk, x) if remat_blk is not None else blk(x)
@@ -234,6 +243,7 @@ class MambaOut(nnx.Module):
             drop_path_rate: float = 0.,
             drop_rate: float = 0.,
             head_fn: str = 'default',
+            stage_scan: Optional[bool] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -272,6 +282,7 @@ class MambaOut(nnx.Module):
             prev_dim = dim
             self.feature_info += [dict(num_chs=prev_dim, reduction=curr_stride, module=f'stages.{i}')]
         self.stages = nnx.List(stages)
+        self.set_stage_scan(resolve_stage_scan(stage_scan))
 
         if head_fn == 'default':
             # unusual norm → pool → fc → act → norm → fc combo
@@ -300,6 +311,14 @@ class MambaOut(nnx.Module):
     def set_grad_checkpointing(self, enable: bool = True):
         for s in self.stages:
             s.grad_checkpointing = enable
+
+    def set_stage_scan(self, enable: bool = True):
+        for s in self.stages:
+            s.stage_scan = enable
+
+    # stage scan IS this family's scan-over-layers: generic machinery that
+    # toggles `set_block_scan` (bench replay, probes) reaches it too
+    set_block_scan = set_stage_scan
 
     def get_classifier(self):
         return self.head.fc

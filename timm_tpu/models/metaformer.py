@@ -22,7 +22,10 @@ from ..layers import (
 )
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
-from ._manipulate import checkpoint_seq
+from ._manipulate import (
+    BlockStackError, checkpoint_seq, resolve_stage_scan, scan_stage_stack,
+    warn_scan_fallback,
+)
 from ._registry import generate_default_cfgs, register_model
 
 __all__ = ['MetaFormer']
@@ -237,6 +240,7 @@ class MetaFormerStage(nnx.Module):
                  *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
         kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.grad_checkpointing = False
+        self.stage_scan = False
         self.downsample = None if in_chs == out_chs else Downsampling(
             in_chs, out_chs, kernel_size=3, stride=2, padding=1, norm_layer=downsample_norm, **kw)
         self.blocks = nnx.List([
@@ -251,6 +255,11 @@ class MetaFormerStage(nnx.Module):
     def __call__(self, x):
         if self.downsample is not None:
             x = self.downsample(x)
+        if self.stage_scan:
+            try:
+                return scan_stage_stack(self.blocks, x, remat=self.grad_checkpointing)
+            except BlockStackError as e:
+                warn_scan_fallback(type(self).__name__, e, what='stage_scan')
         if self.grad_checkpointing:
             x = checkpoint_seq(self.blocks, x)
         else:
@@ -342,6 +351,7 @@ class MetaFormer(nnx.Module):
             norm_layers: Union[Callable, List[Callable]] = LayerNorm2dNoBias,
             output_norm: Callable = LayerNorm2d,
             use_mlp_head: bool = True,
+            stage_scan: Optional[bool] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -378,6 +388,7 @@ class MetaFormer(nnx.Module):
             prev_dim = dims[i]
             self.feature_info += [dict(num_chs=dims[i], reduction=2 ** (i + 2), module=f'stages.{i}')]
         self.stages = nnx.List(stages)
+        self.set_stage_scan(resolve_stage_scan(stage_scan))
         self.head = _Head(
             self.num_features, num_classes, global_pool=global_pool, drop_rate=drop_rate,
             use_mlp_head=use_mlp_head, output_norm=output_norm, **kw)
@@ -394,6 +405,14 @@ class MetaFormer(nnx.Module):
     def set_grad_checkpointing(self, enable: bool = True):
         for s in self.stages:
             s.grad_checkpointing = enable
+
+    def set_stage_scan(self, enable: bool = True):
+        for s in self.stages:
+            s.stage_scan = enable
+
+    # stage scan IS this family's scan-over-layers: generic machinery that
+    # toggles `set_block_scan` (bench replay, probes) reaches it too
+    set_block_scan = set_stage_scan
 
     def get_classifier(self):
         return self.head.fc

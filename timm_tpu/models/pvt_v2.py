@@ -22,6 +22,9 @@ from ..layers import (
 )
 from ..layers.drop import Dropout
 from ._builder import build_model_with_cfg
+from ._manipulate import (
+    BlockStackError, resolve_stage_scan, scan_stage_stack, warn_scan_fallback,
+)
 from ._features import feature_take_indices
 from ._registry import generate_default_cfgs, register_model
 
@@ -185,6 +188,7 @@ class PvtStage(nnx.Module):
                  *, dtype=None, param_dtype=jnp.float32, rngs: nnx.Rngs):
         kw = dict(dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.grad_checkpointing = False
+        self.stage_scan = False
         if downsample:
             self.downsample = OverlapPatchEmbed(
                 patch_size=3, stride=2, in_chans=dim, embed_dim=dim_out, **kw)
@@ -207,6 +211,16 @@ class PvtStage(nnx.Module):
         B, H, W, C = x.shape
         feat_size = (H, W)
         x = x.reshape(B, -1, C)
+        if self.stage_scan:
+            try:
+                x = scan_stage_stack(
+                    self.blocks, x,
+                    call_block=lambda blk, xx: blk(xx, feat_size),
+                    remat=self.grad_checkpointing)
+                x = self.norm(x)
+                return x.reshape(B, H, W, -1)
+            except BlockStackError as e:
+                warn_scan_fallback(type(self).__name__, e, what='stage_scan')
         if self.grad_checkpointing:
             def run_block(blk, x_, fs):
                 return blk(x_, fs)
@@ -240,6 +254,7 @@ class PyramidVisionTransformerV2(nnx.Module):
             attn_drop_rate: float = 0.0,
             drop_path_rate: float = 0.0,
             norm_layer: Callable = LayerNorm,
+            stage_scan: Optional[bool] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -272,6 +287,7 @@ class PyramidVisionTransformerV2(nnx.Module):
             prev_dim = embed_dims[i]
             self.feature_info += [dict(num_chs=prev_dim, reduction=4 * 2 ** i, module=f'stages.{i}')]
         self.stages = nnx.List(stages)
+        self.set_stage_scan(resolve_stage_scan(stage_scan))
 
         self.num_features = self.head_hidden_size = embed_dims[-1]
         self.head_drop = Dropout(drop_rate, rngs=rngs)
@@ -291,6 +307,14 @@ class PyramidVisionTransformerV2(nnx.Module):
     def set_grad_checkpointing(self, enable: bool = True):
         for s in self.stages:
             s.grad_checkpointing = enable
+
+    def set_stage_scan(self, enable: bool = True):
+        for s in self.stages:
+            s.stage_scan = enable
+
+    # stage scan IS this family's scan-over-layers: generic machinery that
+    # toggles `set_block_scan` (bench replay, probes) reaches it too
+    set_block_scan = set_stage_scan
 
     def get_classifier(self):
         return self.head

@@ -14,7 +14,10 @@ from flax import nnx
 from ..layers import BatchNormAct2d, ClassifierHead, DropPath, SEModule, create_conv2d, get_act_fn
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
-from ._manipulate import checkpoint_seq
+from ._manipulate import (
+    BlockStackError, checkpoint_seq, resolve_stage_scan, scan_stage_stack,
+    warn_scan_fallback,
+)
 from ._registry import generate_default_cfgs, register_model
 
 __all__ = ['RegNet']
@@ -104,6 +107,7 @@ class RegNet(nnx.Module):
             drop_path_rate: float = 0.0,
             act_layer: Union[str, Callable] = 'relu',
             norm_layer: Callable = BatchNormAct2d,
+            stage_scan: Optional[bool] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -156,6 +160,7 @@ class RegNet(nnx.Module):
             prev_chs, num_classes, pool_type=global_pool, drop_rate=drop_rate,
             dtype=dtype, param_dtype=param_dtype, rngs=rngs)
         self.grad_checkpointing = False
+        self.stage_scan = resolve_stage_scan(stage_scan)
 
     def no_weight_decay(self) -> set:
         return set()
@@ -165,6 +170,16 @@ class RegNet(nnx.Module):
 
     def set_grad_checkpointing(self, enable: bool = True):
         self.grad_checkpointing = enable
+
+    def set_stage_scan(self, enable: bool = True):
+        # regnet has no Stage module; forward_features scans each block list.
+        # BatchNorm running stats gate scan to eval mode (loud loop fallback
+        # in train mode), so the flag is safe to leave on.
+        self.stage_scan = enable
+
+    # stage scan IS this family's scan-over-layers: generic machinery that
+    # toggles `set_block_scan` (bench replay, probes) reaches it too
+    set_block_scan = set_stage_scan
 
     def get_classifier(self):
         return self.head.fc
@@ -176,6 +191,12 @@ class RegNet(nnx.Module):
     def forward_features(self, x):
         x = self.stem_bn(self.stem_conv(x))
         for stage in self.stages:
+            if self.stage_scan:
+                try:
+                    x = scan_stage_stack(stage, x, remat=self.grad_checkpointing)
+                    continue
+                except BlockStackError as e:
+                    warn_scan_fallback(type(self).__name__, e, what='stage_scan')
             if self.grad_checkpointing:
                 x = checkpoint_seq(stage, x)
             else:

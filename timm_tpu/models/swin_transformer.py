@@ -25,7 +25,10 @@ from ..layers.attention import scaled_dot_product_attention
 from ..layers.drop import dropout_rng_key
 from ._builder import build_model_with_cfg
 from ._features import feature_take_indices
-from ._manipulate import checkpoint_seq
+from ._manipulate import (
+    BlockStackError, checkpoint_seq, resolve_stage_scan, scan_stage_stack,
+    warn_scan_fallback,
+)
 from ._registry import generate_default_cfgs, register_model
 
 __all__ = ['SwinTransformer', 'SwinTransformerBlock', 'WindowAttention']
@@ -268,6 +271,7 @@ class SwinTransformerStage(nnx.Module):
             rngs: nnx.Rngs,
     ):
         self.grad_checkpointing = False
+        self.stage_scan = False
         if downsample:
             self.downsample = PatchMerging(dim, out_dim, norm_layer=norm_layer,
                                            dtype=dtype, param_dtype=param_dtype, rngs=rngs)
@@ -304,6 +308,11 @@ class SwinTransformerStage(nnx.Module):
     def __call__(self, x):
         if self.downsample is not None:
             x = self.downsample(x)
+        if self.stage_scan:
+            try:
+                return scan_stage_stack(self.blocks, x, remat=self.grad_checkpointing)
+            except BlockStackError as e:
+                warn_scan_fallback(type(self).__name__, e, what='stage_scan')
         if self.grad_checkpointing:
             x = checkpoint_seq(self.blocks, x)
         else:
@@ -332,6 +341,7 @@ class SwinTransformer(nnx.Module):
             attn_drop_rate: float = 0.0,
             drop_path_rate: float = 0.1,
             norm_layer: Optional[Union[str, Callable]] = None,
+            stage_scan: Optional[bool] = None,
             *,
             dtype=None,
             param_dtype=jnp.float32,
@@ -383,6 +393,7 @@ class SwinTransformer(nnx.Module):
                 scale *= 2
             self.feature_info += [dict(num_chs=out_dim, reduction=patch_size * scale, module=f'layers.{i}')]
         self.layers = nnx.List(stages)
+        self.set_stage_scan(resolve_stage_scan(stage_scan))
 
         self.norm = norm_layer(self.num_features, rngs=rngs)
         self.head = ClassifierHead(
@@ -408,6 +419,14 @@ class SwinTransformer(nnx.Module):
     def set_grad_checkpointing(self, enable: bool = True):
         for l in self.layers:
             l.grad_checkpointing = enable
+
+    def set_stage_scan(self, enable: bool = True):
+        for s in self.layers:
+            s.stage_scan = enable
+
+    # stage scan IS this family's scan-over-layers: generic machinery that
+    # toggles `set_block_scan` (bench replay, probes) reaches it too
+    set_block_scan = set_stage_scan
 
     def get_classifier(self):
         return self.head.fc

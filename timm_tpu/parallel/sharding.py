@@ -78,8 +78,14 @@ class PartitionRule:
 # emits one reduce per pair (attn.proj, mlp.fc2). The generic kernel rule
 # excludes all four via lookahead so the rule table stays DISJOINT — the
 # exactly-one-rule test is what keeps placement auditable.
-_TP_ATTN_QKV = r'\.attn\.(?:qkv|q_proj|k_proj|v_proj)\.kernel$'
-_TP_ATTN_OUT = r'\.attn\.proj\.kernel$'
+#
+# Hierarchical families route through the same four rules: metaformer wraps
+# attention as `token_mixer`, pvt_v2 splits q from kv, and a 1x1 projection
+# conv (NHWC Linear) matches the same suffixes — its kernel is rank 2, so the
+# megatron specs apply unchanged. Convnext's NHWC MLP fc1/fc2 Linears already
+# match the mlp rules.
+_TP_ATTN_QKV = r'\.(?:attn|token_mixer)\.(?:qkv|q_proj|k_proj|v_proj|q|kv)\.kernel$'
+_TP_ATTN_OUT = r'\.(?:attn|token_mixer)\.proj\.kernel$'
 _TP_MLP_IN = r'\.mlp\.(?:fc1|fc1_g|fc1_x)\.kernel$'
 _TP_MLP_OUT = r'\.mlp\.fc2\.kernel$'
 _TP_KERNEL_PATTERNS = (_TP_ATTN_QKV, _TP_ATTN_OUT, _TP_MLP_IN, _TP_MLP_OUT)
@@ -110,11 +116,19 @@ def default_partition_rules() -> Tuple[PartitionRule, ...]:
         PartitionRule(_TP_MLP_IN, 'megatron_col', name='mlp-fc1'),
         PartitionRule(_TP_MLP_OUT, 'megatron_row', name='mlp-fc2'),
         PartitionRule(_GENERIC_KERNEL, 'fsdp_largest', name='kernel'),
-        PartitionRule(r'\.bias$', 'replicate', name='bias'),
-        PartitionRule(r'(^|\.)(scale|weight|gamma|gamma_1|gamma_2|lambda_q1|lambda_q2|lambda_k1|lambda_k2)$',
+        # `_bias(es)` covers the decomposed-qkv q/v biases (beit/eva/swinv2)
+        # and the levit/efficientformer/tinyvit attention-bias tables
+        PartitionRule(r'(\.|_)bias(es)?$', 'replicate', name='bias'),
+        PartitionRule(r'(^|\.)(scale|weight|gamma|gamma_1|gamma_2|gamma1|gamma2|gamma3|gamma_xca|'
+                      r'lambda_q1|lambda_q2|lambda_k1|lambda_k2|logit_scale|temperature|gain)$',
                       'replicate', name='norm-scale'),
-        PartitionRule(r'(^|\.)(cls_token|reg_token|dist_token|pos_embed|pos_embed_win|relative_position_bias_table|'
-                      r'embedding|latent|probe|mask_token)($|\.)', 'replicate', name='token-embed'),
+        # the leading lookahead keeps this DISJOINT from the kernel/bias
+        # rules when a module is itself named pos_embed/... (xcit's conv
+        # positional encoding nests real kernels under `pos_embed.`)
+        PartitionRule(r'^(?!.*\.(?:kernel|bias)$)(?:.*\.)?'
+                      r'(?:cls_token|reg_token|dist_token|pos_embed|pos_embed_win|pos_embed_x|pos_embed_y|'
+                      r'relative_position_bias_table|rel_pos_w|rel_pos_h|embedding|latent|probe|mask_token)($|\.)',
+                      'replicate', name='token-embed'),
         PartitionRule(r'.*', 'replicate', name='catch-all'),
     )
 
@@ -153,11 +167,29 @@ def _warn_once(path: str, msg: str):
 
 def _fsdp_largest_spec(path: str, shape: Sequence[int], mesh: Mesh,
                        min_shard_size: int) -> P:
-    """'fsdp_largest' action: shard the largest fsdp-divisible dim."""
+    """'fsdp_largest' action: shard the largest fsdp-divisible dim.
+
+    Conv kernels (rank >= 3, nnx layout ``(*window, in // groups, out)``)
+    always shard the OUTPUT-CHANNEL dim instead of the largest one: the
+    spatial window dims are tiny and never divisible, and sharding the input
+    dim would force an all-gather of the kernel before the contraction while
+    the out dim reduce-scatters for free with the NHWC activation layout.
+    Depthwise kernels (in // groups == 1) replicate — their whole weight is
+    smaller than one dense row and GSPMD handles grouped convs poorly when
+    the group dim is split.
+    """
     n_shard = fsdp_size(mesh)
     size = int(np.prod(shape)) if len(shape) else 1
     if n_shard <= 1 or len(shape) < 2 or size < min_shard_size:
         return P()
+    if len(shape) >= 3:
+        if shape[-2] == 1 or shape[-1] % n_shard != 0:
+            _logger.debug(f'fsdp: conv kernel {path} {tuple(shape)} depthwise or out dim '
+                          f'not divisible by {n_shard}; replicating')
+            return P()
+        spec = [None] * len(shape)
+        spec[-1] = 'fsdp'
+        return P(*spec)
     # largest divisible dim → most even memory split; ties break to the
     # RIGHTMOST such dim (output features; matches megatron convention)
     best = None
@@ -185,6 +217,10 @@ def _megatron_spec(path: str, shape: Sequence[int], mesh: Mesh, rule_name: str,
     tp=1 placement is bit-identical to the 2-axis mesh. A head/hidden dim
     not divisible by the tp size replicates with a logged warning (never
     silently): the checkpoint still loads, placement is just degraded.
+
+    Conv kernels (rank >= 3): column stays the last dim (out channels), row
+    becomes dim -2 — the input-channel dim of the nnx ``(*window, in, out)``
+    layout — so a 1x1 projection conv gets exactly the Linear placement.
     """
     n_tp = tp_size(mesh)
     if n_tp <= 1:
@@ -192,7 +228,10 @@ def _megatron_spec(path: str, shape: Sequence[int], mesh: Mesh, rule_name: str,
     size = int(np.prod(shape)) if len(shape) else 1
     if len(shape) < 2 or size < min_shard_size:
         return P()
-    model_dim = len(shape) - 1 if col else 0
+    if col:
+        model_dim = len(shape) - 1
+    else:
+        model_dim = len(shape) - 2 if len(shape) >= 3 else 0
     if shape[model_dim] % n_tp != 0:
         _warn_once(path, (
             f"tp rule {rule_name!r}: {'output' if col else 'input'} dim "
@@ -499,6 +538,17 @@ def _spec_shard_count(spec: P, mesh: Mesh) -> int:
     return n
 
 
+def leaf_itemsize(dtype) -> int:
+    """Physical bytes per element, tolerant of extended dtypes: typed PRNG
+    key leaves (``key<fry>`` — swin-style blocks keep their DropPath/attn
+    Rngs in state) have no numpy dtype; count their uint32 key data
+    (threefry = 2 words) instead of crashing the byte accounting."""
+    try:
+        return np.dtype(dtype).itemsize
+    except TypeError:
+        return 8
+
+
 def param_bytes_per_device(tree, mesh: Mesh,
                            rules: Optional[Sequence[PartitionRule]] = None) -> Tuple[int, int]:
     """(replicated_bytes, sharded_bytes) a single device would hold for
@@ -508,7 +558,7 @@ def param_bytes_per_device(tree, mesh: Mesh,
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     rep = shard = 0
     for kp, leaf in flat:
-        nbytes = int(np.prod(getattr(leaf, 'shape', ()) or (1,))) * np.dtype(leaf.dtype).itemsize
+        nbytes = int(np.prod(getattr(leaf, 'shape', ()) or (1,))) * leaf_itemsize(leaf.dtype)
         rep += nbytes
         spec = spec_for_param(_kp_str(kp), getattr(leaf, 'shape', ()), mesh, rules)
         shard += nbytes // _spec_shard_count(spec, mesh)

@@ -65,12 +65,18 @@ class ProbeConfig:
     buckets: Tuple[int, ...] = (2, 4)     # serve only
     seq_len: int = 25                     # naflex packed probe only
     fused_update: bool = False            # route the step through fused_adamw
+    # batch spatial size when it is NOT a ctor kwarg (conv models size from
+    # the data; their ctors reject img_size) — falls back to model_kwargs
+    img_size: Optional[int] = None
     # tp 'fwd' residual-shape gate (config-specific HLO shape strings)
     fwd_expect_shard: str = ''
     fwd_forbid_full: str = ''
 
     def kwargs(self) -> Dict:
         return dict(self.model_kwargs)
+
+    def img(self, default: int = 224) -> int:
+        return int(self.img_size or self.kwargs().get('img_size', default))
 
 
 # The tier-1 matrix: one config per proven perf property, trimmed so the
@@ -147,6 +153,17 @@ DEFAULT_MATRIX: Tuple[ProbeConfig, ...] = (
     ProbeConfig(name='autotune', model='test_vit',
                 model_kwargs=(('num_classes', 10), ('img_size', 32)),
                 batch_size=8, grad_accum=8, collect='autotune'),
+    # hierarchical stage scan (ISSUE-20): the conv family baseline — convnext
+    # sizes from the data (ctor takes no img_size; the new img_size field
+    # sizes the batch), stages scanned via the set_block_scan alias
+    ProbeConfig(name='stage_scan_convnext', model='test_convnext',
+                model_kwargs=(('num_classes', 10),), img_size=64,
+                batch_size=8, block_scan=True, collect='full'),
+    # ...and the windowed-attention baseline at swin's native test size
+    # (relative-position tables are resolution-bound)
+    ProbeConfig(name='stage_scan_swin', model='test_swin',
+                model_kwargs=(('num_classes', 10),), img_size=96,
+                batch_size=8, block_scan=True, collect='full'),
 )
 
 
@@ -271,7 +288,7 @@ def _probe_train(cfg: ProbeConfig) -> Dict:
     dims = _model_dims(model)
 
     rng = np.random.RandomState(0)
-    s = int(cfg.kwargs().get('img_size', 224))
+    s = cfg.img(224)
     num_classes = int(cfg.kwargs().get('num_classes', 1000))
     batch = {'input': jnp.asarray(rng.rand(cfg.batch_size, s, s, 3), jnp.float32),
              'target': jnp.asarray(rng.randint(0, num_classes, cfg.batch_size))}
@@ -389,7 +406,7 @@ def _probe_augment(cfg: ProbeConfig) -> Dict:
     set_global_mesh(mesh)
     rng = np.random.RandomState(0)
     B = cfg.batch_size
-    s = int(cfg.kwargs().get('img_size', 32))
+    s = cfg.img(32)
     num_classes = int(cfg.kwargs().get('num_classes', 10))
     raw = shard_batch({
         'image': jnp.asarray(rng.randint(0, 256, (B, s, s, 3)), jnp.uint8),
@@ -622,7 +639,7 @@ def _probe_quant(cfg: ProbeConfig) -> Dict:
     model.eval()
     graphdef, state = nnx.split(model)
     qstate = quantize_tree(state)
-    img = cfg.kwargs().get('img_size', 224)
+    img = cfg.img(224)
     x = jnp.zeros((min(cfg.buckets), img, img, 3), jnp.float32)
 
     def fwd_fp(s, xx):
@@ -744,7 +761,7 @@ def _probe_elastic(cfg: ProbeConfig) -> Dict:
         bs * accum == global_batch and bs % mesh_to.size == 0)
 
     rng = np.random.RandomState(0)
-    s = int(cfg.kwargs().get('img_size', 224))
+    s = cfg.img(224)
     num_classes = int(cfg.kwargs().get('num_classes', 1000))
     batch = shard_batch({'input': jnp.asarray(rng.rand(bs, s, s, 3), jnp.float32),
                          'target': jnp.asarray(rng.randint(0, num_classes, bs))},

@@ -146,6 +146,13 @@ REPLAY_STEPS: Tuple[Dict, ...] = (
          title='static-analysis gate: source/jaxpr/HLO rules + zoo abstract-trace '
                '(a bench round never measures a repo the analyzers reject)',
          dry=dict(tiers=('A',), zoo='smoke'), live=dict()),
+    dict(id='family_sweep', item=None, kind='family_sweep',
+         title='family coverage sweep: re-derive the checked-in coverage matrix '
+               '(abstract trace, stage/block scan, sharded donated step, serve '
+               'AOT, device prefetch) and fail on any family that lost a '
+               'capability (dry = the tier-1 smoke subset; live = every '
+               'deep-eligible family)',
+         dry=dict(families='smoke'), live=dict(families='all')),
     dict(id='baseline', item=1, kind='train',
          title='baseline train-step throughput (the --save-self measurement)',
          dry=dict(_TINY), live=dict(_VITB)),
@@ -720,10 +727,37 @@ def _run_multihost(spec: Dict) -> Dict:
     return {'checks': result['checks'], 'details': result['details']}
 
 
+def _run_family_sweep(spec: Dict) -> Dict:
+    """Re-derive the family coverage matrix and diff it against the checked-in
+    fixture (analysis/coverage.py). Any family whose measured capabilities
+    drifted from tests/fixtures/coverage_matrix.json — a capability lost OR a
+    new one left unpinned — fails the step, so a bench round never reports
+    numbers for machinery the matrix says no longer works."""
+    from ..analysis.coverage import (
+        SMOKE_COVERAGE_FAMILIES, diff_matrix, family_coverage, load_matrix,
+    )
+
+    families = None
+    if spec.get('families') == 'smoke':
+        families = list(SMOKE_COVERAGE_FAMILIES)
+    rows = family_coverage(families=families)
+    problems = diff_matrix(load_matrix()['families'], rows)
+    if problems:
+        raise RuntimeError('coverage matrix drift:\n' + '\n'.join(problems))
+    deep = [m for m, r in rows.items() if r['deep']]
+    return {'families': len(rows), 'deep': len(deep),
+            'green': sum(1 for m in deep
+                         if rows[m]['sharded_donated_step'] and rows[m]['serve_aot']),
+            'scan_capable': sum(1 for r in rows.values()
+                                if r['stage_or_block_scan'])}
+
+
 def _run_step(step: Dict, dry_run: bool, trace_dir: Optional[str]) -> Dict:
     spec = step['dry'] if dry_run else step['live']
     if step['kind'] == 'analysis':
         return _run_analysis(spec)
+    if step['kind'] == 'family_sweep':
+        return _run_family_sweep(spec)
     if step['kind'] == 'train':
         return _run_train(spec)
     if step['kind'] == 'flash':

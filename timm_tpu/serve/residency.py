@@ -57,8 +57,10 @@ def _state_bytes_per_device(state, mesh) -> int:
         return int(sharded)
     except Exception:
         import jax
+
+        from ..parallel.sharding import leaf_itemsize
         return int(sum(
-            int(np.prod(getattr(l, 'shape', ()) or (1,))) * np.dtype(l.dtype).itemsize
+            int(np.prod(getattr(l, 'shape', ()) or (1,))) * leaf_itemsize(l.dtype)
             for l in jax.tree.leaves(state)))
 
 
